@@ -1,0 +1,23 @@
+"""opencv_facerecognizer_tpu — a TPU-native face recognition framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+``sandykindy/opencv_facerecognizer`` (the OCVFACEREC / bytefish-facerec
+lineage; see SURVEY.md for the structural blueprint — the reference mount was
+empty at build time, so citations are to SURVEY.md sections instead of
+reference file:line).
+
+Layering (mirrors SURVEY.md §1, rebuilt TPU-first):
+
+- ``ops``      — pure jittable device math: distances, LBP codes, image ops,
+                 PCA/LDA eigen-solvers, spatial histograms.
+- ``models``   — the plugin boundary the reference's north star preserves
+                 (SURVEY.md §1 L2-L4): ``AbstractFeature.compute/extract``,
+                 ``AbstractClassifier.compute/predict``, ``PredictableModel``.
+- ``utils``    — datasets, validation, serialization (pickle-free), metrics.
+
+Further layers follow the SURVEY.md §7 build order as they land: CNN
+embedder/detector under ``models``, device-mesh sharding under ``parallel``,
+and the serving runtime (batcher/connectors/trainer) under ``runtime``.
+"""
+
+__version__ = "0.1.0"

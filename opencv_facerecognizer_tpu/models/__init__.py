@@ -1,0 +1,52 @@
+"""The plugin boundary (SURVEY.md §1 L2-L4): features, classifiers, models.
+
+This is the one piece of the reference's architecture the north star
+explicitly preserves (BASELINE.json:5): ``AbstractFeature.compute/extract``,
+``AbstractClassifier.compute/predict``, ``PredictableModel`` composing them.
+Implementations are batched, jittable device functions.
+"""
+
+from opencv_facerecognizer_tpu.models.classifier import (
+    AbstractClassifier,
+    NearestNeighbor,
+    SVM,
+)
+from opencv_facerecognizer_tpu.models.feature import (
+    AbstractFeature,
+    Fisherfaces,
+    HistogramEqualization,
+    Identity,
+    LDA,
+    MinMaxNormalize,
+    PCA,
+    Resize,
+    SpatialHistogram,
+    TanTriggsPreprocessing,
+)
+from opencv_facerecognizer_tpu.models.model import ExtendedPredictableModel, PredictableModel
+from opencv_facerecognizer_tpu.models.operators import (
+    ChainOperator,
+    CombineOperator,
+    FeatureOperator,
+)
+
+__all__ = [
+    "AbstractClassifier",
+    "AbstractFeature",
+    "ChainOperator",
+    "CombineOperator",
+    "ExtendedPredictableModel",
+    "FeatureOperator",
+    "Fisherfaces",
+    "HistogramEqualization",
+    "Identity",
+    "LDA",
+    "MinMaxNormalize",
+    "NearestNeighbor",
+    "PCA",
+    "PredictableModel",
+    "Resize",
+    "SpatialHistogram",
+    "SVM",
+    "TanTriggsPreprocessing",
+]

@@ -1,0 +1,277 @@
+"""Classifier plugins: the ``AbstractClassifier.compute/predict`` boundary.
+
+Rebuilds the reference's ``facerec/classifier.py`` (SURVEY.md §2.1
+"Classifiers"): NearestNeighbor (k-NN over a pluggable AbstractDistance) and
+SVM. TPU-first redesign:
+
+- ``NearestNeighbor.predict`` on a batch is ONE pairwise-distance block
+  (a matmul for Euclidean/cosine) + ``lax.top_k`` + a one-hot vote — the
+  reference's per-query "distances to ALL gallery vectors -> argsort" hot
+  loop (SURVEY.md §3.4) collapses into a single fused device computation.
+  This same math is what ``parallel.gallery`` shards across devices when the
+  gallery outgrows one chip's HBM.
+- ``SVM`` is a linear multi-class SVM trained on device with optax (the
+  reference wrapped libsvm/cv2.ml, which do not exist here — SURVEY.md §7
+  notes even cv2.face is absent in this environment).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from opencv_facerecognizer_tpu.ops import distance as distance_ops
+
+
+def _require_int_labels(y) -> np.ndarray:
+    """Labels must be integers (the reference's convention too — subject
+    *names* belong in ExtendedPredictableModel.subject_names). String labels
+    would also poison the array-only checkpoint state."""
+    y = np.asarray(y)
+    if not np.issubdtype(y.dtype, np.integer):
+        raise TypeError(
+            f"labels must be integers, got dtype {y.dtype}; map subject names to "
+            "ids and carry the names in ExtendedPredictableModel.subject_names"
+        )
+    return y
+
+
+class AbstractClassifier:
+    """``compute(X, y)`` fits/enrolls; ``predict(q)`` -> (label, info)."""
+
+    name = "abstract_classifier"
+
+    def compute(self, X, y):
+        raise NotImplementedError
+
+    def predict(self, q):
+        raise NotImplementedError
+
+    # -- serialization protocol --
+    def get_config(self) -> dict:
+        return {}
+
+    @classmethod
+    def from_config(cls, config: dict) -> "AbstractClassifier":
+        return cls(**config)
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+def knn_predict(
+    pairwise_fn,
+    gallery: jnp.ndarray,
+    gallery_labels: jnp.ndarray,
+    num_classes: int,
+    queries: jnp.ndarray,
+    k: int,
+):
+    """Pure jittable k-NN: returns (pred_class_idx [Q], top-k labels [Q,k],
+    top-k distances [Q,k]).
+
+    Majority vote over the k nearest, ties broken toward the nearest
+    neighbor's class (a 0.5-vote bonus — exactly one winner, no data-dependent
+    control flow, so the whole thing jits).
+    """
+    d = pairwise_fn(queries, gallery)  # [Q, G]
+    k = min(int(k), int(gallery.shape[0]))
+    neg_topd, top_idx = jax.lax.top_k(-d, k)  # nearest = largest negative
+    top_labels = jnp.take(gallery_labels, top_idx)  # [Q, k]
+    votes = jax.nn.one_hot(top_labels, num_classes, dtype=jnp.float32).sum(axis=-2)
+    nearest_bonus = 0.5 * jax.nn.one_hot(top_labels[..., 0], num_classes, dtype=jnp.float32)
+    pred = jnp.argmax(votes + nearest_bonus, axis=-1)
+    return pred, top_labels, -neg_topd
+
+
+class NearestNeighbor(AbstractClassifier):
+    """Brute-force k-NN over the enrolled gallery (SURVEY.md §3.4), batched."""
+
+    name = "nearest_neighbor"
+
+    def __init__(self, dist_metric: Optional[distance_ops.AbstractDistance] = None, k: int = 1):
+        self.dist_metric = dist_metric or distance_ops.EuclideanDistance()
+        self.k = int(k)
+        self._gallery = None  # [G, D] float32
+        self._labels = None  # [G] int32 class indices
+        self._classes = None  # [C] original label values
+
+    def compute(self, X, y):
+        X = jnp.asarray(X, dtype=jnp.float32)
+        self._gallery = X.reshape((X.shape[0], -1))
+        classes, idx = np.unique(_require_int_labels(y), return_inverse=True)
+        self._classes = np.asarray(classes)
+        self._labels = jnp.asarray(idx, dtype=jnp.int32)
+
+    def predict(self, q):
+        """Single query -> [label, {"labels": [k], "distances": [k]}] (the
+        reference's return shape); batch [Q, D] -> (labels [Q], info dict)."""
+        if self._gallery is None:
+            raise RuntimeError("NearestNeighbor.predict called before compute()")
+        q = jnp.asarray(q, dtype=jnp.float32)
+        single = q.ndim == 1
+        qb = q[None] if single else q.reshape((q.shape[0], -1))
+        pred_idx, top_labels, top_dist = knn_predict(
+            self.dist_metric.pairwise,
+            self._gallery,
+            self._labels,
+            len(self._classes),
+            qb,
+            self.k,
+        )
+        pred = self._classes[np.asarray(pred_idx)]
+        info = {
+            "labels": self._classes[np.asarray(top_labels)],
+            "distances": np.asarray(top_dist),
+        }
+        if single:
+            return [pred[0], {"labels": info["labels"][0], "distances": info["distances"][0]}]
+        return pred, info
+
+    def get_config(self):
+        return {
+            "dist_metric": {"type": self.dist_metric.name, "config": self.dist_metric.get_config()},
+            "k": self.k,
+        }
+
+    @classmethod
+    def from_config(cls, config):
+        spec = config.get("dist_metric")
+        metric = None
+        if spec:
+            metric = distance_ops.DISTANCES[spec["type"]].from_config(spec["config"])
+        return cls(dist_metric=metric, k=config.get("k", 1))
+
+    def get_state(self):
+        if self._gallery is None:
+            return {}
+        return {
+            "gallery": self._gallery,
+            "labels": self._labels,
+            "classes": jnp.asarray(self._classes),
+        }
+
+    def set_state(self, state):
+        if state:
+            self._gallery = jnp.asarray(state["gallery"])
+            self._labels = jnp.asarray(state["labels"], dtype=jnp.int32)
+            self._classes = np.asarray(state["classes"])
+
+    def __repr__(self):
+        return f"NearestNeighbor(dist_metric={self.dist_metric!r}, k={self.k})"
+
+
+def _svm_train_step(params, opt_state, x, y_onehot, optimizer, reg):
+    def loss_fn(p):
+        logits = x @ p["w"] + p["b"]
+        # Multi-class hinge (Crammer-Singer): max over wrong classes.
+        correct = jnp.sum(logits * y_onehot, axis=-1)
+        wrong = jnp.max(logits - 1e9 * y_onehot, axis=-1)
+        hinge = jnp.maximum(0.0, 1.0 + wrong - correct)
+        return jnp.mean(hinge) + reg * jnp.sum(p["w"] ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+
+class SVM(AbstractClassifier):
+    """Linear multi-class SVM (Crammer-Singer hinge), trained on device.
+
+    Capability stand-in for the reference's libsvm/cv2.ml wrapper
+    (SURVEY.md §2.1); linear kernel covers the reference's default usage on
+    subspace features. Training runs ``epochs`` full-batch Adam steps under
+    ``lax.scan`` — one compiled loop, no Python iteration per step.
+    """
+
+    name = "svm"
+
+    def __init__(self, reg: float = 1e-4, learning_rate: float = 0.05, epochs: int = 300):
+        self.reg = float(reg)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self._params = None
+        self._classes = None
+        self._feat_mean = None
+        self._feat_scale = None
+
+    def compute(self, X, y):
+        X = jnp.asarray(X, dtype=jnp.float32)
+        X = X.reshape((X.shape[0], -1))
+        classes, idx = np.unique(_require_int_labels(y), return_inverse=True)
+        self._classes = np.asarray(classes)
+        c = len(classes)
+        # Standardize features for conditioning; stored for predict.
+        self._feat_mean = jnp.mean(X, axis=0)
+        self._feat_scale = jnp.maximum(jnp.std(X, axis=0), 1e-6)
+        Xs = (X - self._feat_mean) / self._feat_scale
+        y_onehot = jax.nn.one_hot(jnp.asarray(idx), c, dtype=jnp.float32)
+        d = Xs.shape[1]
+        params = {
+            "w": jnp.zeros((d, c), dtype=jnp.float32),
+            "b": jnp.zeros((c,), dtype=jnp.float32),
+        }
+        optimizer = optax.adam(self.learning_rate)
+        opt_state = optimizer.init(params)
+        reg = self.reg
+
+        def step(carry, _):
+            p, s = carry
+            p, s, loss = _svm_train_step(p, s, Xs, y_onehot, optimizer, reg)
+            return (p, s), loss
+
+        (params, _), _ = jax.lax.scan(step, (params, opt_state), None, length=self.epochs)
+        self._params = params
+
+    def decision_function(self, q):
+        q = jnp.asarray(q, dtype=jnp.float32)
+        qb = q.reshape((-1, q.shape[-1])) if q.ndim > 1 else q[None]
+        qs = (qb.reshape((qb.shape[0], -1)) - self._feat_mean) / self._feat_scale
+        return qs @ self._params["w"] + self._params["b"]
+
+    def predict(self, q):
+        if self._params is None:
+            raise RuntimeError("SVM.predict called before compute()")
+        q = jnp.asarray(q, dtype=jnp.float32)
+        single = q.ndim == 1
+        logits = self.decision_function(q)
+        idx = np.asarray(jnp.argmax(logits, axis=-1))
+        pred = self._classes[idx]
+        info = {"logits": np.asarray(logits)}
+        if single:
+            return [pred[0], {"logits": info["logits"][0]}]
+        return pred, info
+
+    def get_config(self):
+        return {"reg": self.reg, "learning_rate": self.learning_rate, "epochs": self.epochs}
+
+    def get_state(self):
+        if self._params is None:
+            return {}
+        return {
+            "w": self._params["w"],
+            "b": self._params["b"],
+            "classes": jnp.asarray(self._classes),
+            "feat_mean": self._feat_mean,
+            "feat_scale": self._feat_scale,
+        }
+
+    def set_state(self, state):
+        if state:
+            self._params = {"w": jnp.asarray(state["w"]), "b": jnp.asarray(state["b"])}
+            self._classes = np.asarray(state["classes"])
+            self._feat_mean = jnp.asarray(state["feat_mean"])
+            self._feat_scale = jnp.asarray(state["feat_scale"])
+
+
+CLASSIFIERS = {cls.name: cls for cls in (NearestNeighbor, SVM)}

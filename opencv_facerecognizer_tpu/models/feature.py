@@ -1,0 +1,419 @@
+"""Feature plugins: the ``AbstractFeature.compute/extract`` boundary.
+
+Rebuilds the reference's ``facerec/feature.py`` + ``facerec/preprocessing.py``
+capabilities (SURVEY.md §2.1): Identity, PCA (Eigenfaces), LDA, Fisherfaces,
+SpatialHistogram (LBPH), and the preprocessing plugins that share the feature
+protocol so they chain (TanTriggs, HistogramEqualization, Resize, MinMax).
+
+TPU-first redesign decisions:
+- ``compute(X, y)`` fits on the *whole batch at once* (one eigh / one pass),
+  returns the projected batch — no per-sample Python loops anywhere.
+- ``extract(X)`` is batched: it accepts either a single sample (the
+  reference's contract) or a batch with a leading N dim, and the math is a
+  pure jnp function either way, so callers can wrap it in jit/vmap/shard_map.
+- Fit state is held as arrays on the instance (a pytree via
+  ``get_state/set_state``), keeping the reference's stateful-plugin API while
+  the compute itself stays functional.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opencv_facerecognizer_tpu.ops import histogram as hist_ops
+from opencv_facerecognizer_tpu.ops import image as image_ops
+from opencv_facerecognizer_tpu.ops import lbp as lbp_ops
+from opencv_facerecognizer_tpu.ops import linalg as linalg_ops
+
+
+def as_row_matrix(x) -> jnp.ndarray:
+    """List-of-images or array [N, ...] -> [N, D] float32 row matrix.
+
+    The reference's ``asRowMatrix`` (SURVEY.md §2.1 "Matrix/dataset utils").
+    """
+    if isinstance(x, (list, tuple)):
+        x = jnp.stack([jnp.asarray(v) for v in x])
+    x = jnp.asarray(x, dtype=jnp.float32)
+    return x.reshape((x.shape[0], -1))
+
+
+def as_column_matrix(x) -> jnp.ndarray:
+    return as_row_matrix(x).T
+
+
+def _labels_to_indices(y) -> Tuple[np.ndarray, np.ndarray]:
+    """Arbitrary int labels -> (classes sorted unique, contiguous indices)."""
+    y = np.asarray(y)
+    classes, idx = np.unique(y, return_inverse=True)
+    return classes, idx.astype(np.int32)
+
+
+class AbstractFeature:
+    """``compute(X, y)`` fits on a dataset and returns projected features;
+    ``extract(X)`` transforms new sample(s). SURVEY.md §1 L2."""
+
+    name = "abstract_feature"
+    #: ndim of one raw input sample (2 = grayscale image); used to decide
+    #: whether ``extract`` got a single sample or a batch.
+    sample_ndim = 2
+
+    def compute(self, X, y):
+        raise NotImplementedError
+
+    def extract(self, X):
+        """Dispatch single-sample vs batch, delegate to ``_extract_batch``."""
+        X = jnp.asarray(X, dtype=jnp.float32)
+        if X.ndim == self.sample_ndim:
+            return self._extract_batch(X[None])[0]
+        return self._extract_batch(X)
+
+    def _extract_batch(self, X: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    # -- serialization protocol (utils.serialization) --
+    def get_config(self) -> dict:
+        return {}
+
+    @classmethod
+    def from_config(cls, config: dict) -> "AbstractFeature":
+        return cls(**config)
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        cfg = ", ".join(f"{k}={v}" for k, v in self.get_config().items())
+        return f"{type(self).__name__}({cfg})"
+
+
+class Identity(AbstractFeature):
+    """Flattens samples to vectors; the no-op feature."""
+
+    name = "identity"
+
+    def compute(self, X, y):
+        return as_row_matrix(X)
+
+    def _extract_batch(self, X):
+        return X.reshape((X.shape[0], -1))
+
+
+class _SubspaceFeature(AbstractFeature):
+    """Shared extract dispatch for features projecting flat [D] vectors.
+
+    A fitted subspace knows its input dim D, so single-vs-batch is decided
+    by element count, not a fixed sample ndim: a [H, W] image, a [D] vector,
+    or anything with exactly D elements is ONE sample (unless it is an
+    explicit [1, D] batch); everything else is a batch flattened to
+    [N, D]. This keeps the reference's single-sample contract working for
+    chains whose intermediate features are 1-D (e.g. PCA -> LDA).
+    """
+
+    def _input_dim(self) -> int:
+        raise NotImplementedError
+
+    def extract(self, X):
+        X = jnp.asarray(X, dtype=jnp.float32)
+        d = self._input_dim()
+        if X.size == d and not (X.ndim == 2 and X.shape[0] == 1):
+            return self._extract_batch(X.reshape((1, -1)))[0]
+        return self._extract_batch(X.reshape((X.shape[0], -1)))
+
+
+class PCA(_SubspaceFeature):
+    """Eigenfaces: mean-center, eigh via the small-matrix trick, top-k
+    eigenvectors (SURVEY.md §2.1, §3.1). ``num_components=0`` keeps all."""
+
+    name = "pca"
+
+    def __init__(self, num_components: int = 0):
+        self.num_components = int(num_components)
+        self._state: Optional[linalg_ops.PCAState] = None
+
+    def compute(self, X, y):
+        Xm = as_row_matrix(X)
+        n, d = Xm.shape
+        k = self.num_components if self.num_components > 0 else min(n, d)
+        k = min(k, n, d)
+        self._state = linalg_ops.pca_fit(Xm, k)
+        return linalg_ops.pca_project(self._state, Xm)
+
+    def _input_dim(self):
+        if self._state is None:
+            raise RuntimeError("PCA.extract called before compute()")
+        return int(self._state.components.shape[0])
+
+    def _extract_batch(self, X):
+        if self._state is None:
+            raise RuntimeError("PCA.extract called before compute()")
+        return linalg_ops.pca_project(self._state, X.reshape((X.shape[0], -1)))
+
+    def reconstruct(self, z):
+        return linalg_ops.pca_reconstruct(self._state, jnp.asarray(z))
+
+    @property
+    def mean(self):
+        return self._state.mean if self._state else None
+
+    @property
+    def eigenvectors(self):
+        return self._state.components if self._state else None
+
+    @property
+    def eigenvalues(self):
+        return self._state.eigenvalues if self._state else None
+
+    def get_config(self):
+        return {"num_components": self.num_components}
+
+    def get_state(self):
+        if self._state is None:
+            return {}
+        return {
+            "mean": self._state.mean,
+            "components": self._state.components,
+            "eigenvalues": self._state.eigenvalues,
+        }
+
+    def set_state(self, state):
+        if state:
+            self._state = linalg_ops.PCAState(
+                mean=jnp.asarray(state["mean"]),
+                components=jnp.asarray(state["components"]),
+                eigenvalues=jnp.asarray(state["eigenvalues"]),
+            )
+
+
+class LDA(_SubspaceFeature):
+    """Fisher LDA on flattened samples. ``num_components=0`` -> classes-1."""
+
+    name = "lda"
+
+    def __init__(self, num_components: int = 0):
+        self.num_components = int(num_components)
+        self._state: Optional[linalg_ops.LDAState] = None
+
+    def compute(self, X, y):
+        Xm = as_row_matrix(X)
+        _, y_idx = _labels_to_indices(y)
+        c = int(y_idx.max()) + 1
+        k = self.num_components if self.num_components > 0 else c - 1
+        k = min(k, c - 1)
+        self._state = linalg_ops.lda_fit(Xm, y_idx, num_classes=c, num_components=k)
+        return linalg_ops.lda_project(self._state, Xm)
+
+    def _input_dim(self):
+        if self._state is None:
+            raise RuntimeError("LDA.extract called before compute()")
+        return int(self._state.components.shape[0])
+
+    def _extract_batch(self, X):
+        if self._state is None:
+            raise RuntimeError("LDA.extract called before compute()")
+        return linalg_ops.lda_project(self._state, X.reshape((X.shape[0], -1)))
+
+    def get_config(self):
+        return {"num_components": self.num_components}
+
+    def get_state(self):
+        if self._state is None:
+            return {}
+        return {"components": self._state.components, "eigenvalues": self._state.eigenvalues}
+
+    def set_state(self, state):
+        if state:
+            self._state = linalg_ops.LDAState(
+                components=jnp.asarray(state["components"]),
+                eigenvalues=jnp.asarray(state["eigenvalues"]),
+            )
+
+
+class Fisherfaces(_SubspaceFeature):
+    """PCA to (N - c) dims, LDA to (c - 1): W = W_pca @ W_lda.
+
+    The reference's flagship classic feature (SURVEY.md §2.1, §3.1;
+    BASELINE.json:8). One projection matrix at extract time — a single
+    MXU matmul per batch.
+    """
+
+    name = "fisherfaces"
+
+    def __init__(self, num_components: int = 0):
+        self.num_components = int(num_components)
+        self._mean = None
+        self._components = None
+        self._eigenvalues = None
+
+    def compute(self, X, y):
+        Xm = as_row_matrix(X)
+        n, d = Xm.shape
+        _, y_idx = _labels_to_indices(y)
+        c = int(y_idx.max()) + 1
+        pca_k = max(1, min(n - c, n, d))
+        pca_state = linalg_ops.pca_fit(Xm, pca_k)
+        proj = linalg_ops.pca_project(pca_state, Xm)
+        k = self.num_components if self.num_components > 0 else c - 1
+        k = min(k, c - 1, pca_k)
+        lda_state = linalg_ops.lda_fit(proj, y_idx, num_classes=c, num_components=k)
+        self._mean = pca_state.mean
+        self._components = jnp.matmul(pca_state.components, lda_state.components, precision=jax.lax.Precision.HIGHEST)  # [D, k]
+        self._eigenvalues = lda_state.eigenvalues
+        return self._extract_batch(Xm)
+
+    def _input_dim(self):
+        if self._components is None:
+            raise RuntimeError("Fisherfaces.extract called before compute()")
+        return int(self._components.shape[0])
+
+    def _extract_batch(self, X):
+        if self._components is None:
+            raise RuntimeError("Fisherfaces.extract called before compute()")
+        Xf = X.reshape((X.shape[0], -1))
+        return jnp.matmul(Xf - self._mean, self._components, precision=jax.lax.Precision.HIGHEST)
+
+    @property
+    def eigenvectors(self):
+        return self._components
+
+    @property
+    def eigenvalues(self):
+        return self._eigenvalues
+
+    def get_config(self):
+        return {"num_components": self.num_components}
+
+    def get_state(self):
+        if self._components is None:
+            return {}
+        return {
+            "mean": self._mean,
+            "components": self._components,
+            "eigenvalues": self._eigenvalues,
+        }
+
+    def set_state(self, state):
+        if state:
+            self._mean = jnp.asarray(state["mean"])
+            self._components = jnp.asarray(state["components"])
+            self._eigenvalues = jnp.asarray(state["eigenvalues"])
+
+
+class SpatialHistogram(AbstractFeature):
+    """LBPH: LBP code map -> grid of cell histograms, concatenated
+    (SURVEY.md §2.1, BASELINE.json:9). Stateless; fully batched."""
+
+    name = "spatial_histogram"
+
+    def __init__(self, lbp_operator: Optional[lbp_ops.LocalBinaryOperator] = None,
+                 sz: Tuple[int, int] = (8, 8)):
+        self.lbp_operator = lbp_operator or lbp_ops.ExtendedLBP(radius=1, neighbors=8)
+        self.sz = tuple(int(v) for v in sz)
+
+    def compute(self, X, y):
+        if isinstance(X, (list, tuple)):
+            X = jnp.stack([jnp.asarray(v) for v in X])
+        return self._extract_batch(jnp.asarray(X, dtype=jnp.float32))
+
+    def _extract_batch(self, X):
+        codes = self.lbp_operator(X)
+        return hist_ops.spatial_histogram(
+            codes, grid=self.sz, num_bins=self.lbp_operator.num_bins
+        )
+
+    def get_config(self):
+        return {
+            "lbp_operator": {
+                "type": self.lbp_operator.name,
+                "config": self.lbp_operator.get_config(),
+            },
+            "sz": list(self.sz),
+        }
+
+    @classmethod
+    def from_config(cls, config):
+        op_spec = config.get("lbp_operator")
+        op = None
+        if op_spec:
+            op = lbp_ops.LBP_OPERATORS[op_spec["type"]].from_config(op_spec["config"])
+        return cls(lbp_operator=op, sz=tuple(config.get("sz", (8, 8))))
+
+
+# ---------------------------------------------------------------------------
+# Preprocessing plugins — share the feature protocol so they chain
+# (SURVEY.md §2.1 "Preprocessing"). All stateless.
+# ---------------------------------------------------------------------------
+
+
+class _StatelessImageFeature(AbstractFeature):
+    def compute(self, X, y):
+        if isinstance(X, (list, tuple)):
+            X = jnp.stack([jnp.asarray(v) for v in X])
+        return self._extract_batch(jnp.asarray(X, dtype=jnp.float32))
+
+
+class TanTriggsPreprocessing(_StatelessImageFeature):
+    name = "tan_triggs"
+
+    def __init__(self, alpha: float = 0.1, tau: float = 10.0, gamma: float = 0.2,
+                 sigma0: float = 1.0, sigma1: float = 2.0):
+        self.alpha, self.tau, self.gamma = float(alpha), float(tau), float(gamma)
+        self.sigma0, self.sigma1 = float(sigma0), float(sigma1)
+
+    def _extract_batch(self, X):
+        return image_ops.tan_triggs(
+            X, self.alpha, self.tau, self.gamma, self.sigma0, self.sigma1
+        )
+
+    def get_config(self):
+        return {"alpha": self.alpha, "tau": self.tau, "gamma": self.gamma,
+                "sigma0": self.sigma0, "sigma1": self.sigma1}
+
+
+class HistogramEqualization(_StatelessImageFeature):
+    name = "histogram_equalization"
+
+    def __init__(self, num_bins: int = 256):
+        self.num_bins = int(num_bins)
+
+    def _extract_batch(self, X):
+        return image_ops.histogram_equalize(X, self.num_bins)
+
+    def get_config(self):
+        return {"num_bins": self.num_bins}
+
+
+class Resize(_StatelessImageFeature):
+    name = "resize"
+
+    def __init__(self, size: Tuple[int, int] = (70, 70)):
+        self.size = tuple(int(v) for v in size)
+
+    def _extract_batch(self, X):
+        return image_ops.resize(X, self.size)
+
+    def get_config(self):
+        return {"size": list(self.size)}
+
+    @classmethod
+    def from_config(cls, config):
+        return cls(size=tuple(config["size"]))
+
+
+class MinMaxNormalize(_StatelessImageFeature):
+    name = "minmax_normalize"
+
+    def __init__(self, low: float = 0.0, high: float = 1.0):
+        self.low, self.high = float(low), float(high)
+
+    def _extract_batch(self, X):
+        return image_ops.minmax_normalize(X, self.low, self.high)
+
+    def get_config(self):
+        return {"low": self.low, "high": self.high}

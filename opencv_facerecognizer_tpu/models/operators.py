@@ -1,0 +1,96 @@
+"""Feature operators: compose features as a DAG (SURVEY.md §2.1
+"Feature operators": FeatureOperator, ChainOperator, CombineOperator).
+
+Composition is plain function composition over batched extracts, so a chain
+like Resize -> TanTriggs -> Fisherfaces stays one jittable device graph.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from opencv_facerecognizer_tpu.models.feature import AbstractFeature
+
+
+class FeatureOperator(AbstractFeature):
+    """Base for binary feature operators."""
+
+    name = "feature_operator"
+
+    def __init__(self, model1: AbstractFeature, model2: AbstractFeature):
+        self.model1 = model1
+        self.model2 = model2
+
+    @property
+    def sample_ndim(self):  # type: ignore[override]
+        return self.model1.sample_ndim
+
+    def get_config(self):
+        from opencv_facerecognizer_tpu.utils import serialization
+
+        return {
+            "model1": serialization.serialize_spec(self.model1),
+            "model2": serialization.serialize_spec(self.model2),
+        }
+
+    @classmethod
+    def from_config(cls, config):
+        from opencv_facerecognizer_tpu.utils import serialization
+
+        return cls(
+            serialization.deserialize_spec(config["model1"]),
+            serialization.deserialize_spec(config["model2"]),
+        )
+
+    def get_state(self):
+        return {"model1": self.model1.get_state(), "model2": self.model2.get_state()}
+
+    def set_state(self, state):
+        if state:
+            self.model1.set_state(state.get("model1", {}))
+            self.model2.set_state(state.get("model2", {}))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.model1!r}, {self.model2!r})"
+
+
+class ChainOperator(FeatureOperator):
+    """model2(model1(X)): e.g. TanTriggs -> Fisherfaces (SURVEY.md §3.4)."""
+
+    name = "chain_operator"
+
+    def compute(self, X, y):
+        return self.model2.compute(self.model1.compute(X, y), y)
+
+    def extract(self, X):
+        return self.model2.extract(self.model1.extract(X))
+
+    def _extract_batch(self, X):
+        return self.extract(X)
+
+
+class CombineOperator(FeatureOperator):
+    """Concatenate both features' flattened outputs along the last axis."""
+
+    name = "combine_operator"
+
+    @staticmethod
+    def _flat2(a: jnp.ndarray, batched: bool) -> jnp.ndarray:
+        if batched:
+            return a.reshape((a.shape[0], -1))
+        return a.reshape((-1,))
+
+    def compute(self, X, y):
+        a = jnp.asarray(self.model1.compute(X, y))
+        b = jnp.asarray(self.model2.compute(X, y))
+        return jnp.concatenate([self._flat2(a, True), self._flat2(b, True)], axis=-1)
+
+    def extract(self, X):
+        X = jnp.asarray(X, dtype=jnp.float32)
+        batched = X.ndim != self.sample_ndim
+        a = jnp.asarray(self.model1.extract(X))
+        b = jnp.asarray(self.model2.extract(X))
+        return jnp.concatenate([self._flat2(a, batched), self._flat2(b, batched)], axis=-1)
+
+    def _extract_batch(self, X):
+        return self.extract(X)

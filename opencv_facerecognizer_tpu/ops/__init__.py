@@ -1,0 +1,5 @@
+"""Pure jittable device math primitives (SURVEY.md §1 L1, §7 stage 1)."""
+
+from opencv_facerecognizer_tpu.ops import distance, histogram, image, lbp, linalg
+
+__all__ = ["distance", "histogram", "image", "lbp", "linalg"]

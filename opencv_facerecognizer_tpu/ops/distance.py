@@ -1,0 +1,235 @@
+"""Distance metrics, batched pairwise by construction.
+
+Rebuilds the capability of the reference's ``facerec/distance.py``
+(SURVEY.md §2.1 "Distance metrics": AbstractDistance + Euclidean, Cosine,
+NormalizedCorrelation, ChiSquare, HistogramIntersection, BinRatio,
+L1BinRatio, ChiSquareBRD), redesigned TPU-first:
+
+- The unit of work is a *pairwise block* ``(Q queries, G gallery) -> [Q, G]``,
+  not a scalar pair. Euclidean / cosine / correlation are expressed as one
+  matmul plus elementwise terms so XLA tiles them onto the MXU; the
+  histogram-family distances are broadcast elementwise reductions fused by
+  XLA on the VPU.
+- Everything is a pure function of arrays; the thin ``AbstractDistance``
+  classes below only carry the name + pairwise fn so the classifier layer
+  keeps the reference's pluggable-distance boundary (SURVEY.md §1 L3).
+
+Convention (matches the reference's NearestNeighbor contract): smaller value
+== more similar. Similarity measures (cosine, normalized correlation,
+histogram intersection) are therefore negated/complemented, which reorders
+nothing for k-NN but keeps a single "min is best" rule end-to-end.
+
+The bin-ratio family follows the published Bin Ratio Dissimilarity
+definitions (Xie/Hu et al.); the reference mount was empty so exact upstream
+formulas could not be re-verified (SURVEY.md §0) — these are capability
+rebuilds, not byte-parity ports.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+PairwiseFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def _mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full-precision matmul: these distances run on small subspace/LBPH
+    features where f32 accuracy beats MXU bf16 throughput (the CNN-embedding
+    gallery matcher makes the opposite trade explicitly)."""
+    return jnp.matmul(a, b, precision=jax.lax.Precision.HIGHEST)
+
+
+def _as_2d(x: jnp.ndarray) -> jnp.ndarray:
+    """Flatten anything to [batch, dim]; promote a single vector to [1, dim]."""
+    x = jnp.asarray(x)
+    if x.ndim == 1:
+        return x[None, :]
+    return x.reshape((x.shape[0], -1))
+
+
+def euclidean(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise L2 distance [Q, G] via the ||p||^2 + ||q||^2 - 2 p.q matmul trick."""
+    p, q = _as_2d(p), _as_2d(q)
+    p2 = jnp.sum(p * p, axis=-1)[:, None]
+    q2 = jnp.sum(q * q, axis=-1)[None, :]
+    sq = p2 + q2 - 2.0 * _mm(p, q.T)
+    return jnp.sqrt(jnp.maximum(sq, 0.0))
+
+
+def squared_euclidean(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    p, q = _as_2d(p), _as_2d(q)
+    p2 = jnp.sum(p * p, axis=-1)[:, None]
+    q2 = jnp.sum(q * q, axis=-1)[None, :]
+    return jnp.maximum(p2 + q2 - 2.0 * _mm(p, q.T), 0.0)
+
+
+def cosine(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Negative cosine similarity (min == most similar), one matmul."""
+    p, q = _as_2d(p), _as_2d(q)
+    pn = p / jnp.maximum(jnp.linalg.norm(p, axis=-1, keepdims=True), _EPS)
+    qn = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), _EPS)
+    return -_mm(pn, qn.T)
+
+
+def normalized_correlation(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """1 - Pearson correlation: mean-center each vector, then cosine."""
+    p, q = _as_2d(p), _as_2d(q)
+    pc = p - jnp.mean(p, axis=-1, keepdims=True)
+    qc = q - jnp.mean(q, axis=-1, keepdims=True)
+    pn = pc / jnp.maximum(jnp.linalg.norm(pc, axis=-1, keepdims=True), _EPS)
+    qn = qc / jnp.maximum(jnp.linalg.norm(qc, axis=-1, keepdims=True), _EPS)
+    return 1.0 - _mm(pn, qn.T)
+
+
+def _broadcast_pair(p: jnp.ndarray, q: jnp.ndarray):
+    """[Q, 1, D], [1, G, D] views for elementwise pairwise reductions."""
+    p, q = _as_2d(p), _as_2d(q)
+    return p[:, None, :], q[None, :, :]
+
+
+def chi_square(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Chi-square histogram distance: sum (p-q)^2 / (p+q)."""
+    pb, qb = _broadcast_pair(p, q)
+    d = pb - qb
+    s = pb + qb
+    return jnp.sum(d * d / jnp.maximum(s, _EPS), axis=-1)
+
+
+def histogram_intersection(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Negated histogram intersection sum(min(p, q)) so that min == best."""
+    pb, qb = _broadcast_pair(p, q)
+    return -jnp.sum(jnp.minimum(pb, qb), axis=-1)
+
+
+def bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Bin Ratio Dissimilarity: sum (p-q)^2 / (p+q)^2."""
+    pb, qb = _broadcast_pair(p, q)
+    d = pb - qb
+    s = jnp.maximum(pb + qb, _EPS)
+    return jnp.sum((d / s) * d / s, axis=-1)
+
+
+def l1_bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """L1-weighted Bin Ratio Dissimilarity: sum |p-q| (p-q)^2 / (p+q)^2."""
+    pb, qb = _broadcast_pair(p, q)
+    d = pb - qb
+    s = jnp.maximum(pb + qb, _EPS)
+    return jnp.sum(jnp.abs(d) * (d / s) * (d / s), axis=-1)
+
+
+def chi_square_bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Chi-square-weighted Bin Ratio Dissimilarity: sum (p-q)^2/(p+q) * (p-q)^2/(p+q)^2."""
+    pb, qb = _broadcast_pair(p, q)
+    d = pb - qb
+    s = jnp.maximum(pb + qb, _EPS)
+    r = d / s
+    return jnp.sum((d * d / s) * r * r, axis=-1)
+
+
+def manhattan(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise L1 distance."""
+    pb, qb = _broadcast_pair(p, q)
+    return jnp.sum(jnp.abs(pb - qb), axis=-1)
+
+
+class AbstractDistance:
+    """Pluggable distance: callable on (query batch, gallery batch) -> [Q, G].
+
+    Keeps the reference's AbstractDistance boundary (SURVEY.md §2.1) while the
+    actual math lives in the pure pairwise functions above. ``__call__`` on
+    two single vectors returns a scalar, matching the reference's scalar
+    contract; on batches it returns the full pairwise block.
+    """
+
+    name: str = "abstract"
+    pairwise: PairwiseFn = None  # type: ignore[assignment]
+
+    def __call__(self, p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+        p = jnp.asarray(p)
+        q = jnp.asarray(q)
+        scalar = p.ndim == 1 and q.ndim == 1
+        out = type(self).pairwise(p, q)
+        return out[0, 0] if scalar else out
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    # Serialization hooks (utils.serialization registry).
+    def get_config(self) -> dict:
+        return {}
+
+    @classmethod
+    def from_config(cls, config: dict) -> "AbstractDistance":
+        return cls(**config)
+
+
+class EuclideanDistance(AbstractDistance):
+    name = "euclidean"
+    pairwise = staticmethod(euclidean)
+
+
+class SquaredEuclideanDistance(AbstractDistance):
+    name = "squared_euclidean"
+    pairwise = staticmethod(squared_euclidean)
+
+
+class CosineDistance(AbstractDistance):
+    name = "cosine"
+    pairwise = staticmethod(cosine)
+
+
+class NormalizedCorrelation(AbstractDistance):
+    name = "normalized_correlation"
+    pairwise = staticmethod(normalized_correlation)
+
+
+class ChiSquareDistance(AbstractDistance):
+    name = "chi_square"
+    pairwise = staticmethod(chi_square)
+
+
+class HistogramIntersection(AbstractDistance):
+    name = "histogram_intersection"
+    pairwise = staticmethod(histogram_intersection)
+
+
+class BinRatioDistance(AbstractDistance):
+    name = "bin_ratio"
+    pairwise = staticmethod(bin_ratio)
+
+
+class L1BinRatioDistance(AbstractDistance):
+    name = "l1_bin_ratio"
+    pairwise = staticmethod(l1_bin_ratio)
+
+
+class ChiSquareBRD(AbstractDistance):
+    name = "chi_square_brd"
+    pairwise = staticmethod(chi_square_bin_ratio)
+
+
+class ManhattanDistance(AbstractDistance):
+    name = "manhattan"
+    pairwise = staticmethod(manhattan)
+
+
+DISTANCES = {
+    cls.name: cls
+    for cls in (
+        EuclideanDistance,
+        SquaredEuclideanDistance,
+        CosineDistance,
+        NormalizedCorrelation,
+        ChiSquareDistance,
+        HistogramIntersection,
+        BinRatioDistance,
+        L1BinRatioDistance,
+        ChiSquareBRD,
+        ManhattanDistance,
+    )
+}

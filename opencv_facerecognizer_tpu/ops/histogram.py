@@ -1,0 +1,50 @@
+"""Spatial histograms of code maps (the LBPH descriptor core).
+
+Rebuilds the reference's ``SpatialHistogram`` compute kernel (SURVEY.md §2.1
+"Feature plugins": grid of LBP histograms, concatenated), TPU-first: instead
+of ``np.histogram`` per cell in a Python loop, the code map is cropped to a
+multiple of the grid, reshaped into cells, and histogrammed with a one-hot
+matmul — one big [pixels, bins] contraction the MXU handles, batched over
+leading dims.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def spatial_histogram(
+    codes: jnp.ndarray,
+    grid: Tuple[int, int] = (8, 8),
+    num_bins: int = 256,
+    normalize: bool = True,
+) -> jnp.ndarray:
+    """[..., H, W] int codes -> [..., gy*gx*num_bins] concatenated cell histograms.
+
+    The map is center-cropped so H, W divide evenly by the grid (static
+    shapes; the few boundary rows a remainder would cover carry negligible
+    signal for LBPH). Each cell histogram is L1-normalized when
+    ``normalize`` so the descriptor is comparable across cell sizes.
+    """
+    codes = jnp.asarray(codes)
+    gy, gx = grid
+    h, w = codes.shape[-2], codes.shape[-1]
+    ch, cw = h // gy, w // gx
+    if ch == 0 or cw == 0:
+        raise ValueError(f"code map {h}x{w} smaller than grid {grid}")
+    # Center crop to (gy*ch, gx*cw).
+    y0 = (h - gy * ch) // 2
+    x0 = (w - gx * cw) // 2
+    codes = codes[..., y0 : y0 + gy * ch, x0 : x0 + gx * cw]
+    batch = codes.shape[:-2]
+    # [..., gy, ch, gx, cw] -> [..., gy, gx, ch*cw]
+    cells = codes.reshape(batch + (gy, ch, gx, cw))
+    cells = jnp.swapaxes(cells, -3, -2).reshape(batch + (gy, gx, ch * cw))
+    onehot = jax.nn.one_hot(cells, num_bins, dtype=jnp.float32)  # [..., gy, gx, n, B]
+    hist = jnp.sum(onehot, axis=-2)  # [..., gy, gx, B]
+    if normalize:
+        hist = hist / jnp.maximum(jnp.sum(hist, axis=-1, keepdims=True), 1e-12)
+    return hist.reshape(batch + (gy * gx * num_bins,))

@@ -1,0 +1,146 @@
+"""Image ops as pure jnp functions: the TPU replacement for the reference's
+imported OpenCV C++ surface (SURVEY.md §2.2: cv2.resize / cvtColor /
+equalizeHist) and its preprocessing plugins (SURVEY.md §2.1
+"Preprocessing": TanTriggs, HistogramEqualization, Resize, minmax).
+
+All functions take ``[..., H, W]`` (grayscale) or ``[..., H, W, 3]`` (RGB)
+float arrays and broadcast over leading batch dims, so the whole
+preprocessing chain stays inside one jitted graph — no host round-trips per
+frame.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# BT.601 luma weights — matches cv2.cvtColor(BGR2GRAY) up to channel order.
+_LUMA_RGB = (0.299, 0.587, 0.114)
+
+
+def to_grayscale(x: jnp.ndarray, channel_order: str = "rgb") -> jnp.ndarray:
+    """[..., H, W, 3] -> [..., H, W] luma; a dot product the VPU eats for free."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    w = jnp.array(_LUMA_RGB if channel_order == "rgb" else _LUMA_RGB[::-1], dtype=jnp.float32)
+    return x @ w
+
+
+def resize(x: jnp.ndarray, size: Tuple[int, int], method: str = "bilinear") -> jnp.ndarray:
+    """Resize trailing [H, W] dims to ``size=(h, w)``; batch dims untouched."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    out_shape = x.shape[:-2] + tuple(size)
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def minmax_normalize(x: jnp.ndarray, low: float = 0.0, high: float = 1.0) -> jnp.ndarray:
+    """Per-image min/max normalization over the trailing [H, W] dims."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    mn = jnp.min(x, axis=(-2, -1), keepdims=True)
+    mx = jnp.max(x, axis=(-2, -1), keepdims=True)
+    scale = (high - low) / jnp.maximum(mx - mn, 1e-12)
+    return low + (x - mn) * scale
+
+
+def histogram_equalize(x: jnp.ndarray, num_bins: int = 256) -> jnp.ndarray:
+    """Per-image histogram equalization, jittable (one-hot histogram + cumsum LUT).
+
+    Input is expected in [0, 255] (any float range works: it is first
+    quantized to ``num_bins`` levels over [0, 255]). Output is float32 in
+    [0, 255], matching cv2.equalizeHist semantics closely enough for the
+    preprocessing chain.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    h, w = x.shape[-2], x.shape[-1]
+    n = h * w
+    idx = jnp.clip(x, 0.0, 255.0) * ((num_bins - 1) / 255.0)
+    idx = jnp.round(idx).astype(jnp.int32)
+    flat = idx.reshape(x.shape[:-2] + (n,))
+    # Histogram via one-hot matmul: [.., n] x [num_bins] -> [.., num_bins].
+    onehot = jax.nn.one_hot(flat, num_bins, dtype=jnp.float32)
+    hist = jnp.sum(onehot, axis=-2)
+    cdf = jnp.cumsum(hist, axis=-1)
+    cdf_min = jnp.take_along_axis(
+        cdf, jnp.argmax((hist > 0).astype(jnp.int32), axis=-1)[..., None], axis=-1
+    )
+    denom = jnp.maximum(n - cdf_min, 1.0)
+    lut = jnp.clip((cdf - cdf_min) / denom * 255.0, 0.0, 255.0)
+    out = jnp.take_along_axis(lut, flat, axis=-1)
+    return out.reshape(x.shape)
+
+
+def _gaussian_kernel_1d(sigma: float) -> jnp.ndarray:
+    """Static-size separable Gaussian taps (radius = ceil(3 sigma))."""
+    radius = max(1, int(math.ceil(3.0 * sigma)))
+    xs = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-(xs**2) / (2.0 * sigma * sigma))
+    return k / jnp.sum(k)
+
+
+def gaussian_blur(x: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """Separable Gaussian blur over trailing [H, W], 'same' size, edge-replicate.
+
+    Implemented as two 1-D convolutions with static kernels so XLA lowers
+    them to small dense convs (MXU-friendly) instead of a generic stencil.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    k = _gaussian_kernel_1d(sigma)
+    r = (k.shape[0] - 1) // 2
+    batch_shape = x.shape[:-2]
+    h, w = x.shape[-2], x.shape[-1]
+    xb = x.reshape((-1, h, w))
+
+    def conv_last(a: jnp.ndarray) -> jnp.ndarray:
+        # a: [N, L, M]; convolve along M with edge padding.
+        ap = jnp.pad(a, ((0, 0), (0, 0), (r, r)), mode="edge")
+        # [N, L, M + 2r] -> conv via jnp stacked slices (static taps).
+        out = jnp.zeros_like(a)
+        for i in range(2 * r + 1):
+            out = out + k[i] * ap[:, :, i : i + a.shape[-1]]
+        return out
+
+    xb = conv_last(xb)  # along W
+    xb = conv_last(xb.swapaxes(-1, -2)).swapaxes(-1, -2)  # along H
+    return xb.reshape(batch_shape + (h, w))
+
+
+def tan_triggs(
+    x: jnp.ndarray,
+    alpha: float = 0.1,
+    tau: float = 10.0,
+    gamma: float = 0.2,
+    sigma0: float = 1.0,
+    sigma1: float = 2.0,
+) -> jnp.ndarray:
+    """Tan-Triggs illumination normalization (gamma -> DoG -> contrast eq).
+
+    Default parameters follow the facerec-family defaults as reconstructed in
+    SURVEY.md §2.1 (reference mount empty — defaults tagged [U] there).
+    Output is zero-centered, tau-bounded (tanh stage), float32.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    # Gamma correction (input shifted to >= 1 to keep the power stable).
+    xg = jnp.power(x + 1.0, gamma)
+    # Difference of Gaussians.
+    dog = gaussian_blur(xg, sigma0) - gaussian_blur(xg, sigma1)
+    # Two-stage contrast equalization.
+    axes = (-2, -1)
+    m1 = jnp.mean(jnp.abs(dog) ** alpha, axis=axes, keepdims=True)
+    dog = dog / jnp.maximum(m1, 1e-12) ** (1.0 / alpha)
+    m2 = jnp.mean(jnp.minimum(jnp.abs(dog), tau) ** alpha, axis=axes, keepdims=True)
+    dog = dog / jnp.maximum(m2, 1e-12) ** (1.0 / alpha)
+    return tau * jnp.tanh(dog / tau)
+
+
+def crop_and_resize(
+    frame: jnp.ndarray, box: Sequence[int], size: Tuple[int, int]
+) -> jnp.ndarray:
+    """Crop [y0:y1, x0:x1] from a [H, W] frame and resize to ``size``.
+
+    Host-side convenience for the serving path (boxes are dynamic there; the
+    batched on-device equivalent uses fixed-size aligned crops).
+    """
+    y0, x0, y1, x1 = (int(v) for v in box)
+    return resize(frame[..., y0:y1, x0:x1], size)

@@ -1,0 +1,206 @@
+"""Local Binary Pattern operators as pure, batched jnp functions.
+
+Rebuilds the reference's ``facerec/lbp.py`` capability (SURVEY.md §2.1 "LBP
+operators": OriginalLBP 3x3, ExtendedLBP circular with bilinear
+interpolation, VarLBP variance), TPU-first:
+
+- All operators act on ``[..., H, W]`` float/uint8 images and return
+  ``[..., H-2R, W-2R]`` code/variance maps — leading batch dims broadcast
+  for free, no per-image Python loops.
+- The circular sampling offsets are *static* Python floats (radius and
+  neighbor count are plugin constructor args, hence compile-time constants),
+  so bilinear interpolation compiles to four static slices + a weighted sum
+  per neighbor: pure VPU elementwise work, no gathers, no dynamic shapes.
+- Codes are built with comparisons and static bit weights; XLA fuses the
+  whole operator into one elementwise kernel.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+def original_lbp(x: jnp.ndarray) -> jnp.ndarray:
+    """3x3 LBP code map: [..., H, W] -> [..., H-2, W-2] int32 in [0, 255].
+
+    Bit order: clockwise from the top-left neighbor, MSB first (the standard
+    original-LBP weighting the reference family uses).
+    """
+    x = jnp.asarray(x)
+    c = x[..., 1:-1, 1:-1]
+    neighbors = (
+        x[..., 0:-2, 0:-2],  # top-left
+        x[..., 0:-2, 1:-1],  # top
+        x[..., 0:-2, 2:],    # top-right
+        x[..., 1:-1, 2:],    # right
+        x[..., 2:, 2:],      # bottom-right
+        x[..., 2:, 1:-1],    # bottom
+        x[..., 2:, 0:-2],    # bottom-left
+        x[..., 1:-1, 0:-2],  # left
+    )
+    code = jnp.zeros(c.shape, dtype=jnp.int32)
+    for i, n in enumerate(neighbors):
+        bit = 1 << (7 - i)
+        code = code + bit * (n >= c).astype(jnp.int32)
+    return code
+
+
+def _circular_samples(x: jnp.ndarray, radius: int, neighbors: int):
+    """Bilinearly-interpolated circular samples around each interior pixel.
+
+    Returns a list of ``neighbors`` arrays shaped [..., H-2r, W-2r]. Sample
+    k sits at angle ``2*pi*k/neighbors`` on a circle of ``radius`` around the
+    center; all offsets are static so each sample is four static slices.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    h, w = x.shape[-2], x.shape[-1]
+    oh, ow = h - 2 * radius, w - 2 * radius
+    samples = []
+    for k in range(neighbors):
+        theta = 2.0 * math.pi * k / neighbors
+        # Standard circular-LBP sample point (row offset, col offset).
+        dy = -radius * math.sin(theta)
+        dx = radius * math.cos(theta)
+        fy, fx = math.floor(dy), math.floor(dx)
+        ty, tx = dy - fy, dx - fx
+        # Bilinear weights over the 4 integer neighbors of (dy, dx).
+        w00 = (1 - ty) * (1 - tx)
+        w01 = (1 - ty) * tx
+        w10 = ty * (1 - tx)
+        w11 = ty * tx
+        # Window origin for the interior region, shifted by the offset.
+        y0 = radius + fy
+        x0 = radius + fx
+
+        def win(yy, xx):
+            return x[..., yy : yy + oh, xx : xx + ow]
+
+        # Zero-weight taps are skipped: when the sample sits exactly on an
+        # integer offset the +1 slice would run past the image edge, and the
+        # weights are static Python floats so the skip costs nothing.
+        s = None
+        for wgt, yy, xx in (
+            (w00, y0, x0),
+            (w01, y0, x0 + 1),
+            (w10, y0 + 1, x0),
+            (w11, y0 + 1, x0 + 1),
+        ):
+            if wgt > 1e-12:
+                term = wgt * win(yy, xx)
+                s = term if s is None else s + term
+        samples.append(s)
+    return samples
+
+
+def extended_lbp(x: jnp.ndarray, radius: int = 1, neighbors: int = 8) -> jnp.ndarray:
+    """Circular (extended) LBP: [..., H, W] -> [..., H-2r, W-2r] int32 codes."""
+    if neighbors > 31:
+        raise ValueError("extended_lbp supports at most 31 neighbors (int32 codes)")
+    x = jnp.asarray(x, dtype=jnp.float32)
+    c = x[..., radius:-radius, radius:-radius]
+    code = jnp.zeros(c.shape, dtype=jnp.int32)
+    for k, s in enumerate(_circular_samples(x, radius, neighbors)):
+        # Tolerance mirrors the upstream family's >= comparison on floats.
+        code = code + (1 << k) * (s >= c).astype(jnp.int32)
+    return code
+
+
+def var_lbp(x: jnp.ndarray, radius: int = 1, neighbors: int = 8) -> jnp.ndarray:
+    """Rotation-invariant local variance of the circular samples (VAR operator)."""
+    samples = jnp.stack(_circular_samples(x, radius, neighbors), axis=0)
+    mean = jnp.mean(samples, axis=0)
+    return jnp.mean((samples - mean) ** 2, axis=0)
+
+
+def lbp_num_bins(neighbors: int = 8) -> int:
+    return 1 << neighbors
+
+
+class LocalBinaryOperator:
+    """Pluggable LBP operator (the reference's lbp-operator boundary,
+    SURVEY.md §2.1): callable on [..., H, W] images, exposes ``num_bins``
+    for the SpatialHistogram feature and config hooks for serialization."""
+
+    name = "abstract_lbp"
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    @property
+    def num_bins(self) -> int:
+        raise NotImplementedError
+
+    def get_config(self) -> dict:
+        return {}
+
+    @classmethod
+    def from_config(cls, config: dict) -> "LocalBinaryOperator":
+        return cls(**config)
+
+    def __repr__(self) -> str:
+        cfg = ", ".join(f"{k}={v}" for k, v in self.get_config().items())
+        return f"{type(self).__name__}({cfg})"
+
+
+class OriginalLBP(LocalBinaryOperator):
+    name = "original_lbp"
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return original_lbp(x)
+
+    @property
+    def num_bins(self) -> int:
+        return 256
+
+
+class ExtendedLBP(LocalBinaryOperator):
+    name = "extended_lbp"
+
+    def __init__(self, radius: int = 1, neighbors: int = 8):
+        self.radius = int(radius)
+        self.neighbors = int(neighbors)
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        return extended_lbp(x, self.radius, self.neighbors)
+
+    @property
+    def num_bins(self) -> int:
+        return 1 << self.neighbors
+
+    def get_config(self) -> dict:
+        return {"radius": self.radius, "neighbors": self.neighbors}
+
+
+class VarLBP(LocalBinaryOperator):
+    """Variance operator; quantized into ``num_bins`` buckets by the
+    SpatialHistogram feature (continuous output, so bins are set here)."""
+
+    name = "var_lbp"
+
+    def __init__(self, radius: int = 1, neighbors: int = 8, bins: int = 64, max_var: float = 8192.0):
+        self.radius = int(radius)
+        self.neighbors = int(neighbors)
+        self.bins = int(bins)
+        self.max_var = float(max_var)
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        v = var_lbp(x, self.radius, self.neighbors)
+        idx = jnp.clip(v / self.max_var, 0.0, 1.0 - 1e-7) * self.bins
+        return idx.astype(jnp.int32)
+
+    @property
+    def num_bins(self) -> int:
+        return self.bins
+
+    def get_config(self) -> dict:
+        return {
+            "radius": self.radius,
+            "neighbors": self.neighbors,
+            "bins": self.bins,
+            "max_var": self.max_var,
+        }
+
+
+LBP_OPERATORS = {cls.name: cls for cls in (OriginalLBP, ExtendedLBP, VarLBP)}

@@ -1,0 +1,132 @@
+"""Eigen-solvers for the subspace features: PCA (Eigenfaces), LDA, Fisherfaces.
+
+TPU replacement for the reference's imported LAPACK surface (SURVEY.md §2.2:
+``numpy.linalg.eigh/svd`` used by ``facerec/feature.py`` PCA/LDA fits). All
+fits run on device via ``jnp.linalg.eigh``; the classic small-matrix
+(Gram) trick keeps the eigenproblem at [N, N] when D >> N, which is the
+Eigenfaces regime (70*70 = 4900 pixels, N a few hundred images).
+
+Numerical note (SURVEY.md §7 "hard parts"): fits default to float32 on
+device. Tests compare subspace projections (not raw eigenvector signs)
+against NumPy/sklearn oracles with f32 tolerances.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+# Subspace math runs at full f32 precision: these are small matmuls where
+# eigh conditioning and projection accuracy dominate, and the default
+# (backend-chosen) precision was observed to drift ~1e-3 between separate
+# compilations of the same projection.
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _mm(a, b):
+    return jnp.matmul(a, b, precision=_HI)
+
+
+class PCAState(NamedTuple):
+    mean: jnp.ndarray  # [D]
+    components: jnp.ndarray  # [D, K] column eigenvectors, descending eigenvalue
+    eigenvalues: jnp.ndarray  # [K]
+
+
+class LDAState(NamedTuple):
+    components: jnp.ndarray  # [D, K]
+    eigenvalues: jnp.ndarray  # [K]
+
+
+def pca_fit(x: jnp.ndarray, num_components: int) -> PCAState:
+    """Fit PCA on row-matrix ``x`` [N, D], keep top ``num_components``.
+
+    Uses eigh of the [N, N] Gram matrix when D > N (the Eigenfaces
+    small-matrix trick, SURVEY.md §3.1), else eigh of the [D, D] covariance.
+    ``num_components`` must be a static positive int (<= min(N, D)).
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    n, d = x.shape
+    k = int(num_components)
+    if k <= 0 or k > min(n, d):
+        raise ValueError(f"num_components={k} must be in [1, min(N={n}, D={d})]")
+    mean = jnp.mean(x, axis=0)
+    xc = x - mean
+    if d > n:
+        gram = _mm(xc, xc.T)  # [N, N]
+        evals, evecs = jnp.linalg.eigh(gram)
+        # eigh returns ascending order; take top-k from the end.
+        evals = evals[::-1][:k]
+        evecs = evecs[:, ::-1][:, :k]
+        comps = _mm(xc.T, evecs)  # [D, k], unnormalized
+        comps = comps / jnp.maximum(jnp.linalg.norm(comps, axis=0, keepdims=True), 1e-12)
+    else:
+        cov = _mm(xc.T, xc)  # [D, D]
+        evals, evecs = jnp.linalg.eigh(cov)
+        evals = evals[::-1][:k]
+        comps = evecs[:, ::-1][:, :k]
+    # Eigenvalues of the scatter matrix Xc^T Xc (Gram and covariance paths agree).
+    return PCAState(mean=mean, components=comps, eigenvalues=jnp.maximum(evals, 0.0))
+
+
+def pca_project(state: PCAState, x: jnp.ndarray) -> jnp.ndarray:
+    """[..., D] -> [..., K]: W^T (x - mean); one MXU matmul for batches."""
+    return _mm(jnp.asarray(x, jnp.float32) - state.mean, state.components)
+
+
+def pca_reconstruct(state: PCAState, z: jnp.ndarray) -> jnp.ndarray:
+    """[..., K] -> [..., D] back-projection (for eigenface visualization)."""
+    return _mm(z, state.components.T) + state.mean
+
+
+def lda_fit(
+    x: jnp.ndarray, y: jnp.ndarray, num_classes: int, num_components: int, reg: float = 1e-4
+) -> LDAState:
+    """Fisher LDA on row-matrix ``x`` [N, D] with int labels ``y`` [N].
+
+    Solves the generalized eigenproblem Sb v = λ Sw v via Cholesky whitening
+    of the (regularized) within-class scatter — eigh-only, so it stays on
+    device and differentiable. ``num_classes`` and ``num_components`` are
+    static; labels must be in [0, num_classes).
+
+    Class means are computed with a one-hot matmul (no segment_sum /
+    dynamic shapes), so the whole fit is three matmuls + one eigh.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    y = jnp.asarray(y, dtype=jnp.int32)
+    n, d = x.shape
+    c = int(num_classes)
+    k = int(num_components)
+    if k <= 0 or k > c - 1:
+        raise ValueError(f"num_components={k} must be in [1, num_classes-1={c - 1}]")
+    onehot = (y[:, None] == jnp.arange(c)[None, :]).astype(jnp.float32)  # [N, C]
+    counts = jnp.sum(onehot, axis=0)  # [C]
+    safe_counts = jnp.maximum(counts, 1.0)
+    class_means = (onehot.T @ x) / safe_counts[:, None]  # [C, D]
+    total_mean = jnp.mean(x, axis=0)
+    # Within-class scatter: sum over samples of (x - mean_class)(x - mean_class)^T.
+    centered = x - onehot @ class_means  # [N, D]
+    sw = _mm(centered.T, centered)
+    # Between-class scatter: sum_c n_c (mu_c - mu)(mu_c - mu)^T.
+    md = class_means - total_mean
+    sb = _mm((md * counts[:, None]).T, md)
+    # Regularize Sw for Cholesky (f32 + singular scatter in the PCA'd space).
+    sw = sw + reg * jnp.trace(sw) / d * jnp.eye(d, dtype=jnp.float32)
+    chol = jnp.linalg.cholesky(sw)
+    # M = L^-1 Sb L^-T is symmetric PSD; eigh it, map back by L^-T.
+    linv_sb = jnp.linalg.solve(chol, sb)
+    m = jnp.linalg.solve(chol, linv_sb.T).T
+    m = 0.5 * (m + m.T)
+    evals, evecs = jnp.linalg.eigh(m)
+    evals = evals[::-1][:k]
+    evecs = evecs[:, ::-1][:, :k]
+    # Back-substitute: v = L^-T u  <=>  L^T v = u.
+    comps = jnp.linalg.solve(chol.T, evecs)
+    comps = comps / jnp.maximum(jnp.linalg.norm(comps, axis=0, keepdims=True), 1e-12)
+    return LDAState(components=comps, eigenvalues=jnp.maximum(evals, 0.0))
+
+
+def lda_project(state: LDAState, x: jnp.ndarray) -> jnp.ndarray:
+    return _mm(jnp.asarray(x, jnp.float32), state.components)

@@ -1,0 +1,6 @@
+"""Cross-cutting utilities (SURVEY.md §1 L5): datasets, validation,
+serialization, metrics."""
+
+from opencv_facerecognizer_tpu.utils import dataset, serialization, validation
+
+__all__ = ["dataset", "serialization", "validation"]

@@ -1,0 +1,122 @@
+"""Dataset utilities (SURVEY.md §2.1 "Matrix/dataset utils").
+
+``read_images`` walks the reference-family dataset layout (one folder per
+subject containing face images) and returns (images [N, H, W] float32,
+labels [N] int, subject_names). Decoding uses cv2 when present, else PIL —
+both are host-side I/O; everything downstream is device arrays.
+
+``make_synthetic_faces`` generates a deterministic ORL-like dataset (distinct
+per-subject structure + per-sample noise/illumination) so the validation
+harness and tests run without network access to the real AT&T/LFW data
+(the environment has zero egress — SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _imread_gray(path: str) -> Optional[np.ndarray]:
+    try:
+        import cv2
+
+        img = cv2.imread(path, cv2.IMREAD_GRAYSCALE)
+        return None if img is None else img.astype(np.float32)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            return np.asarray(im.convert("L"), dtype=np.float32)
+    except Exception:
+        return None
+
+
+def read_images(
+    path: str, image_size: Optional[Tuple[int, int]] = None
+) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Walk ``path/<subject>/<image files>`` -> (images, labels, names).
+
+    Subjects are sorted for determinism; unreadable files are skipped with a
+    warning count rather than aborting enrolment (SURVEY.md §5.3 graceful
+    skip of malformed inputs).
+    """
+    images, labels, names = [], [], []
+    subjects = sorted(
+        d for d in os.listdir(path) if os.path.isdir(os.path.join(path, d))
+    )
+    for subject in subjects:
+        subject_dir = os.path.join(path, subject)
+        files = sorted(os.listdir(subject_dir))
+        # Label assigned from the names list so a subject dir with zero
+        # readable images cannot shift later subjects onto wrong names.
+        label = len(names)
+        count = 0
+        for fn in files:
+            img = _imread_gray(os.path.join(subject_dir, fn))
+            if img is None:
+                continue
+            if image_size is not None:
+                import cv2
+
+                img = cv2.resize(img, (image_size[1], image_size[0])).astype(np.float32)
+            images.append(img)
+            labels.append(label)
+            count += 1
+        if count:
+            names.append(subject)
+    if not images:
+        raise ValueError(f"no readable images under {path!r}")
+    return np.stack(images), np.asarray(labels, dtype=np.int32), names
+
+
+def shuffle(X: np.ndarray, y: np.ndarray, seed: int = 0):
+    """Deterministic joint shuffle (the reference's shuffle util)."""
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(y))
+    if isinstance(X, list):
+        return [X[i] for i in perm], np.asarray(y)[perm]
+    return np.asarray(X)[perm], np.asarray(y)[perm]
+
+
+def make_synthetic_faces(
+    num_subjects: int = 10,
+    per_subject: int = 10,
+    size: Tuple[int, int] = (32, 32),
+    seed: int = 0,
+    noise: float = 12.0,
+    illumination: float = 0.35,
+) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Deterministic face-like dataset: per-subject smooth base pattern +
+    per-sample noise, global illumination scaling, and small translations —
+    the variation axes the classic pipeline (TanTriggs/PCA/LDA/LBP) exists
+    to handle. Returns (images [N,H,W] in [0,255], labels, names)."""
+    rng = np.random.default_rng(seed)
+    h, w = size
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    images, labels = [], []
+    for s in range(num_subjects):
+        # Smooth "identity" structure: sum of a few random low-freq gaussians.
+        base = np.zeros((h, w), dtype=np.float32)
+        for _ in range(6):
+            cy, cx = rng.uniform(0, h), rng.uniform(0, w)
+            sy, sx = rng.uniform(h / 8, h / 3), rng.uniform(w / 8, w / 3)
+            amp = rng.uniform(-1.0, 1.0)
+            base += amp * np.exp(-(((yy - cy) / sy) ** 2 + ((xx - cx) / sx) ** 2))
+        base = 128.0 + 90.0 * base / (np.abs(base).max() + 1e-6)
+        for _ in range(per_subject):
+            img = base.copy()
+            # small translation (integer, wraps cropped)
+            ty, tx = rng.integers(-2, 3, size=2)
+            img = np.roll(img, (ty, tx), axis=(0, 1))
+            # illumination scale + offset
+            img = img * rng.uniform(1 - illumination, 1 + illumination) + rng.uniform(-20, 20)
+            img = img + rng.normal(scale=noise, size=(h, w))
+            images.append(np.clip(img, 0, 255).astype(np.float32))
+            labels.append(s)
+    names = [f"subject_{i:02d}" for i in range(num_subjects)]
+    return np.stack(images), np.asarray(labels, dtype=np.int32), names

@@ -1,0 +1,106 @@
+"""Pickle-free model checkpointing (SURVEY.md §5.4).
+
+The reference checkpointed by pickling the whole ``PredictableModel``
+(``facerec/serialization.py`` save_model/load_model — SURVEY.md §2.1). That
+is unsafe (arbitrary code execution on load) and version-brittle. Rebuild:
+
+- a *spec* — a JSON-safe nested dict ``{"type": registry-name, "config":
+  {...}}`` describing how to reconstruct every plugin, and
+- a *state* — a nested dict of arrays (the fit results / enrolled gallery),
+  serialized with flax's msgpack (no code, just tensors + structure).
+
+``save_model`` writes one msgpack file with header/spec/state;
+``load_model`` rebuilds the plugin tree from the registry and restores
+arrays. Anything implementing get_config/from_config/get_state/set_state
+participates — including operators, which recursively serialize children.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+import numpy as np
+from flax import serialization as flax_serialization
+
+FORMAT_VERSION = 1
+
+#: registry-name -> class, populated lazily to avoid import cycles.
+_REGISTRY: Dict[str, type] = {}
+
+
+def _registry() -> Dict[str, type]:
+    if not _REGISTRY:
+        from opencv_facerecognizer_tpu.models import classifier as c
+        from opencv_facerecognizer_tpu.models import feature as f
+        from opencv_facerecognizer_tpu.models import model as m
+        from opencv_facerecognizer_tpu.models import operators as o
+
+        for cls in (
+            f.Identity,
+            f.PCA,
+            f.LDA,
+            f.Fisherfaces,
+            f.SpatialHistogram,
+            f.TanTriggsPreprocessing,
+            f.HistogramEqualization,
+            f.Resize,
+            f.MinMaxNormalize,
+            o.ChainOperator,
+            o.CombineOperator,
+            c.NearestNeighbor,
+            c.SVM,
+            m.PredictableModel,
+            m.ExtendedPredictableModel,
+        ):
+            _REGISTRY[cls.name] = cls
+    return _REGISTRY
+
+
+def register(cls: type) -> type:
+    """Register an external plugin class (usable as a decorator)."""
+    _registry()[cls.name] = cls
+    return cls
+
+
+def serialize_spec(obj: Any) -> dict:
+    """Object -> JSON-safe reconstruction spec {"type", "config"}."""
+    return {"type": obj.name, "config": obj.get_config()}
+
+
+def deserialize_spec(spec: dict) -> Any:
+    reg = _registry()
+    if spec["type"] not in reg:
+        raise KeyError(
+            f"unknown plugin type {spec['type']!r}; registered: {sorted(reg)}"
+        )
+    return reg[spec["type"]].from_config(spec["config"])
+
+
+def _to_numpy_tree(state: Any) -> Any:
+    if isinstance(state, dict):
+        return {k: _to_numpy_tree(v) for k, v in state.items()}
+    return np.asarray(state)
+
+
+def save_model(filename: str, model: Any) -> None:
+    """Write {header, spec, state} as one msgpack blob. No pickle anywhere."""
+    payload = {
+        "header": {"format_version": FORMAT_VERSION, "spec_json": json.dumps(serialize_spec(model))},
+        "state": _to_numpy_tree(model.get_state()),
+    }
+    blob = flax_serialization.msgpack_serialize(payload)
+    with open(filename, "wb") as fh:
+        fh.write(blob)
+
+
+def load_model(filename: str) -> Any:
+    with open(filename, "rb") as fh:
+        payload = flax_serialization.msgpack_restore(fh.read())
+    header = payload["header"]
+    version = int(header["format_version"])
+    if version > FORMAT_VERSION:
+        raise ValueError(f"checkpoint format v{version} is newer than supported v{FORMAT_VERSION}")
+    model = deserialize_spec(json.loads(header["spec_json"]))
+    model.set_state(payload.get("state", {}))
+    return model

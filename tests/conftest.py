@@ -1,0 +1,18 @@
+"""Test harness config (SURVEY.md §4 prescription).
+
+Tests run on the CPU backend with 8 virtual devices so N-way sharding is
+exercised without a TPU pod; the real-chip paths are covered by bench.py and
+__graft_entry__.py which the driver runs on hardware. Env vars must be set
+before jax initializes its backend, hence this conftest does it at import
+time (pytest imports conftest before any test module).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,83 @@
+"""Classifier boundary tests: k-NN vs sklearn oracle, SVM separability."""
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.models import NearestNeighbor, SVM
+from opencv_facerecognizer_tpu.ops import distance as D
+
+RNG = np.random.default_rng(11)
+
+
+def _blobs(num_classes=4, per_class=15, d=8, sep=5.0, seed=11):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=sep, size=(num_classes, d)).astype(np.float32)
+    x = np.concatenate([c + rng.normal(size=(per_class, d)).astype(np.float32) for c in centers])
+    y = np.repeat(np.arange(num_classes), per_class)
+    return x, y
+
+
+def test_knn_matches_sklearn_k1():
+    from sklearn.neighbors import KNeighborsClassifier
+
+    x, y = _blobs()
+    q = x + RNG.normal(scale=0.3, size=x.shape).astype(np.float32)
+    clf = NearestNeighbor(D.EuclideanDistance(), k=1)
+    clf.compute(x, y)
+    pred, info = clf.predict(q)
+    sk = KNeighborsClassifier(n_neighbors=1).fit(x, y)
+    np.testing.assert_array_equal(np.asarray(pred), sk.predict(q))
+    assert info["distances"].shape == (len(q), 1)
+
+
+def test_knn_matches_sklearn_k5_majority():
+    from sklearn.neighbors import KNeighborsClassifier
+
+    x, y = _blobs(sep=3.0)
+    q = RNG.normal(scale=4.0, size=(40, 8)).astype(np.float32)
+    clf = NearestNeighbor(D.EuclideanDistance(), k=5)
+    clf.compute(x, y)
+    pred, _ = clf.predict(q)
+    sk = KNeighborsClassifier(n_neighbors=5).fit(x, y)
+    agree = (np.asarray(pred) == sk.predict(q)).mean()
+    # sklearn breaks vote ties differently; require near-total agreement
+    assert agree > 0.9
+
+
+def test_knn_single_query_reference_contract():
+    x, y = _blobs()
+    clf = NearestNeighbor(k=3)
+    clf.compute(x, y)
+    out = clf.predict(x[0])
+    assert isinstance(out, list) and len(out) == 2
+    label, info = out
+    assert int(label) == int(y[0])
+    assert info["labels"].shape == (3,)
+    assert info["distances"][0] <= info["distances"][1]
+
+
+def test_knn_preserves_original_label_values():
+    x, y = _blobs(num_classes=3)
+    y_shifted = (y * 7 + 100).astype(np.int64)  # non-contiguous labels
+    clf = NearestNeighbor(k=1)
+    clf.compute(x, y_shifted)
+    pred, _ = clf.predict(x[:10])
+    np.testing.assert_array_equal(np.asarray(pred), y_shifted[:10])
+
+
+def test_knn_cosine_metric():
+    x, y = _blobs()
+    clf = NearestNeighbor(D.CosineDistance(), k=1)
+    clf.compute(x, y)
+    pred, _ = clf.predict(x)
+    assert (np.asarray(pred) == y).mean() == 1.0
+
+
+def test_svm_separable_blobs():
+    x, y = _blobs(sep=6.0)
+    clf = SVM(epochs=200)
+    clf.compute(x, y)
+    pred, info = clf.predict(x)
+    assert (np.asarray(pred) == y).mean() > 0.97
+    assert info["logits"].shape == (len(y), 4)
+    single = clf.predict(x[0])
+    assert int(single[0]) == int(y[0])

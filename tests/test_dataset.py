@@ -1,0 +1,67 @@
+"""Dataset reading: folder-per-subject layout, label/name alignment."""
+
+import os
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models import NearestNeighbor
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces, read_images, shuffle
+
+
+def _write_png(path, img):
+    import cv2
+
+    cv2.imwrite(path, img.astype(np.uint8))
+
+
+def test_read_images_folder_per_subject(tmp_path):
+    X, _, _ = make_synthetic_faces(3, 2, (20, 20), seed=1)
+    for i, name in enumerate(["alice", "bob", "carol"]):
+        os.makedirs(tmp_path / name)
+        for j in range(2):
+            _write_png(str(tmp_path / name / f"{j}.png"), X[i * 2 + j])
+    imgs, labels, names = read_images(str(tmp_path), image_size=(16, 16))
+    assert imgs.shape == (6, 16, 16)
+    assert names == ["alice", "bob", "carol"]
+    np.testing.assert_array_equal(labels, [0, 0, 1, 1, 2, 2])
+
+
+def test_read_images_skips_unreadable_subject_keeps_alignment(tmp_path):
+    # regression: a subject dir with no readable images must not shift
+    # later subjects onto wrong labels/names
+    X, _, _ = make_synthetic_faces(2, 2, (20, 20), seed=2)
+    os.makedirs(tmp_path / "alice")
+    _write_png(str(tmp_path / "alice" / "0.png"), X[0])
+    os.makedirs(tmp_path / "bob")
+    (tmp_path / "bob" / "junk.png").write_bytes(b"not an image")
+    os.makedirs(tmp_path / "carol")
+    _write_png(str(tmp_path / "carol" / "0.png"), X[2])
+    imgs, labels, names = read_images(str(tmp_path))
+    assert names == ["alice", "carol"]
+    np.testing.assert_array_equal(labels, [0, 1])
+    assert labels.max() == len(names) - 1
+
+
+def test_read_images_empty_dir_raises(tmp_path):
+    with pytest.raises(ValueError):
+        read_images(str(tmp_path))
+
+
+def test_shuffle_is_joint_and_deterministic():
+    X, y, _ = make_synthetic_faces(3, 3, (8, 8), seed=0)
+    X1, y1 = shuffle(X, y, seed=5)
+    X2, y2 = shuffle(X, y, seed=5)
+    np.testing.assert_array_equal(y1, y2)
+    np.testing.assert_allclose(X1, X2)
+    # pairs stay aligned: each shuffled image equals the original at its label position
+    for i in range(len(y1)):
+        orig_idx = np.flatnonzero([np.allclose(X[j], X1[i]) for j in range(len(y))])[0]
+        assert y[orig_idx] == y1[i]
+
+
+def test_string_labels_rejected_with_clear_error():
+    X, y, _ = make_synthetic_faces(2, 2, (8, 8), seed=0)
+    clf = NearestNeighbor()
+    with pytest.raises(TypeError, match="subject_names"):
+        clf.compute(X.reshape(4, -1), np.array(["a", "a", "b", "b"]))

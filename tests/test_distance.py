@@ -1,0 +1,58 @@
+"""Oracle tests for ops.distance vs pure NumPy (SURVEY.md §4 prescription)."""
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.ops import distance as D
+
+RNG = np.random.default_rng(0)
+P = RNG.uniform(0.1, 1.0, size=(5, 32)).astype(np.float32)
+Q = RNG.uniform(0.1, 1.0, size=(7, 32)).astype(np.float32)
+
+
+def _numpy_pairwise(fn):
+    return np.array([[fn(p, q) for q in Q] for p in P], dtype=np.float32)
+
+
+ORACLES = {
+    "euclidean": lambda p, q: np.linalg.norm(p - q),
+    "squared_euclidean": lambda p, q: np.sum((p - q) ** 2),
+    "cosine": lambda p, q: -np.dot(p, q) / (np.linalg.norm(p) * np.linalg.norm(q)),
+    "normalized_correlation": lambda p, q: 1.0 - np.corrcoef(p, q)[0, 1],
+    "chi_square": lambda p, q: np.sum((p - q) ** 2 / (p + q)),
+    "histogram_intersection": lambda p, q: -np.sum(np.minimum(p, q)),
+    "bin_ratio": lambda p, q: np.sum((p - q) ** 2 / (p + q) ** 2),
+    "l1_bin_ratio": lambda p, q: np.sum(np.abs(p - q) * (p - q) ** 2 / (p + q) ** 2),
+    "chi_square_brd": lambda p, q: np.sum(((p - q) ** 2 / (p + q)) * ((p - q) ** 2 / (p + q) ** 2)),
+    "manhattan": lambda p, q: np.sum(np.abs(p - q)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(D.DISTANCES))
+def test_pairwise_matches_numpy_oracle(name):
+    dist = D.DISTANCES[name]()
+    got = np.asarray(dist(P, Q))
+    want = _numpy_pairwise(ORACLES[name])
+    assert got.shape == (5, 7)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_scalar_contract_on_vector_pair():
+    dist = D.EuclideanDistance()
+    got = dist(P[0], Q[0])
+    assert np.ndim(got) == 0
+    np.testing.assert_allclose(float(got), np.linalg.norm(P[0] - Q[0]), rtol=1e-5)
+
+
+def test_self_distance_is_minimal():
+    for name, cls in D.DISTANCES.items():
+        d = np.asarray(cls()(P, P))
+        # diagonal should be the row minimum (self is most similar)
+        assert np.all(np.diag(d) <= d.min(axis=1) + 1e-4), name
+
+
+def test_images_are_flattened():
+    imgs_p = P.reshape(5, 4, 8)
+    got = np.asarray(D.EuclideanDistance()(imgs_p, Q))
+    want = np.asarray(D.EuclideanDistance()(P, Q))
+    np.testing.assert_allclose(got, want, rtol=1e-6)

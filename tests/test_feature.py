@@ -1,0 +1,114 @@
+"""Feature plugin boundary tests (SURVEY.md §4, §7.2)."""
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models import (
+    ChainOperator,
+    CombineOperator,
+    Fisherfaces,
+    HistogramEqualization,
+    Identity,
+    LDA,
+    MinMaxNormalize,
+    PCA,
+    Resize,
+    SpatialHistogram,
+    TanTriggsPreprocessing,
+)
+from opencv_facerecognizer_tpu.ops import lbp as lbp_ops
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+X, Y, NAMES = make_synthetic_faces(num_subjects=6, per_subject=6, size=(24, 24), seed=7)
+
+
+def test_identity_flattens():
+    feat = Identity()
+    out = np.asarray(feat.compute(X, Y))
+    assert out.shape == (36, 24 * 24)
+    one = np.asarray(feat.extract(X[0]))
+    np.testing.assert_allclose(one, X[0].ravel(), rtol=1e-6)
+
+
+def test_pca_compute_extract_consistency():
+    feat = PCA(num_components=10)
+    proj = np.asarray(feat.compute(X, Y))
+    assert proj.shape == (36, 10)
+    again = np.asarray(feat.extract(X))
+    np.testing.assert_allclose(proj, again, atol=1e-3)
+    single = np.asarray(feat.extract(X[3]))
+    np.testing.assert_allclose(single, proj[3], atol=1e-3)
+
+
+def test_pca_extract_before_compute_raises():
+    with pytest.raises(RuntimeError):
+        PCA(5).extract(X[0])
+
+
+def test_lda_projects_to_c_minus_1():
+    feat = LDA()
+    proj = np.asarray(feat.compute(X, Y))
+    assert proj.shape == (36, 5)
+
+
+def test_fisherfaces_class_separation():
+    feat = Fisherfaces()
+    proj = np.asarray(feat.compute(X, Y))
+    assert proj.shape == (36, 5)
+    # class centroids should be far apart relative to within-class spread
+    means = np.stack([proj[Y == c].mean(0) for c in range(6)])
+    within = np.mean([np.linalg.norm(proj[Y == c] - means[c], axis=1).mean() for c in range(6)])
+    between = np.linalg.norm(means[:, None] - means[None], axis=-1)
+    between = between[~np.eye(6, dtype=bool)].mean()
+    assert between > 2.0 * within
+
+
+def test_spatial_histogram_shapes_and_lbph_defaults():
+    feat = SpatialHistogram(sz=(4, 4))
+    out = np.asarray(feat.compute(X, Y))
+    assert out.shape == (36, 4 * 4 * 256)
+    single = np.asarray(feat.extract(X[0]))
+    np.testing.assert_allclose(single, out[0], atol=1e-6)
+
+
+def test_spatial_histogram_with_var_lbp():
+    feat = SpatialHistogram(lbp_operator=lbp_ops.VarLBP(bins=32), sz=(2, 2))
+    out = np.asarray(feat.compute(X, Y))
+    assert out.shape == (36, 2 * 2 * 32)
+
+
+def test_chain_operator_preprocess_then_subspace():
+    chain = ChainOperator(TanTriggsPreprocessing(), Fisherfaces())
+    proj = np.asarray(chain.compute(X, Y))
+    assert proj.shape == (36, 5)
+    single = np.asarray(chain.extract(X[5]))
+    np.testing.assert_allclose(single, proj[5], atol=1e-2)
+
+
+def test_chain_operator_resize_first():
+    chain = ChainOperator(Resize((16, 16)), PCA(8))
+    proj = np.asarray(chain.compute(X, Y))
+    assert proj.shape == (36, 8)
+
+
+def test_combine_operator_concatenates():
+    comb = CombineOperator(PCA(4), SpatialHistogram(sz=(2, 2)))
+    out = np.asarray(comb.compute(X, Y))
+    assert out.shape == (36, 4 + 2 * 2 * 256)
+    single = np.asarray(comb.extract(X[1]))
+    np.testing.assert_allclose(single, out[1], atol=1e-3)
+
+
+def test_chain_pca_lda_single_sample():
+    # regression: 1-D intermediate features must not be misread as batches
+    chain = ChainOperator(PCA(8), LDA())
+    proj = np.asarray(chain.compute(X, Y))
+    single = np.asarray(chain.extract(X[2]))
+    assert single.shape == proj[2].shape
+    np.testing.assert_allclose(single, proj[2], atol=1e-3)
+
+
+def test_preprocessing_plugins_keep_image_shape():
+    for feat in (TanTriggsPreprocessing(), HistogramEqualization(), MinMaxNormalize()):
+        out = np.asarray(feat.compute(X, Y))
+        assert out.shape == X.shape, type(feat).__name__

@@ -1,0 +1,49 @@
+"""Spatial histogram vs per-cell np.histogram oracle (SURVEY.md §4)."""
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.ops import histogram as H
+
+RNG = np.random.default_rng(4)
+
+
+def numpy_spatial_histogram(codes, grid, num_bins, normalize):
+    gy, gx = grid
+    h, w = codes.shape
+    ch, cw = h // gy, w // gx
+    y0, x0 = (h - gy * ch) // 2, (w - gx * cw) // 2
+    codes = codes[y0 : y0 + gy * ch, x0 : x0 + gx * cw]
+    out = []
+    for iy in range(gy):
+        for ix in range(gx):
+            cell = codes[iy * ch : (iy + 1) * ch, ix * cw : (ix + 1) * cw]
+            hist, _ = np.histogram(cell, bins=num_bins, range=(0, num_bins))
+            hist = hist.astype(np.float64)
+            if normalize:
+                hist /= max(hist.sum(), 1e-12)
+            out.append(hist)
+    return np.concatenate(out)
+
+
+def test_matches_numpy_oracle_with_remainder_crop():
+    codes = RNG.integers(0, 16, size=(13, 11)).astype(np.int32)
+    got = np.asarray(H.spatial_histogram(codes, grid=(3, 2), num_bins=16, normalize=False))
+    want = numpy_spatial_histogram(codes, (3, 2), 16, False)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_normalized_cells_sum_to_one():
+    codes = RNG.integers(0, 256, size=(2, 32, 32)).astype(np.int32)
+    got = np.asarray(H.spatial_histogram(codes, grid=(4, 4), num_bins=256))
+    assert got.shape == (2, 4 * 4 * 256)
+    sums = got.reshape(2, 16, 256).sum(-1)
+    np.testing.assert_allclose(sums, 1.0, atol=1e-5)
+
+
+def test_batched_equals_per_image():
+    codes = RNG.integers(0, 8, size=(3, 16, 16)).astype(np.int32)
+    batched = np.asarray(H.spatial_histogram(codes, grid=(2, 2), num_bins=8))
+    singles = np.stack(
+        [np.asarray(H.spatial_histogram(c, grid=(2, 2), num_bins=8)) for c in codes]
+    )
+    np.testing.assert_allclose(batched, singles, atol=1e-6)

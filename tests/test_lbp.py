@@ -1,0 +1,85 @@
+"""LBP operators vs a pure-NumPy reference implementation (SURVEY.md §4)."""
+
+import math
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.ops import lbp
+
+RNG = np.random.default_rng(1)
+IMG = RNG.integers(0, 256, size=(12, 14)).astype(np.float32)
+
+
+def numpy_original_lbp(x):
+    h, w = x.shape
+    out = np.zeros((h - 2, w - 2), dtype=np.int32)
+    offs = [(-1, -1), (-1, 0), (-1, 1), (0, 1), (1, 1), (1, 0), (1, -1), (0, -1)]
+    for yy in range(1, h - 1):
+        for xx in range(1, w - 1):
+            c = x[yy, xx]
+            code = 0
+            for i, (dy, dx) in enumerate(offs):
+                if x[yy + dy, xx + dx] >= c:
+                    code |= 1 << (7 - i)
+            out[yy - 1, xx - 1] = code
+    return out
+
+
+def numpy_circular_samples(x, radius, neighbors):
+    h, w = x.shape
+    samples = np.zeros((neighbors, h - 2 * radius, w - 2 * radius), dtype=np.float64)
+    for k in range(neighbors):
+        theta = 2.0 * math.pi * k / neighbors
+        dy, dx = -radius * math.sin(theta), radius * math.cos(theta)
+        fy, fx = math.floor(dy), math.floor(dx)
+        ty, tx = dy - fy, dx - fx
+        taps = [((1 - ty) * (1 - tx), 0, 0), ((1 - ty) * tx, 0, 1),
+                (ty * (1 - tx), 1, 0), (ty * tx, 1, 1)]
+        for yy in range(radius, h - radius):
+            for xx in range(radius, w - radius):
+                y0, x0 = yy + fy, xx + fx
+                v = sum(wt * x[y0 + oy, x0 + ox] for wt, oy, ox in taps if wt > 1e-12)
+                samples[k, yy - radius, xx - radius] = v
+    return samples
+
+
+def test_original_lbp_matches_reference():
+    got = np.asarray(lbp.original_lbp(IMG))
+    np.testing.assert_array_equal(got, numpy_original_lbp(IMG))
+
+
+def test_original_lbp_batched():
+    batch = np.stack([IMG, IMG[::-1].copy()])
+    got = np.asarray(lbp.original_lbp(batch))
+    assert got.shape == (2, 10, 12)
+    np.testing.assert_array_equal(got[0], numpy_original_lbp(IMG))
+    np.testing.assert_array_equal(got[1], numpy_original_lbp(IMG[::-1]))
+
+
+def test_extended_lbp_matches_reference():
+    for radius, neighbors in [(1, 8), (2, 8), (2, 12)]:
+        got = np.asarray(lbp.extended_lbp(IMG, radius, neighbors))
+        samples = numpy_circular_samples(IMG.astype(np.float64), radius, neighbors)
+        c = IMG[radius:-radius, radius:-radius]
+        want = np.zeros_like(c, dtype=np.int64)
+        for k in range(neighbors):
+            want += (1 << k) * (samples[k] >= c - 1e-5)
+        # Tolerate the rare off-by-one-bit where a bilinear sample sits
+        # exactly on the center value (f32 vs f64 rounding).
+        mismatch = np.mean(got != want)
+        assert mismatch < 0.02, f"r={radius} P={neighbors}: {mismatch:.3f} codes differ"
+
+
+def test_extended_lbp_shapes_and_range():
+    out = np.asarray(lbp.extended_lbp(IMG, radius=2, neighbors=10))
+    assert out.shape == (8, 10)
+    assert out.min() >= 0 and out.max() < 1 << 10
+
+
+def test_var_lbp_is_nonnegative_and_shaped():
+    out = np.asarray(lbp.var_lbp(IMG, radius=1, neighbors=8))
+    assert out.shape == (10, 12)
+    assert np.all(out >= 0)
+    # constant image has zero local variance
+    const = np.full((8, 8), 7.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(lbp.var_lbp(const)), 0.0, atol=1e-6)

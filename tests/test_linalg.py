@@ -1,0 +1,94 @@
+"""PCA/LDA eigen-solver oracle tests vs sklearn/NumPy (SURVEY.md §4, §7.2)."""
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.ops import linalg as L
+
+RNG = np.random.default_rng(3)
+
+
+def _random_lowrank(n=40, d=300, rank=10):
+    a = RNG.normal(size=(n, rank)).astype(np.float32)
+    b = RNG.normal(size=(rank, d)).astype(np.float32)
+    return a @ b + 0.01 * RNG.normal(size=(n, d)).astype(np.float32)
+
+
+def _subspace_angle(a, b):
+    """Largest principal angle between column spaces (0 == identical)."""
+    qa, _ = np.linalg.qr(a)
+    qb, _ = np.linalg.qr(b)
+    s = np.linalg.svd(qa.T @ qb, compute_uv=False)
+    return float(np.arccos(np.clip(s.min(), -1, 1)))
+
+
+@pytest.mark.parametrize("n,d", [(40, 300), (60, 20)])  # gram trick + direct path
+def test_pca_matches_sklearn_subspace(n, d):
+    from sklearn.decomposition import PCA as SkPCA
+
+    x = _random_lowrank(n=n, d=d, rank=8)
+    k = 6
+    st = L.pca_fit(x, k)
+    sk = SkPCA(n_components=k, svd_solver="full").fit(x.astype(np.float64))
+    assert np.asarray(st.components).shape == (d, k)
+    # Same subspace (eigenvector signs/rotations may differ within ties).
+    assert _subspace_angle(np.asarray(st.components), sk.components_.T) < 0.05
+    # Eigenvalues of scatter = explained_variance * (n - 1).
+    np.testing.assert_allclose(
+        np.asarray(st.eigenvalues), sk.explained_variance_ * (n - 1), rtol=0.02
+    )
+
+
+def test_pca_project_reconstruct_roundtrip():
+    x = _random_lowrank(n=30, d=100, rank=5)
+    st = L.pca_fit(x, 5)
+    z = L.pca_project(st, x)
+    xr = np.asarray(L.pca_reconstruct(st, z))
+    # rank-5 data + 5 components => near-perfect reconstruction
+    rel = np.linalg.norm(xr - x) / np.linalg.norm(x)
+    assert rel < 0.05
+
+
+def test_pca_validates_num_components():
+    x = _random_lowrank(n=10, d=20)
+    with pytest.raises(ValueError):
+        L.pca_fit(x, 0)
+    with pytest.raises(ValueError):
+        L.pca_fit(x, 11)
+
+
+def _class_blobs(num_classes=5, per_class=12, d=30, sep=4.0):
+    centers = RNG.normal(scale=sep, size=(num_classes, d)).astype(np.float32)
+    x = np.concatenate(
+        [c + RNG.normal(size=(per_class, d)).astype(np.float32) for c in centers]
+    )
+    y = np.repeat(np.arange(num_classes), per_class)
+    return x, y
+
+
+def test_lda_separates_classes_like_sklearn():
+    from sklearn.discriminant_analysis import LinearDiscriminantAnalysis
+
+    x, y = _class_blobs()
+    c, k = 5, 4
+    st = L.lda_fit(x, y, num_classes=c, num_components=k)
+    proj = np.asarray(L.lda_project(st, x))
+    sk = LinearDiscriminantAnalysis(n_components=k, solver="eigen", shrinkage=None)
+    sk_proj = sk.fit_transform(x.astype(np.float64), y)
+    # Compare class separability (Fisher criterion) achieved, not raw axes.
+    def fisher_score(p):
+        means = np.array([p[y == i].mean(axis=0) for i in range(c)])
+        within = sum(((p[y == i] - means[i]) ** 2).sum() for i in range(c))
+        between = sum((y == i).sum() * ((means[i] - p.mean(axis=0)) ** 2).sum() for i in range(c))
+        return between / within
+
+    assert fisher_score(proj) > 0.8 * fisher_score(sk_proj)
+
+
+def test_lda_nearest_class_mean_accuracy():
+    x, y = _class_blobs(sep=3.0)
+    st = L.lda_fit(x, y, num_classes=5, num_components=4)
+    proj = np.asarray(L.lda_project(st, x))
+    means = np.stack([proj[y == i].mean(axis=0) for i in range(5)])
+    pred = np.argmin(((proj[:, None, :] - means[None]) ** 2).sum(-1), axis=1)
+    assert (pred == y).mean() > 0.95

@@ -1,0 +1,108 @@
+"""PredictableModel composition + pickle-free checkpoint roundtrip
+(SURVEY.md §3.4, §5.4): the minimum end-to-end slice of §7.4."""
+
+import os
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models import (
+    ChainOperator,
+    ExtendedPredictableModel,
+    Fisherfaces,
+    NearestNeighbor,
+    PCA,
+    PredictableModel,
+    SpatialHistogram,
+    TanTriggsPreprocessing,
+)
+from opencv_facerecognizer_tpu.ops.distance import ChiSquareDistance, EuclideanDistance
+from opencv_facerecognizer_tpu.utils import serialization
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+X, Y, NAMES = make_synthetic_faces(num_subjects=6, per_subject=8, size=(24, 24), seed=3)
+
+
+def test_eigenfaces_model_end_to_end():
+    model = PredictableModel(PCA(num_components=20), NearestNeighbor(EuclideanDistance(), k=1))
+    model.compute(X, Y)
+    pred, info = model.predict(X)
+    assert (np.asarray(pred) == Y).mean() == 1.0
+    assert info["distances"].shape == (len(Y), 1)
+
+
+def test_fisherfaces_model_batch_and_single():
+    model = PredictableModel(Fisherfaces(), NearestNeighbor(k=1))
+    model.compute(X, Y)
+    label, info = model.predict(X[10])
+    assert int(label) == int(Y[10])
+    pred, _ = model.predict(X)
+    assert (np.asarray(pred) == Y).mean() == 1.0
+
+
+def test_lbph_model_with_chisquare():
+    model = PredictableModel(
+        SpatialHistogram(sz=(4, 4)), NearestNeighbor(ChiSquareDistance(), k=1)
+    )
+    model.compute(X, Y)
+    pred, _ = model.predict(X)
+    assert (np.asarray(pred) == Y).mean() == 1.0
+
+
+def test_type_validation():
+    with pytest.raises(TypeError):
+        PredictableModel(PCA(5), PCA(5))
+    with pytest.raises(TypeError):
+        PredictableModel(NearestNeighbor(), NearestNeighbor())
+
+
+@pytest.mark.parametrize(
+    "make_model",
+    [
+        lambda: PredictableModel(PCA(15), NearestNeighbor(EuclideanDistance(), k=1)),
+        lambda: PredictableModel(
+            ChainOperator(TanTriggsPreprocessing(), Fisherfaces()),
+            NearestNeighbor(k=3),
+        ),
+        lambda: ExtendedPredictableModel(
+            SpatialHistogram(sz=(2, 2)),
+            NearestNeighbor(ChiSquareDistance(), k=1),
+            image_size=(24, 24),
+            subject_names=NAMES,
+        ),
+    ],
+    ids=["eigenfaces", "chain-fisherfaces", "extended-lbph"],
+)
+def test_save_load_roundtrip_preserves_predictions(tmp_path, make_model):
+    model = make_model()
+    model.compute(X, Y)
+    pred_before, _ = model.predict(X)
+    path = os.path.join(tmp_path, "model.ckpt")
+    serialization.save_model(path, model)
+    restored = serialization.load_model(path)
+    pred_after, _ = restored.predict(X)
+    np.testing.assert_array_equal(np.asarray(pred_before), np.asarray(pred_after))
+    if isinstance(model, ExtendedPredictableModel):
+        assert restored.image_size == (24, 24)
+        assert restored.subject_names == NAMES
+        assert restored.subject_name(0) == NAMES[0]
+
+
+def test_checkpoint_has_no_pickle(tmp_path):
+    model = PredictableModel(PCA(5), NearestNeighbor())
+    model.compute(X, Y)
+    path = os.path.join(tmp_path, "model.ckpt")
+    serialization.save_model(path, model)
+    blob = open(path, "rb").read()
+    assert b"__reduce__" not in blob and b"cnumpy" not in blob
+    # future-version checkpoints are refused, not mis-read
+    import json
+
+    from flax import serialization as fs
+
+    payload = fs.msgpack_restore(blob)
+    payload["header"]["format_version"] = 99
+    bad = os.path.join(tmp_path, "bad.ckpt")
+    open(bad, "wb").write(fs.msgpack_serialize(payload))
+    with pytest.raises(ValueError):
+        serialization.load_model(bad)

@@ -1,0 +1,67 @@
+"""Validation harness: k-fold / LOO / simple on the synthetic ORL stand-in
+(SURVEY.md §3.5, §6 measurement plan step 1 — the real ORL is unreachable in
+this zero-egress environment, so the accuracy band is established on the
+deterministic synthetic set)."""
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.models import (
+    Fisherfaces,
+    NearestNeighbor,
+    PCA,
+    PredictableModel,
+)
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+from opencv_facerecognizer_tpu.utils.validation import (
+    KFoldCrossValidation,
+    LeaveOneOutCrossValidation,
+    SimpleValidation,
+    stratified_kfold_indices,
+)
+
+X, Y, _ = make_synthetic_faces(num_subjects=8, per_subject=6, size=(24, 24), seed=5)
+# Milder illumination variation for the raw-PCA band: Eigenfaces is
+# illumination-sensitive by design (that is why Fisherfaces exists), and the
+# default synthetic set varies illumination far harder than ORL does.
+X_MILD, Y_MILD, _ = make_synthetic_faces(
+    num_subjects=8, per_subject=6, size=(24, 24), seed=5, noise=8.0, illumination=0.1
+)
+
+
+def test_stratified_folds_cover_and_balance():
+    folds = stratified_kfold_indices(Y, k=3, seed=0)
+    all_idx = np.concatenate(folds)
+    assert sorted(all_idx.tolist()) == list(range(len(Y)))
+    for f in folds:
+        counts = np.bincount(Y[f], minlength=8)
+        assert counts.max() - counts.min() <= 1
+
+
+def test_kfold_eigenfaces_band():
+    model = PredictableModel(PCA(num_components=20), NearestNeighbor(k=1))
+    cv = KFoldCrossValidation(k=3).validate(model, X_MILD, Y_MILD)
+    assert len(cv.results) == 3
+    assert cv.mean_accuracy >= 0.90, cv.results
+
+
+def test_kfold_fisherfaces_band():
+    model = PredictableModel(Fisherfaces(), NearestNeighbor(k=1))
+    cv = KFoldCrossValidation(k=3).validate(model, X, Y)
+    assert cv.mean_accuracy >= 0.90, cv.results
+
+
+def test_leave_one_out_on_tiny_subset():
+    Xs, Ys, _ = make_synthetic_faces(num_subjects=3, per_subject=4, size=(16, 16), seed=9)
+    model = PredictableModel(PCA(num_components=6), NearestNeighbor(k=1))
+    cv = LeaveOneOutCrossValidation().validate(model, Xs, Ys)
+    assert len(cv.results) == len(Ys)
+    assert cv.mean_accuracy >= 0.8
+
+
+def test_simple_validation_result_fields():
+    model = PredictableModel(PCA(num_components=10), NearestNeighbor(k=1))
+    cv = SimpleValidation().validate(model, X, Y)
+    r = cv.results[0]
+    assert r.total == len(Y)
+    assert 0.0 <= r.accuracy <= 1.0
+    assert "ValidationResult" in repr(r)
