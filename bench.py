@@ -1,0 +1,103 @@
+"""Headline benchmark: faces/sec/chip of the fused detect->align->embed->
+match pipeline (the BASELINE.json:5 north-star metric; baseline target
+2000 faces/sec/chip on v5e).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+supporting numbers on stderr. Runs on whatever jax.devices() offers (the
+driver runs it on the real chip; `JAX_PLATFORMS=axon` is already the
+environment default there).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_FACES_PER_SEC = 2000.0
+
+
+def main():
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector, decode_detections
+    from opencv_facerecognizer_tpu.models.embedder import FaceEmbedNet, init_embedder, normalize_faces
+    from opencv_facerecognizer_tpu.ops import image as image_ops
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    # Serving-shaped workload: VGA-ish frames, 8 face slots each, 112x112
+    # aligned crops, 128-d embeddings vs a 16k gallery in HBM.
+    batch, height, width = 32, 256, 256
+    face_size = (112, 112)
+    max_faces = 8
+    gallery_size, embed_dim = 16384, 128
+
+    det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3)
+    det_params = det.net.init(jax.random.PRNGKey(0), jnp.zeros((1, height, width)))["params"]
+    net = FaceEmbedNet(embed_dim=embed_dim)
+    emb_params = init_embedder(net, num_classes=64, input_shape=face_size, seed=0)["net"]
+
+    rng = np.random.default_rng(0)
+    gallery = rng.normal(size=(gallery_size, embed_dim)).astype(np.float32)
+    gallery /= np.linalg.norm(gallery, axis=-1, keepdims=True)
+    labels = rng.integers(0, 512, size=gallery_size).astype(np.int32)
+
+    @jax.jit
+    def step(det_params, emb_params, gallery, labels, frames):
+        outputs = det.net.apply({"params": det_params}, frames)
+        boxes, det_scores, valid = decode_detections(
+            outputs, max_faces, det.score_threshold, det.iou_threshold
+        )
+        crops = image_ops.batched_crop_resize(frames, boxes, face_size)
+        flat = crops.reshape((batch * max_faces, *face_size))
+        emb = net.apply({"params": emb_params}, normalize_faces(flat, face_size))
+        sims = jax.lax.dot_general(
+            emb.astype(jnp.bfloat16), gallery.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        top_sims, top_idx = jax.lax.top_k(sims, 1)
+        return boxes, valid, jnp.take(labels, top_idx), top_sims
+
+    frames = jnp.asarray(rng.uniform(0, 255, size=(batch, height, width)).astype(np.float32))
+    g = jnp.asarray(gallery)
+    l = jnp.asarray(labels)
+
+    t0 = time.perf_counter()
+    out = step(det_params, emb_params, g, l, frames)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    print(f"first call (incl compile): {compile_s:.1f}s", file=sys.stderr)
+
+    # Steady state: timed loop, per-batch latencies for p50.
+    iters = 30
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = step(det_params, emb_params, g, l, frames)
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t0)
+    lat = np.asarray(lat)
+    faces_per_batch = batch * max_faces
+    faces_per_sec = faces_per_batch / lat.mean()
+    p50_ms = float(np.percentile(lat, 50) * 1e3)
+    print(
+        f"steady: {faces_per_sec:,.0f} faces/sec/chip "
+        f"({batch} frames x {max_faces} slots, p50 {p50_ms:.2f} ms/batch, "
+        f"gallery {gallery_size})",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "faces/sec/chip (fused detect-align-embed-match, 256x256 frames, "
+                  "8 slots, 16k gallery)",
+        "value": round(float(faces_per_sec), 1),
+        "unit": "faces/s",
+        "vs_baseline": round(float(faces_per_sec) / BASELINE_FACES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,273 @@
+"""CNN face detector (Flax): the TPU-native replacement for the reference's
+Haar-cascade ``detectMultiScale`` stage (BASELINE.json:5: "the Haar-cascade
+detectMultiScale stage becomes a batched ... CNN detector"; design anchors
+PAPERS.md:6-7 — CNN-cascade / single-pass CNN detection).
+
+Instead of translating the cascade's image pyramid + sliding window (serial,
+shape-dynamic — hostile to XLA), this is a single-stage anchor-free
+("center-heatmap") detector:
+
+- A small FCN backbone at stride 8 emits a face-center heatmap plus box
+  size and sub-cell offset maps — all dense convs, MXU work.
+- Decode is static-shape end-to-end (SURVEY.md §7 "hard parts"): 3x3
+  max-pool peak suppression, ``top_k`` K candidates, box assembly, then the
+  fixed-K ``ops.nms`` mask. One jitted graph, batchable under vmap — the
+  "fixed-size outputs + on-device NMS" contract from SURVEY.md §2.2.
+- Training: penalty-reduced focal loss on a Gaussian-splatted heatmap +
+  masked L1 on size/offset (the standard center-heatmap recipe), jitted.
+
+``CNNFaceDetector.detect(img)`` keeps the reference's ``CascadedDetector``
+API (SURVEY.md §2.1 "Face detector wrapper"): returns a host-side list of
+(x0, y0, x1, y1) boxes for one image; the batched device path used by the
+serving runtime is ``detect_batch``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from opencv_facerecognizer_tpu.ops import nms as nms_ops
+
+STRIDE = 8
+
+
+class DetectorNet(nn.Module):
+    """Stride-8 FCN: 3 downsampling conv blocks -> heatmap/size/offset heads."""
+
+    features: Sequence[int] = (16, 32, 64)
+    head_features: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype) / 255.0
+        for feats in self.features:
+            x = nn.Conv(feats, (3, 3), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(feats, (3, 3), use_bias=False, dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        h = nn.Conv(self.head_features, (3, 3), dtype=self.dtype)(x)
+        h = nn.relu(h)
+        heatmap = nn.Conv(1, (1, 1), dtype=jnp.float32,
+                          bias_init=nn.initializers.constant(-4.0))(h)
+        size = nn.Conv(2, (1, 1), dtype=jnp.float32)(h)
+        offset = nn.Conv(2, (1, 1), dtype=jnp.float32)(h)
+        return {
+            "heatmap": heatmap[..., 0],  # [N, Hs, Ws] logits
+            "size": size,  # [N, Hs, Ws, 2] (h, w) in output-cell units
+            "offset": offset,  # [N, Hs, Ws, 2] sub-cell (dy, dx)
+        }
+
+
+def decode_detections(
+    outputs: Dict[str, jnp.ndarray],
+    max_faces: int = 16,
+    score_threshold: float = 0.3,
+    iou_threshold: float = 0.4,
+):
+    """Batched static-shape decode: outputs -> (boxes [N,K,4] pixel yxyx,
+    scores [N,K], valid [N,K])."""
+    heat = jax.nn.sigmoid(outputs["heatmap"])  # [N, Hs, Ws]
+    size = outputs["size"]
+    offset = outputs["offset"]
+    n, hs, ws = heat.shape
+
+    # CenterNet peak NMS: keep cells that are their 3x3 neighborhood max.
+    pooled = nn.max_pool(heat[..., None], (3, 3), strides=(1, 1), padding="SAME")[..., 0]
+    peaks = jnp.where(heat >= pooled - 1e-6, heat, 0.0)
+
+    flat = peaks.reshape(n, hs * ws)
+    k = min(max_faces * 4, hs * ws)  # over-collect, NMS trims
+    scores, idx = jax.lax.top_k(flat, k)  # [N, k]
+    cy = (idx // ws).astype(jnp.float32)
+    cx = (idx % ws).astype(jnp.float32)
+    take = lambda m: jnp.take_along_axis(m.reshape(n, hs * ws, 2), idx[..., None], axis=1)
+    sz = take(size)
+    off = take(offset)
+    cy = cy + off[..., 0]
+    cx = cx + off[..., 1]
+    bh = jnp.maximum(sz[..., 0], 1e-3)
+    bw = jnp.maximum(sz[..., 1], 1e-3)
+    boxes = jnp.stack(
+        [
+            (cy - bh / 2) * STRIDE,
+            (cx - bw / 2) * STRIDE,
+            (cy + bh / 2) * STRIDE,
+            (cx + bw / 2) * STRIDE,
+        ],
+        axis=-1,
+    )  # [N, k, 4]
+
+    def per_image(b, s):
+        return nms_ops.nms_fixed(b, s, max_faces, iou_threshold, score_threshold)
+
+    boxes, scores, valid = jax.vmap(per_image)(boxes, scores)
+    return boxes, scores, valid
+
+
+def gaussian_heatmap_targets(
+    boxes: np.ndarray, num_boxes: np.ndarray, image_size: Tuple[int, int], max_boxes: int
+):
+    """Host-side target builder: padded pixel yxyx boxes [N, B, 4] + counts
+    -> (heatmap [N,Hs,Ws], size [N,Hs,Ws,2], offset [N,Hs,Ws,2],
+    mask [N,Hs,Ws]). Gaussian splat radius follows the box size."""
+    n = boxes.shape[0]
+    hs, ws = image_size[0] // STRIDE, image_size[1] // STRIDE
+    heat = np.zeros((n, hs, ws), dtype=np.float32)
+    size = np.zeros((n, hs, ws, 2), dtype=np.float32)
+    offset = np.zeros((n, hs, ws, 2), dtype=np.float32)
+    mask = np.zeros((n, hs, ws), dtype=np.float32)
+    ys, xs = np.mgrid[0:hs, 0:ws]
+    for i in range(n):
+        for b in range(int(num_boxes[i])):
+            y0, x0, y1, x1 = boxes[i, b] / STRIDE
+            cy, cx = (y0 + y1) / 2, (x0 + x1) / 2
+            bh, bw = max(y1 - y0, 1e-3), max(x1 - x0, 1e-3)
+            iy, ix = int(np.clip(cy, 0, hs - 1)), int(np.clip(cx, 0, ws - 1))
+            sigma = max((bh + bw) / 8.0, 0.7)
+            g = np.exp(-((ys - iy) ** 2 + (xs - ix) ** 2) / (2 * sigma**2))
+            heat[i] = np.maximum(heat[i], g)
+            size[i, iy, ix] = (bh, bw)
+            offset[i, iy, ix] = (cy - iy, cx - ix)
+            mask[i, iy, ix] = 1.0
+    return heat, size, offset, mask
+
+
+def detector_loss(outputs, targets, alpha: float = 2.0, beta: float = 4.0):
+    """Penalty-reduced focal loss on the heatmap + masked L1 on size/offset."""
+    pred = jax.nn.sigmoid(outputs["heatmap"])
+    pred = jnp.clip(pred, 1e-6, 1.0 - 1e-6)
+    gt = targets["heatmap"]
+    pos = (gt >= 0.999).astype(jnp.float32)
+    pos_loss = -pos * ((1 - pred) ** alpha) * jnp.log(pred)
+    neg_loss = -(1 - pos) * ((1 - gt) ** beta) * (pred**alpha) * jnp.log(1 - pred)
+    num_pos = jnp.maximum(jnp.sum(pos), 1.0)
+    heat_loss = (jnp.sum(pos_loss) + jnp.sum(neg_loss)) / num_pos
+    m = targets["mask"][..., None]
+    size_loss = jnp.sum(jnp.abs(outputs["size"] - targets["size"]) * m) / num_pos
+    off_loss = jnp.sum(jnp.abs(outputs["offset"] - targets["offset"]) * m) / num_pos
+    return heat_loss + 0.1 * size_loss + off_loss
+
+
+def make_detector_train_step(model: DetectorNet, optimizer):
+    @jax.jit
+    def step(params, opt_state, images, targets):
+        def loss_fn(p):
+            return detector_loss(model.apply({"params": p}, images), targets)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step
+
+
+def train_detector(
+    model: DetectorNet,
+    images: np.ndarray,
+    boxes: np.ndarray,
+    num_boxes: np.ndarray,
+    *,
+    steps: int = 300,
+    batch_size: int = 16,
+    learning_rate: float = 1e-3,
+    seed: int = 0,
+    params: Optional[Dict] = None,
+    log_every: int = 0,
+):
+    """Train on (images [N,H,W], padded boxes [N,B,4], counts [N])."""
+    h, w = images.shape[1], images.shape[2]
+    heat, size, offset, mask = gaussian_heatmap_targets(
+        boxes, num_boxes, (h, w), boxes.shape[1]
+    )
+    if params is None:
+        params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, h, w)))["params"]
+    optimizer = optax.adam(learning_rate)
+    opt_state = optimizer.init(params)
+    step = make_detector_train_step(model, optimizer)
+    n = images.shape[0]
+    batch_size = min(batch_size, n)
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(images, jnp.float32)
+    t_all = {
+        "heatmap": jnp.asarray(heat),
+        "size": jnp.asarray(size),
+        "offset": jnp.asarray(offset),
+        "mask": jnp.asarray(mask),
+    }
+    for i in range(steps):
+        idx = jnp.asarray(rng.choice(n, size=batch_size, replace=n < batch_size))
+        batch_t = {k: v[idx] for k, v in t_all.items()}
+        params, opt_state, loss = step(params, opt_state, x[idx], batch_t)
+        if log_every and (i + 1) % log_every == 0:
+            print(f"  detector step {i + 1}/{steps}: loss {float(loss):.4f}")
+    return params
+
+
+class CNNFaceDetector:
+    """``CascadedDetector``-shaped wrapper (SURVEY.md §2.1): ``detect(img)``
+    -> list of (x0, y0, x1, y1) int tuples, plus the batched device path."""
+
+    def __init__(
+        self,
+        features: Sequence[int] = (16, 32, 64),
+        head_features: int = 64,
+        max_faces: int = 16,
+        score_threshold: float = 0.3,
+        iou_threshold: float = 0.4,
+    ):
+        self.net = DetectorNet(features=tuple(features), head_features=head_features)
+        self.max_faces = int(max_faces)
+        self.score_threshold = float(score_threshold)
+        self.iou_threshold = float(iou_threshold)
+        self._params: Optional[Dict] = None
+
+        def _detect(params, images):
+            outputs = self.net.apply({"params": params}, images)
+            return decode_detections(
+                outputs, self.max_faces, self.score_threshold, self.iou_threshold
+            )
+
+        self._detect_jit = jax.jit(_detect)
+
+    def train(self, images, boxes, num_boxes, **kwargs):
+        self._params = train_detector(
+            self.net, images, boxes, num_boxes, params=self._params, **kwargs
+        )
+        return self
+
+    def load_params(self, params) -> None:
+        self._params = params
+
+    @property
+    def params(self):
+        return self._params
+
+    def detect_batch(self, images: jnp.ndarray):
+        """[N, H, W] -> (boxes [N,K,4] yxyx, scores [N,K], valid [N,K]) on device."""
+        if self._params is None:
+            raise RuntimeError("CNNFaceDetector.detect called before train()/load_params()")
+        return self._detect_jit(self._params, jnp.asarray(images, jnp.float32))
+
+    def detect(self, img: np.ndarray):
+        """Single grayscale image -> [(x0, y0, x1, y1)] like the reference's
+        CascadedDetector.detect (x/y order flipped to its x-first tuples)."""
+        boxes, scores, valid = self.detect_batch(jnp.asarray(img, jnp.float32)[None])
+        boxes = np.asarray(boxes[0])
+        valid = np.asarray(valid[0])
+        out = []
+        for b, ok in zip(boxes, valid):
+            if ok:
+                y0, x0, y1, x1 = (int(round(float(v))) for v in b)
+                out.append((x0, y0, x1, y1))
+        return out

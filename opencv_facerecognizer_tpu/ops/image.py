@@ -134,6 +134,61 @@ def tan_triggs(
     return tau * jnp.tanh(dog / tau)
 
 
+def batched_crop_resize(
+    frames: jnp.ndarray, boxes: jnp.ndarray, size: Tuple[int, int]
+) -> jnp.ndarray:
+    """Crop+resize K dynamic boxes per frame, fully on device.
+
+    frames [N, H, W], boxes [N, K, 4] pixel (y0, x0, y1, x1) -> crops
+    [N, K, h, w]. The align stage of detect->align->embed->match: boxes are
+    *values* (dynamic), so this is bilinear sampling on a computed grid —
+    one gather + weighted sum, jit/vmap-friendly, static output shape.
+    Out-of-bounds samples clamp to the frame edge; degenerate boxes produce
+    edge-pixel fills (harmless — such slots are masked invalid downstream).
+    """
+    frames = jnp.asarray(frames, jnp.float32)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    n, h, w = frames.shape
+    k = boxes.shape[1]
+    oh, ow = size
+    # Sample centers of `oh x ow` pixels spanning each box.
+    ty = (jnp.arange(oh, dtype=jnp.float32) + 0.5) / oh  # [oh] in (0, 1)
+    tx = (jnp.arange(ow, dtype=jnp.float32) + 0.5) / ow
+    y0, x0, y1, x1 = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+    ys = y0[..., None] + (y1 - y0)[..., None] * ty[None, None, :] - 0.5  # [N, K, oh]
+    xs = x0[..., None] + (x1 - x0)[..., None] * tx[None, None, :] - 0.5  # [N, K, ow]
+    ys = jnp.clip(ys, 0.0, h - 1.0)
+    xs = jnp.clip(xs, 0.0, w - 1.0)
+    yf = jnp.floor(ys)
+    xf = jnp.floor(xs)
+    wy = ys - yf
+    wx = xs - xf
+    yi0 = yf.astype(jnp.int32)
+    xi0 = xf.astype(jnp.int32)
+    yi1 = jnp.minimum(yi0 + 1, h - 1)
+    xi1 = jnp.minimum(xi0 + 1, w - 1)
+
+    def gather(frame, yi, xi):
+        # frame [H, W], yi [K, oh], xi [K, ow] -> [K, oh, ow] in one 2-D gather
+        return frame[yi[:, :, None], xi[:, None, :]]
+
+    def per_frame(frame, yi0f, yi1f, xi0f, xi1f, wyf, wxf):
+        v00 = gather(frame, yi0f, xi0f)
+        v01 = gather(frame, yi0f, xi1f)
+        v10 = gather(frame, yi1f, xi0f)
+        v11 = gather(frame, yi1f, xi1f)
+        wyb = wyf[:, :, None]
+        wxb = wxf[:, None, :]
+        return (
+            v00 * (1 - wyb) * (1 - wxb)
+            + v01 * (1 - wyb) * wxb
+            + v10 * wyb * (1 - wxb)
+            + v11 * wyb * wxb
+        )
+
+    return jax.vmap(per_frame)(frames, yi0, yi1, xi0, xi1, wy, wx)
+
+
 def crop_and_resize(
     frame: jnp.ndarray, box: Sequence[int], size: Tuple[int, int]
 ) -> jnp.ndarray:

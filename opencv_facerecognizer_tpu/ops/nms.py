@@ -1,0 +1,83 @@
+"""On-device non-maximum suppression with static shapes (SURVEY.md §7.6).
+
+XLA needs static shapes, so NMS is expressed as a fixed-size mask update:
+``nms_mask`` takes exactly K candidate boxes (padded upstream) and returns a
+boolean keep-mask — no dynamic output sizes anywhere, so the whole detector
+decode stays inside one jitted graph and batches under vmap.
+
+Boxes are [y0, x0, y1, x1] in any consistent unit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    w = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return h * w
+
+
+def pairwise_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """[K, 4], [M, 4] -> [K, M] IoU."""
+    y0 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    x0 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    y1 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    x1 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    inter = jnp.maximum(y1 - y0, 0.0) * jnp.maximum(x1 - x0, 0.0)
+    union = box_area(a)[:, None] + box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def nms_mask(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float = 0.45,
+    score_threshold: float = 0.0,
+) -> jnp.ndarray:
+    """Greedy NMS as a fixed-K boolean mask (True = kept).
+
+    Candidates are visited in descending score order; a box is kept iff no
+    already-kept, higher-scored box overlaps it above ``iou_threshold``.
+    O(K^2) IoU + a K-step ``fori_loop`` — fine for the K<=128 detector
+    budget, and fully jittable/vmappable.
+    """
+    k = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_sorted = jnp.take(boxes, order, axis=0)
+    scores_sorted = jnp.take(scores, order)
+    iou = pairwise_iou(boxes_sorted, boxes_sorted)
+    candidate = scores_sorted > score_threshold
+    idx = jnp.arange(k)
+
+    def body(i, keep):
+        overlapped = keep & (idx < i) & (iou[i] > iou_threshold)
+        return keep.at[i].set(candidate[i] & ~jnp.any(overlapped))
+
+    keep_sorted = jax.lax.fori_loop(0, k, body, candidate)
+    # Scatter back to original candidate order.
+    keep = jnp.zeros((k,), dtype=bool).at[order].set(keep_sorted)
+    return keep
+
+
+def nms_fixed(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    max_outputs: int,
+    iou_threshold: float = 0.45,
+    score_threshold: float = 0.0,
+):
+    """NMS returning exactly ``max_outputs`` (boxes, scores, valid-mask),
+    best first; unused slots are zero boxes with -inf score."""
+    keep = nms_mask(boxes, scores, iou_threshold, score_threshold)
+    masked_scores = jnp.where(keep, scores, -jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(masked_scores, max_outputs)
+    top_boxes = jnp.take(boxes, top_idx, axis=0)
+    valid = jnp.isfinite(top_scores)
+    return (
+        jnp.where(valid[:, None], top_boxes, 0.0),
+        jnp.where(valid, top_scores, -jnp.inf),
+        valid,
+    )

@@ -1,0 +1,18 @@
+"""Device-mesh parallelism (SURVEY.md §2.3).
+
+The reference is single-process NumPy — its only distribution is host-level
+pub-sub. The rebuild makes two parallel axes first-class, per the north star
+(BASELINE.json:5):
+
+- ``dp`` — data parallel: frame/face batches sharded across chips.
+- ``tp`` — tensor parallel: the enrolled-gallery embedding matrix sharded
+  across chips' HBM; similarity matmul per shard + cross-device top-k merge.
+
+Collectives ride ICI via ``shard_map`` + ``all_gather``/``psum``; the
+host-level application transport stays a separate layer (``runtime``).
+"""
+
+from opencv_facerecognizer_tpu.parallel.mesh import make_mesh
+from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
+
+__all__ = ["ShardedGallery", "make_mesh"]

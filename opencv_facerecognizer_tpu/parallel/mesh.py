@@ -1,0 +1,47 @@
+"""Mesh construction helpers.
+
+One place decides how the available chips are split between the data-parallel
+(``dp``) and gallery-tensor-parallel (``tp``) axes, so every jitted graph in
+the framework agrees on axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+def make_mesh(
+    dp: Optional[int] = None,
+    tp: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, tp) mesh over ``devices`` (default: all local devices).
+
+    With neither axis given, everything goes to ``tp`` — gallery sharding is
+    the axis that changes peak capacity, while dp can also be served by
+    larger per-chip batches. Given one axis, the other takes the remainder;
+    given both, they must factor the device count exactly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None and tp is None:
+        dp, tp = 1, n
+    elif dp is None:
+        if n % tp:
+            raise ValueError(f"tp={tp} does not divide device count {n}")
+        dp = n // tp
+    elif tp is None:
+        if n % dp:
+            raise ValueError(f"dp={dp} does not divide device count {n}")
+        tp = n // dp
+    if dp * tp != n:
+        raise ValueError(f"dp*tp = {dp}*{tp} != device count {n}")
+    arr = np.asarray(devices).reshape(dp, tp)
+    return Mesh(arr, (DP_AXIS, TP_AXIS))

@@ -1,0 +1,111 @@
+"""The fused serving graph: detect -> align -> embed -> match as ONE jitted,
+mesh-sharded call per frame batch (BASELINE.json:5: "detect->align->embed->
+match executes as one pmap'd call per batch"; SURVEY.md §3.3 rebuild note).
+
+Static-shape discipline end-to-end (SURVEY.md §7 "hard parts"): every frame
+contributes exactly ``max_faces`` slots; empty slots ride along as invalid
+(masked) work. TPUs vastly prefer predictable dense compute over dynamic
+shapes — invalid-slot embeddings are garbage lanes of a batched matmul, not
+wasted recompiles.
+
+Sharding: frames are dp-sharded; detector/embedder params are replicated;
+the gallery match inside is tp-sharded (see ``parallel.gallery``). XLA
+inserts the collectives; nothing here names a wire protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from opencv_facerecognizer_tpu.models import detector as detector_mod
+from opencv_facerecognizer_tpu.models import embedder as embedder_mod
+from opencv_facerecognizer_tpu.ops import image as image_ops
+from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery, match_global
+from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+
+class RecognitionResult(NamedTuple):
+    boxes: jnp.ndarray  # [B, K, 4] pixel yxyx
+    det_scores: jnp.ndarray  # [B, K]
+    valid: jnp.ndarray  # [B, K] bool
+    labels: jnp.ndarray  # [B, K, k] gallery labels, best first
+    similarities: jnp.ndarray  # [B, K, k] cosine similarity
+
+
+class RecognitionPipeline:
+    """Holds the nets + gallery and compiles the fused per-batch step."""
+
+    def __init__(
+        self,
+        detector: detector_mod.CNNFaceDetector,
+        embed_net: embedder_mod.FaceEmbedNet,
+        embed_params: Dict[str, Any],
+        gallery: ShardedGallery,
+        face_size: Tuple[int, int] = (112, 112),
+        top_k: int = 1,
+    ):
+        self.detector = detector
+        self.embed_net = embed_net
+        self.embed_params = embed_params
+        self.gallery = gallery
+        self.face_size = tuple(face_size)
+        self.top_k = int(top_k)
+        self._step_cache: Dict[Tuple[int, int, int], Any] = {}
+
+    def _build_step(self, batch: int, height: int, width: int):
+        mesh = self.gallery.mesh
+        det = self.detector
+        k = self.top_k
+        face_size = self.face_size
+        embed_net = self.embed_net
+        max_faces = det.max_faces
+
+        def step(det_params, emb_params, gallery_emb, gallery_valid, gallery_labels, frames):
+            # 1) detect (dense convs; dp-sharded batch)
+            outputs = det.net.apply({"params": det_params}, frames)
+            boxes, det_scores, valid = detector_mod.decode_detections(
+                outputs, max_faces, det.score_threshold, det.iou_threshold
+            )
+            # 2) align: dynamic crop+resize, all slots (invalid ones too)
+            crops = image_ops.batched_crop_resize(frames, boxes, face_size)
+            flat = crops.reshape((batch * max_faces, *face_size))
+            # 3) embed
+            emb = embed_net.apply(
+                {"params": emb_params}, embedder_mod.normalize_faces(flat, face_size)
+            )  # [B*K, E] unit-norm
+            # 4) match against the tp-sharded gallery (GSPMD global view —
+            # see parallel.gallery.match_global for why not shard_map)
+            labels, sims, _ = match_global(
+                emb, gallery_emb, gallery_valid, gallery_labels, k=k, mesh=mesh
+            )
+            return RecognitionResult(
+                boxes=boxes,
+                det_scores=det_scores,
+                valid=valid,
+                labels=labels.reshape((batch, max_faces, k)),
+                similarities=sims.reshape((batch, max_faces, k)),
+            )
+
+        frames_sharding = NamedSharding(mesh, P(DP_AXIS, None, None))
+        return jax.jit(step, in_shardings=(None, None, None, None, None, frames_sharding))
+
+    def recognize_batch(self, frames: jnp.ndarray) -> RecognitionResult:
+        """[B, H, W] frames -> RecognitionResult; B must divide by dp size,
+        and B * max_faces must too (it does when B does)."""
+        frames = jnp.asarray(frames, jnp.float32)
+        key = frames.shape
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(*key)
+        g = self.gallery
+        return self._step_cache[key](
+            self.detector.params,
+            self.embed_params,
+            g.embeddings,
+            g.valid,
+            g.labels,
+            frames,
+        )

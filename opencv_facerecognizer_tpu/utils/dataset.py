@@ -120,3 +120,59 @@ def make_synthetic_faces(
             labels.append(s)
     names = [f"subject_{i:02d}" for i in range(num_subjects)]
     return np.stack(images), np.asarray(labels, dtype=np.int32), names
+
+
+def make_synthetic_scenes(
+    num_scenes: int = 32,
+    scene_size: Tuple[int, int] = (96, 96),
+    max_faces: int = 3,
+    face_size_range: Tuple[int, int] = (20, 36),
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Detection-training scenes: textured background with 0..max_faces
+    bright ellipse-masked "face" patches pasted in (distinct enough for a
+    small detector to learn). Returns (scenes [N,H,W] in [0,255],
+    boxes [N,max_faces,4] pixel yxyx zero-padded, num_faces [N])."""
+    rng = np.random.default_rng(seed)
+    h, w = scene_size
+    scenes = np.zeros((num_scenes, h, w), dtype=np.float32)
+    boxes = np.zeros((num_scenes, max_faces, 4), dtype=np.float32)
+    counts = np.zeros((num_scenes,), dtype=np.int32)
+    for i in range(num_scenes):
+        # low-frequency background texture (kron-upsampled, cropped to size)
+        bg = rng.normal(scale=1.0, size=(-(-h // 8), -(-w // 8))).astype(np.float32)
+        bg = np.kron(bg, np.ones((8, 8), dtype=np.float32))[:h, :w]
+        scene = 80.0 + 20.0 * bg + rng.normal(scale=6.0, size=(h, w)).astype(np.float32)
+        n_faces = int(rng.integers(0, max_faces + 1))
+        placed = 0
+        attempts = 0
+        while placed < n_faces and attempts < 20:
+            attempts += 1
+            fs = int(rng.integers(face_size_range[0], face_size_range[1] + 1))
+            y0 = int(rng.integers(0, h - fs + 1))
+            x0 = int(rng.integers(0, w - fs + 1))
+            # reject overlaps with already-placed boxes
+            ok = True
+            for b in range(placed):
+                by0, bx0, by1, bx1 = boxes[i, b]
+                if not (y0 + fs < by0 or by1 < y0 or x0 + fs < bx0 or bx1 < x0):
+                    ok = False
+                    break
+            if not ok:
+                continue
+            yy, xx = np.mgrid[0:fs, 0:fs].astype(np.float32)
+            cy, cx = fs / 2, fs / 2
+            ellipse = (((yy - cy) / (fs * 0.5)) ** 2 + ((xx - cx) / (fs * 0.42)) ** 2) <= 1.0
+            face = 190.0 + 30.0 * np.cos(yy / fs * 3.1) + rng.normal(scale=8.0, size=(fs, fs))
+            # darker "eyes" structure so faces are not plain blobs
+            for ex in (0.32, 0.68):
+                eyy, exx = int(fs * 0.38), int(fs * ex)
+                rr = max(1, fs // 10)
+                face[eyy - rr : eyy + rr, exx - rr : exx + rr] -= 90.0
+            region = scene[y0 : y0 + fs, x0 : x0 + fs]
+            region[ellipse] = face[ellipse]
+            boxes[i, placed] = (y0, x0, y0 + fs, x0 + fs)
+            placed += 1
+        counts[i] = placed
+        scenes[i] = np.clip(scene, 0, 255)
+    return scenes, boxes, counts

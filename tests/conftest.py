@@ -2,17 +2,28 @@
 
 Tests run on the CPU backend with 8 virtual devices so N-way sharding is
 exercised without a TPU pod; the real-chip paths are covered by bench.py and
-__graft_entry__.py which the driver runs on hardware. Env vars must be set
-before jax initializes its backend, hence this conftest does it at import
-time (pytest imports conftest before any test module).
+__graft_entry__.py which the driver runs on hardware.
+
+Gotcha: this environment's sitecustomize force-registers the axon TPU
+backend and overrides the JAX_PLATFORMS env var, so merely setting the env
+is NOT enough — ``jax.config.update('jax_platforms', 'cpu')`` after import
+is what actually wins. XLA_FLAGS still must be set before the first backend
+initialization to get the 8 virtual CPU devices.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_report_header(config):
+    return f"jax backend: {jax.devices()[0].platform}, devices: {len(jax.devices())}"

@@ -1,0 +1,101 @@
+"""CNN detector: target building, loss, end-to-end training on synthetic
+scenes, CascadedDetector-compatible API (SURVEY.md §7.6)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opencv_facerecognizer_tpu.models.detector import (
+    STRIDE,
+    CNNFaceDetector,
+    DetectorNet,
+    decode_detections,
+    detector_loss,
+    gaussian_heatmap_targets,
+)
+from opencv_facerecognizer_tpu.ops.nms import pairwise_iou
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+
+def test_gaussian_targets_peak_at_centers():
+    boxes = np.array([[[16, 24, 48, 56], [0, 0, 0, 0]]], dtype=np.float32)
+    heat, size, offset, mask = gaussian_heatmap_targets(boxes, np.array([1]), (96, 96), 2)
+    assert heat.shape == (1, 12, 12)
+    cy, cx = (16 + 48) / 2 / STRIDE, (24 + 56) / 2 / STRIDE
+    iy, ix = int(cy), int(cx)
+    assert heat[0].argmax() == iy * 12 + ix
+    assert mask[0].sum() == 1.0
+    np.testing.assert_allclose(size[0, iy, ix], [4.0, 4.0])
+    np.testing.assert_allclose(offset[0, iy, ix], [cy - iy, cx - ix], atol=1e-6)
+
+
+def test_detector_loss_prefers_correct_heatmap():
+    boxes = np.array([[[16, 16, 40, 40]]], dtype=np.float32)
+    heat, size, offset, mask = gaussian_heatmap_targets(boxes, np.array([1]), (64, 64), 1)
+    targets = {"heatmap": jnp.asarray(heat), "size": jnp.asarray(size),
+               "offset": jnp.asarray(offset), "mask": jnp.asarray(mask)}
+    logit_good = np.full((1, 8, 8), -6.0, dtype=np.float32)
+    iy, ix = np.unravel_index(heat[0].argmax(), heat[0].shape)
+    logit_good[0, iy, ix] = 6.0
+    good = {"heatmap": jnp.asarray(logit_good), "size": targets["size"],
+            "offset": targets["offset"]}
+    bad = {"heatmap": jnp.asarray(-logit_good), "size": targets["size"],
+           "offset": targets["offset"]}
+    assert float(detector_loss(good, targets)) < float(detector_loss(bad, targets))
+
+
+def test_decode_static_shapes():
+    net = DetectorNet(features=(8, 8, 16), head_features=16)
+    import jax
+
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((2, 64, 64)))["params"]
+    out = net.apply({"params": params}, jnp.zeros((2, 64, 64)))
+    boxes, scores, valid = decode_detections(out, max_faces=5)
+    assert boxes.shape == (2, 5, 4)
+    assert scores.shape == (2, 5)
+    assert valid.shape == (2, 5)
+
+
+@pytest.fixture(scope="module")
+def trained_detector():
+    scenes, boxes, counts = make_synthetic_scenes(48, (96, 96), max_faces=2, seed=3)
+    det = CNNFaceDetector(features=(8, 16, 32), head_features=32, max_faces=4,
+                          score_threshold=0.25)
+    det.train(scenes, boxes, counts, steps=250, batch_size=16, learning_rate=2e-3)
+    return det
+
+
+def test_detector_learns_synthetic_faces(trained_detector):
+    scenes, boxes, counts = make_synthetic_scenes(16, (96, 96), max_faces=2, seed=99)
+    pred_boxes, pred_scores, valid = (np.asarray(v) for v in
+                                      trained_detector.detect_batch(scenes))
+    matched, total = 0, 0
+    for i in range(len(scenes)):
+        gt = boxes[i, : counts[i]]
+        total += counts[i]
+        pb = pred_boxes[i][valid[i]]
+        if len(pb) == 0 or len(gt) == 0:
+            continue
+        iou = np.asarray(pairwise_iou(jnp.asarray(gt), jnp.asarray(pb, dtype=jnp.float32)))
+        matched += (iou.max(axis=1) > 0.4).sum()
+    recall = matched / max(total, 1)
+    assert recall >= 0.7, f"recall {recall:.2f} ({matched}/{total})"
+
+
+def test_detect_single_image_reference_api(trained_detector):
+    scenes, boxes, counts = make_synthetic_scenes(4, (96, 96), max_faces=1, seed=7)
+    i = int(np.flatnonzero(counts > 0)[0])
+    rects = trained_detector.detect(scenes[i])
+    assert isinstance(rects, list)
+    assert all(len(r) == 4 for r in rects)
+    # x-first tuples, ints
+    if rects:
+        x0, y0, x1, y1 = rects[0]
+        assert x1 > x0 and y1 > y0
+
+
+def test_detect_before_train_raises():
+    det = CNNFaceDetector()
+    with pytest.raises(RuntimeError):
+        det.detect(np.zeros((64, 64), dtype=np.float32))

@@ -1,0 +1,135 @@
+"""CNN embedder: shapes, ArcFace training signal, plugin integration,
+verification protocol (SURVEY.md §7.5)."""
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models import NearestNeighbor, PredictableModel
+from opencv_facerecognizer_tpu.models.embedder import (
+    CNNEmbedding,
+    FaceEmbedNet,
+    arcface_loss,
+    init_embedder,
+    train_embedder,
+)
+from opencv_facerecognizer_tpu.ops.distance import CosineDistance
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+from opencv_facerecognizer_tpu.utils.verification import (
+    make_verification_pairs,
+    verification_accuracy,
+)
+
+import jax.numpy as jnp
+
+TINY = dict(embed_dim=32, stem_features=8, stage_features=(8, 16), stage_blocks=(1, 1))
+
+
+def _tiny_net():
+    return FaceEmbedNet(embed_dim=32, stem_features=8, stage_features=(8, 16),
+                        stage_blocks=(1, 1))
+
+
+def test_embeddings_are_unit_norm():
+    net = _tiny_net()
+    params = init_embedder(net, num_classes=4, input_shape=(32, 32))
+    emb = np.asarray(net.apply({"params": params["net"]}, jnp.zeros((3, 32, 32)) + 1.0))
+    assert emb.shape == (3, 32)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0, atol=1e-5)
+
+
+def test_arcface_margin_increases_loss():
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(8, 16)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=1, keepdims=True)
+    w = rng.normal(size=(4, 16)).astype(np.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=8))
+    base = float(arcface_loss(jnp.asarray(emb), y, jnp.asarray(w), margin=0.0))
+    with_margin = float(arcface_loss(jnp.asarray(emb), y, jnp.asarray(w), margin=0.5))
+    assert with_margin > base
+
+
+def test_training_reduces_loss_and_separates_classes():
+    X, y, _ = make_synthetic_faces(4, 8, (32, 32), seed=21, noise=8.0)
+    net = _tiny_net()
+    params = init_embedder(net, num_classes=4, input_shape=(32, 32), seed=0)
+    from opencv_facerecognizer_tpu.models.embedder import normalize_faces
+
+    xn = normalize_faces(X, (32, 32))
+    emb0 = np.asarray(net.apply({"params": params["net"]}, xn))
+    params = train_embedder(net, params, np.asarray(xn), y, steps=60, batch_size=16,
+                            learning_rate=3e-3)
+    emb1 = np.asarray(net.apply({"params": params["net"]}, xn))
+
+    def genuine_vs_impostor_gap(emb):
+        sims = emb @ emb.T
+        same = y[:, None] == y[None, :]
+        off_diag = ~np.eye(len(y), dtype=bool)
+        return sims[same & off_diag].mean() - sims[~same].mean()
+
+    assert genuine_vs_impostor_gap(emb1) > genuine_vs_impostor_gap(emb0) + 0.1
+
+
+def test_cnn_embedding_plugin_in_predictable_model():
+    X, y, _ = make_synthetic_faces(4, 6, (32, 32), seed=2, noise=8.0)
+    feat = CNNEmbedding(input_size=(32, 32), train_steps=80, batch_size=24,
+                        learning_rate=3e-3, **{k: v for k, v in TINY.items() if k != "embed_dim"},
+                        embed_dim=32)
+    model = PredictableModel(feat, NearestNeighbor(CosineDistance(), k=1))
+    model.compute(X, y)
+    pred, _ = model.predict(X)
+    assert (np.asarray(pred) == y).mean() >= 0.9
+    single, _ = model.predict(X[0])
+    assert np.ndim(single) == 0
+
+
+def test_cnn_embedding_checkpoint_roundtrip(tmp_path):
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    serialization.register(CNNEmbedding)
+    X, y, _ = make_synthetic_faces(3, 4, (32, 32), seed=4)
+    feat = CNNEmbedding(input_size=(32, 32), train_steps=5, batch_size=12,
+                        **{k: v for k, v in TINY.items() if k != "embed_dim"}, embed_dim=32)
+    model = PredictableModel(feat, NearestNeighbor(CosineDistance(), k=1))
+    model.compute(X, y)
+    before = np.asarray(model.feature.extract(X))
+    path = str(tmp_path / "cnn.ckpt")
+    serialization.save_model(path, model)
+    restored = serialization.load_model(path)
+    after = np.asarray(restored.feature.extract(X))
+    np.testing.assert_allclose(before, after, atol=1e-5)
+
+
+def test_verification_pairs_balanced_no_self():
+    _, y, _ = make_synthetic_faces(6, 5, (8, 8), seed=0)
+    a, b, same = make_verification_pairs(y, num_pairs=200, seed=1)
+    assert len(a) == 200
+    assert same.sum() == 100
+    assert np.all(a != b) or np.all(y[a[same]] == y[b[same]])
+    assert np.all(y[a[same]] == y[b[same]])
+    assert np.all(y[a[~same]] != y[b[~same]])
+
+
+def test_verification_accuracy_separable_embeddings():
+    rng = np.random.default_rng(3)
+    centers = rng.normal(size=(5, 16))
+    centers /= np.linalg.norm(centers, axis=1, keepdims=True)
+    y = np.repeat(np.arange(5), 20)
+    emb = centers[y] + 0.05 * rng.normal(size=(100, 16))
+    a, b, same = make_verification_pairs(y, num_pairs=400, seed=2)
+    acc, std, thr = verification_accuracy(emb[a], emb[b], same)
+    assert acc > 0.97
+    assert -1.0 <= thr <= 1.0
+
+
+def test_verification_accuracy_random_embeddings_near_chance():
+    rng = np.random.default_rng(4)
+    y = np.repeat(np.arange(5), 20)
+    emb = rng.normal(size=(100, 16))
+    a, b, same = make_verification_pairs(y, num_pairs=400, seed=5)
+    acc, _, _ = verification_accuracy(emb[a], emb[b], same)
+    assert acc < 0.65
+
+
+def test_verification_pairs_requires_multi_sample_classes():
+    with pytest.raises(ValueError):
+        make_verification_pairs(np.arange(10), num_pairs=10)

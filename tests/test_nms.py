@@ -1,0 +1,80 @@
+"""On-device NMS vs brute-force oracle (SURVEY.md §4 prescription)."""
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.ops import nms as N
+
+RNG = np.random.default_rng(13)
+
+
+def brute_force_nms(boxes, scores, iou_t, score_t):
+    def iou(a, b):
+        y0, x0 = max(a[0], b[0]), max(a[1], b[1])
+        y1, x1 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(y1 - y0, 0) * max(x1 - x0, 0)
+        area = lambda z: max(z[2] - z[0], 0) * max(z[3] - z[1], 0)
+        return inter / max(area(a) + area(b) - inter, 1e-12)
+
+    order = np.argsort(-scores)
+    kept = []
+    for i in order:
+        if scores[i] <= score_t:
+            continue
+        if all(iou(boxes[i], boxes[j]) <= iou_t for j in kept):
+            kept.append(i)
+    return sorted(kept)
+
+
+def _random_boxes(k=40):
+    y0 = RNG.uniform(0, 60, k)
+    x0 = RNG.uniform(0, 60, k)
+    h = RNG.uniform(5, 30, k)
+    w = RNG.uniform(5, 30, k)
+    boxes = np.stack([y0, x0, y0 + h, x0 + w], axis=1).astype(np.float32)
+    scores = RNG.uniform(0, 1, k).astype(np.float32)
+    return boxes, scores
+
+
+def test_pairwise_iou_oracle():
+    a, _ = _random_boxes(10)
+    b, _ = _random_boxes(7)
+    got = np.asarray(N.pairwise_iou(a, b))
+    for i in range(10):
+        for j in range(7):
+            yi0, xi0 = max(a[i, 0], b[j, 0]), max(a[i, 1], b[j, 1])
+            yi1, xi1 = min(a[i, 2], b[j, 2]), min(a[i, 3], b[j, 3])
+            inter = max(yi1 - yi0, 0) * max(xi1 - xi0, 0)
+            area_a = (a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+            area_b = (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1])
+            want = inter / (area_a + area_b - inter)
+            np.testing.assert_allclose(got[i, j], want, rtol=1e-4, atol=1e-5)
+
+
+def test_nms_mask_matches_bruteforce():
+    for trial in range(5):
+        boxes, scores = _random_boxes(40)
+        keep = np.asarray(N.nms_mask(boxes, scores, 0.4, 0.1))
+        want = brute_force_nms(boxes, scores, 0.4, 0.1)
+        assert sorted(np.flatnonzero(keep).tolist()) == want, f"trial {trial}"
+
+
+def test_nms_fixed_output_shapes_and_order():
+    boxes, scores = _random_boxes(30)
+    out_boxes, out_scores, valid = (np.asarray(v) for v in N.nms_fixed(boxes, scores, 8, 0.4, 0.1))
+    assert out_boxes.shape == (8, 4) and out_scores.shape == (8,) and valid.shape == (8,)
+    vs = out_scores[valid]
+    assert np.all(np.diff(vs) <= 1e-6)  # descending
+    assert np.all(out_boxes[~valid] == 0.0)
+
+
+def test_nms_all_below_threshold():
+    boxes, scores = _random_boxes(10)
+    _, out_scores, valid = N.nms_fixed(boxes, scores * 0.01, 4, 0.4, 0.5)
+    assert not np.any(np.asarray(valid))
+
+
+def test_nms_identical_boxes_keep_one():
+    box = np.array([[10, 10, 30, 30]] * 5, dtype=np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.6, 0.5], dtype=np.float32)
+    keep = np.asarray(N.nms_mask(box, scores, 0.5, 0.0))
+    assert keep.sum() == 1 and keep[0]

@@ -1,0 +1,104 @@
+"""Sharded gallery + mesh tests on the 8-virtual-device CPU backend
+(SURVEY.md §7.7: N-way CPU-simulated device tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+RNG = np.random.default_rng(17)
+
+
+def _unit(v):
+    return v / np.maximum(np.linalg.norm(v, axis=-1, keepdims=True), 1e-12)
+
+
+def _brute_force_topk(queries, gallery, labels, k):
+    sims = _unit(queries) @ _unit(gallery).T
+    idx = np.argsort(-sims, axis=1)[:, :k]
+    return labels[idx], np.take_along_axis(sims, idx, axis=1)
+
+
+def test_make_mesh_factorizations():
+    assert make_mesh().shape == {DP_AXIS: 1, TP_AXIS: 8}
+    assert make_mesh(dp=2).shape == {DP_AXIS: 2, TP_AXIS: 4}
+    assert make_mesh(tp=2).shape == {DP_AXIS: 4, TP_AXIS: 2}
+    assert make_mesh(dp=8, tp=1).shape == {DP_AXIS: 8, TP_AXIS: 1}
+    with pytest.raises(ValueError):
+        make_mesh(dp=3)
+    with pytest.raises(ValueError):
+        make_mesh(dp=2, tp=2)
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 8), (2, 4), (8, 1)])
+def test_sharded_match_equals_bruteforce(dp, tp):
+    mesh = make_mesh(dp=dp, tp=tp)
+    gal_emb = RNG.normal(size=(64, 16)).astype(np.float32)
+    gal_labels = RNG.integers(0, 10, size=64).astype(np.int32)
+    g = ShardedGallery(capacity=64, dim=16, mesh=mesh)
+    g.add(gal_emb, gal_labels)
+    queries = _unit(RNG.normal(size=(8, 16)).astype(np.float32))
+    for k in (1, 3):
+        labels, sims, idx = (np.asarray(v) for v in g.match(queries, k=k))
+        want_labels, want_sims = _brute_force_topk(queries, gal_emb, gal_labels, k)
+        np.testing.assert_allclose(sims, want_sims, atol=2e-2)  # bf16 matmul
+        # labels can differ at near-ties under bf16; require match on clear wins
+        clear = (want_sims[:, :1] - want_sims[:, -1:]) > 0.05 if k > 1 else np.ones((8, 1), bool)
+        np.testing.assert_array_equal(labels[:, 0][clear[:, 0]], want_labels[:, 0][clear[:, 0]])
+
+
+def test_gallery_partial_fill_and_masking():
+    mesh = make_mesh(tp=8)
+    g = ShardedGallery(capacity=30, dim=8, mesh=mesh)  # rounds up to 32
+    assert g.capacity == 32
+    emb = RNG.normal(size=(5, 8)).astype(np.float32)
+    labels = np.arange(5, dtype=np.int32)
+    g.add(emb, labels)
+    q = _unit(emb)
+    got_labels, sims, idx = (np.asarray(v) for v in g.match(q, k=1))
+    np.testing.assert_array_equal(got_labels[:, 0], labels)
+    assert np.all(idx < 5)  # never matches an invalid padded row
+
+
+def test_gallery_overflow_raises():
+    mesh = make_mesh(tp=8)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    g.add(RNG.normal(size=(8, 4)).astype(np.float32), np.arange(8, dtype=np.int32))
+    with pytest.raises(ValueError, match="overflow"):
+        g.add(RNG.normal(size=(1, 4)).astype(np.float32), np.array([9], dtype=np.int32))
+
+
+def test_gallery_incremental_enrolment():
+    mesh = make_mesh(tp=4, dp=2)
+    g = ShardedGallery(capacity=16, dim=8, mesh=mesh)
+    e1 = RNG.normal(size=(4, 8)).astype(np.float32)
+    e2 = RNG.normal(size=(4, 8)).astype(np.float32)
+    g.add(e1, np.zeros(4, dtype=np.int32))
+    g.add(e2, np.ones(4, dtype=np.int32))
+    assert g.size == 8
+    labels, _, _ = (np.asarray(v) for v in g.match(_unit(e2)[:2], k=1))
+    np.testing.assert_array_equal(labels[:, 0], [1, 1])
+
+
+def test_double_buffered_swap():
+    mesh = make_mesh(tp=8)
+    live = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    live.add(_unit(RNG.normal(size=(4, 4)).astype(np.float32)), np.zeros(4, np.int32))
+    staged = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    new_emb = _unit(RNG.normal(size=(6, 4)).astype(np.float32))
+    staged.add(new_emb, np.full(6, 7, np.int32))
+    live.swap_from(staged)
+    assert live.size == 6
+    labels, _, _ = (np.asarray(v) for v in live.match(new_emb[:1], k=1))
+    assert labels[0, 0] == 7
+
+
+def test_query_count_must_divide_dp():
+    mesh = make_mesh(dp=4, tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32), np.arange(4, dtype=np.int32))
+    with pytest.raises(ValueError, match="divisible"):
+        g.match(np.zeros((3, 4), dtype=np.float32), k=1)

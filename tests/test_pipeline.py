@@ -1,0 +1,89 @@
+"""End-to-end fused detect->align->embed->match pipeline on the 8-device
+CPU mesh (SURVEY.md §3.3 rebuild contract, §7.7)."""
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+from opencv_facerecognizer_tpu.models.embedder import (
+    FaceEmbedNet,
+    init_embedder,
+    normalize_faces,
+    train_embedder,
+)
+from opencv_facerecognizer_tpu.ops import image as image_ops
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+
+FACE = (32, 32)
+
+
+@pytest.fixture(scope="module")
+def pipeline_setup():
+    # Train a tiny detector on synthetic scenes.
+    scenes, boxes, counts = make_synthetic_scenes(48, (96, 96), max_faces=2, seed=31)
+    det = CNNFaceDetector(features=(8, 16, 32), head_features=32, max_faces=4,
+                          score_threshold=0.25)
+    det.train(scenes, boxes, counts, steps=250, batch_size=16, learning_rate=2e-3)
+
+    # "Subjects": crops of distinct synthetic faces; embedder trained on them.
+    net = FaceEmbedNet(embed_dim=32, stem_features=8, stage_features=(8, 16),
+                       stage_blocks=(1, 1))
+    crops, labels = [], []
+    for i in range(len(scenes)):
+        for b in range(counts[i]):
+            y0, x0, y1, x1 = boxes[i, b].astype(int)
+            crop = np.asarray(image_ops.resize(scenes[i][y0:y1, x0:x1], FACE))
+            crops.append(crop)
+            labels.append(i % 5)  # 5 pseudo-identities
+    crops = np.stack(crops)
+    labels = np.asarray(labels, np.int32)
+    params = init_embedder(net, num_classes=5, input_shape=FACE, seed=0)
+    xn = np.asarray(normalize_faces(crops, FACE))
+    params = train_embedder(net, params, xn, labels, steps=40, batch_size=16)
+    return det, net, params, scenes, boxes, counts, crops, labels
+
+
+@pytest.mark.parametrize("dp,tp", [(2, 4), (1, 8)])
+def test_fused_pipeline_runs_sharded(pipeline_setup, dp, tp):
+    det, net, params, scenes, boxes, counts, crops, labels = pipeline_setup
+    mesh = make_mesh(dp=dp, tp=tp)
+    gallery = ShardedGallery(capacity=64, dim=32, mesh=mesh)
+    emb = np.asarray(net.apply({"params": params["net"]},
+                               normalize_faces(crops, FACE)))
+    gallery.add(emb, labels)
+
+    pipe = RecognitionPipeline(det, net, params["net"], gallery, face_size=FACE, top_k=2)
+    batch = scenes[:8]
+    result = pipe.recognize_batch(batch)
+    assert result.boxes.shape == (8, 4, 4)
+    assert result.valid.shape == (8, 4)
+    assert result.labels.shape == (8, 4, 2)
+    assert result.similarities.shape == (8, 4, 2)
+    # detections should roughly track ground truth face counts
+    det_count = int(np.asarray(result.valid).sum())
+    gt_count = int(counts[:8].sum())
+    assert det_count >= gt_count // 2
+    # matched labels for valid faces must be real gallery labels
+    valid = np.asarray(result.valid)
+    lbl = np.asarray(result.labels)[..., 0]
+    assert set(np.unique(lbl[valid]).tolist()) <= set(range(5))
+    # similarities are cosine-bounded
+    sims = np.asarray(result.similarities)[valid]
+    assert np.all(sims <= 1.0 + 1e-3)
+
+
+def test_pipeline_batch_caching(pipeline_setup):
+    det, net, params, scenes, *_ = pipeline_setup
+    mesh = make_mesh(tp=8)
+    gallery = ShardedGallery(capacity=16, dim=32, mesh=mesh)
+    gallery.add(np.eye(16, 32, dtype=np.float32), np.arange(16, dtype=np.int32))
+    pipe = RecognitionPipeline(det, net, params["net"], gallery, face_size=FACE)
+    r1 = pipe.recognize_batch(scenes[:8])
+    assert len(pipe._step_cache) == 1
+    r2 = pipe.recognize_batch(scenes[8:16])
+    assert len(pipe._step_cache) == 1  # same shape -> no recompile
+    pipe.recognize_batch(scenes[:16])
+    assert len(pipe._step_cache) == 2
