@@ -1,0 +1,3 @@
+"""CLI entry points (SURVEY.md §2.1 "Packaging/CLI"): the reference's
+``ocvf_*`` script surface as argparse apps — train, recognize (JSONL or
+video transport), interactive enrolment via the control topic."""

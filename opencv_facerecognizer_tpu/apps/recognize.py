@@ -1,0 +1,146 @@
+"""``ocvf-recognize``: the live recognizer node (SURVEY.md §2.1 "Standalone
+recognizer app" / "ROS recognizer node", rebuilt per §3.3): frames in ->
+fused TPU batch recognition -> results out.
+
+Transports:
+- ``--source jsonl`` (default): frames as JSONL on stdin (see
+  runtime.connector.encode_frame for the schema), results as JSONL on
+  stdout — the shippable default in a ROS-less environment. The enrolment
+  protocol rides the same stream ({"topic": "ocvfacerec/control",
+  "data": {"cmd": "enroll", ...}}).
+- ``--source dir``: replay a directory of images once and exit — demo/
+  verification mode.
+
+Needs a CNN embedding model checkpoint (ocvf-train --model cnn) and a
+detector checkpoint (CNNFaceDetector.save).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ocvf-recognize",
+                                description="Live face recognition on TPU")
+    p.add_argument("--model", required=True, help="CNN model checkpoint (ocvf-train --model cnn)")
+    p.add_argument("--detector", required=True, help="detector checkpoint (CNNFaceDetector.save)")
+    p.add_argument("--gallery", required=True,
+                   help="dataset dir to enroll at startup (folder per subject)")
+    p.add_argument("--source", choices=["jsonl", "dir"], default="jsonl")
+    p.add_argument("--dir", help="image directory for --source dir")
+    p.add_argument("--frame-size", type=int, nargs=2, default=(256, 256), metavar=("H", "W"))
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--flush-ms", type=float, default=30.0)
+    p.add_argument("--similarity-threshold", type=float, default=0.3)
+    p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
+    p.add_argument("--metrics-jsonl", help="append per-batch metrics to this file")
+    return p
+
+
+def _load_stack(args):
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+    from opencv_facerecognizer_tpu.utils import dataset as dataset_utils
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    serialization.register(CNNEmbedding)
+    model = serialization.load_model(args.model)
+    feature = model.feature
+    if not isinstance(feature, CNNEmbedding):
+        raise SystemExit("--model must be a cnn checkpoint (ocvf-train --model cnn)")
+    detector = CNNFaceDetector.load(args.detector)
+
+    images, labels, names = dataset_utils.read_images(
+        args.gallery, image_size=feature.input_size
+    )
+    emb = np.array(feature.extract(images))
+    mesh = make_mesh()
+    gallery = ShardedGallery(capacity=max(args.capacity, 2 * len(emb)),
+                             dim=emb.shape[1], mesh=mesh)
+    gallery.add(emb, labels)
+    pipeline = RecognitionPipeline(
+        detector, feature.net, feature._params["net"], gallery,
+        face_size=feature.input_size,
+    )
+    return pipeline, names
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from opencv_facerecognizer_tpu.runtime.connector import (
+        FakeConnector, JSONLConnector, encode_frame,
+    )
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC, RecognizerService,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    pipeline, names = _load_stack(args)
+    metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
+    metrics = Metrics(sink=metrics_sink)
+
+    if args.source == "jsonl":
+        connector = JSONLConnector(sys.stdin, sys.stdout)
+    else:
+        connector = FakeConnector()
+
+    service = RecognizerService(
+        pipeline, connector,
+        batch_size=args.batch_size,
+        frame_shape=tuple(args.frame_size),
+        flush_timeout=args.flush_ms / 1e3,
+        similarity_threshold=args.similarity_threshold,
+        subject_names=names,
+        metrics=metrics,
+    )
+    service.start()
+    try:
+        if args.source == "dir":
+            import json
+
+            import numpy as np
+
+            from opencv_facerecognizer_tpu.ops import image as image_ops
+            from opencv_facerecognizer_tpu.utils.dataset import _imread_gray
+
+            files = sorted(
+                f for f in os.listdir(args.dir)
+                if f.lower().endswith((".png", ".jpg", ".jpeg", ".pgm", ".bmp"))
+            )
+            for fn in files:
+                img = _imread_gray(os.path.join(args.dir, fn))
+                if img is None:
+                    continue
+                img = np.asarray(image_ops.resize(img, tuple(args.frame_size)))
+                connector.inject(FRAME_TOPIC, {**encode_frame(img), "meta": {"file": fn}})
+            deadline = time.monotonic() + 60
+            while (len(connector.messages(RESULT_TOPIC)) < len(files)
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            for message in connector.messages(RESULT_TOPIC):
+                print(json.dumps(message))
+        else:
+            while True:
+                time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        service.stop()
+        summary = metrics.summary()
+        if summary:
+            print(f"metrics: {summary}", file=sys.stderr)
+        if metrics_sink:
+            metrics_sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""``ocvf-train``: dataset dir -> validated, checkpointed model.
+
+The reference flow (SURVEY.md §3.1): walk folder-per-subject dataset,
+resize, fit Fisherfaces+NN, k-fold validate, save. Flags cover the §5.6
+config surface; ``--model cnn`` swaps in the ArcFace CNN backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ocvf-train", description="Train a face recognition model on TPU"
+    )
+    p.add_argument("dataset", help="dataset dir: one sub-folder of images per subject")
+    p.add_argument("model_path", help="output checkpoint path (.ckpt)")
+    p.add_argument("--model", default="fisherfaces",
+                   choices=["fisherfaces", "eigenfaces", "lbph", "cnn"])
+    p.add_argument("--image-size", type=int, nargs=2, default=(70, 70),
+                   metavar=("H", "W"))
+    p.add_argument("--kfold", type=int, default=3)
+    p.add_argument("--num-components", type=int, default=0)
+    p.add_argument("--knn-k", type=int, default=1)
+    p.add_argument("--no-tan-triggs", action="store_true")
+    p.add_argument("--embed-dim", type=int, default=128)
+    p.add_argument("--train-steps", type=int, default=200)
+    p.add_argument("--eigenfaces-plot", default=None,
+                   help="optional PNG path: render top subspace components")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer, TrainerConfig
+
+    config = TrainerConfig(
+        model=args.model,
+        image_size=tuple(args.image_size),
+        kfold=args.kfold,
+        num_components=args.num_components,
+        knn_k=args.knn_k,
+        tan_triggs=not args.no_tan_triggs,
+        embed_dim=args.embed_dim,
+        train_steps=args.train_steps,
+    )
+    trainer = TheTrainer(config)
+    model = trainer.train_from_dir(args.dataset, model_path=args.model_path)
+    if trainer.validation:
+        for result in trainer.validation.results:
+            print(result)
+        print(f"mean k-fold accuracy: {trainer.mean_accuracy:.4f}")
+    print(f"subjects: {model.subject_names}")
+    print(f"model saved to {args.model_path}")
+    if args.eigenfaces_plot:
+        from opencv_facerecognizer_tpu.models import Fisherfaces, PCA
+        from opencv_facerecognizer_tpu.models.operators import FeatureOperator
+        from opencv_facerecognizer_tpu.utils import visual
+
+        feature = model.feature
+        while isinstance(feature, FeatureOperator):
+            feature = feature.model2
+        if isinstance(feature, (PCA, Fisherfaces)):
+            path = visual.plot_eigenfaces(feature, tuple(args.image_size),
+                                          filename=args.eigenfaces_plot)
+            print(f"eigenfaces plot: {path}")
+        else:
+            print("eigenfaces plot skipped: model has no subspace components")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -253,6 +253,50 @@ class CNNFaceDetector:
     def params(self):
         return self._params
 
+    # -- checkpointing (msgpack, pickle-free, like utils.serialization) --
+
+    def save(self, path: str) -> None:
+        import json
+
+        from flax import serialization as flax_serialization
+
+        if self._params is None:
+            raise RuntimeError("CNNFaceDetector.save called before train()/load_params()")
+        payload = {
+            "header": {
+                "format_version": 1,
+                "config_json": json.dumps({
+                    "features": list(self.net.features),
+                    "head_features": self.net.head_features,
+                    "max_faces": self.max_faces,
+                    "score_threshold": self.score_threshold,
+                    "iou_threshold": self.iou_threshold,
+                }),
+            },
+            "params": jax.tree_util.tree_map(np.asarray, self._params),
+        }
+        with open(path, "wb") as fh:
+            fh.write(flax_serialization.msgpack_serialize(payload))
+
+    @classmethod
+    def load(cls, path: str) -> "CNNFaceDetector":
+        import json
+
+        from flax import serialization as flax_serialization
+
+        with open(path, "rb") as fh:
+            payload = flax_serialization.msgpack_restore(fh.read())
+        config = json.loads(payload["header"]["config_json"])
+        det = cls(
+            features=tuple(config["features"]),
+            head_features=config["head_features"],
+            max_faces=config["max_faces"],
+            score_threshold=config["score_threshold"],
+            iou_threshold=config["iou_threshold"],
+        )
+        det.load_params(jax.tree_util.tree_map(jnp.asarray, payload["params"]))
+        return det
+
     def detect_batch(self, images: jnp.ndarray):
         """[N, H, W] -> (boxes [N,K,4] yxyx, scores [N,K], valid [N,K]) on device."""
         if self._params is None:

@@ -103,6 +103,9 @@ class ShardedGallery:
         self._emb_sharding = NamedSharding(mesh, P(TP_AXIS, None))
         self._lab_sharding = NamedSharding(mesh, P())
         self._valid_sharding = NamedSharding(mesh, P(TP_AXIS))
+        self._host_emb = np.zeros((self.capacity, dim), np.float32)
+        self._host_lab = np.full((self.capacity,), labels_pad, np.int32)
+        self._host_val = np.zeros((self.capacity,), bool)
         self.embeddings = jax.device_put(
             jnp.zeros((self.capacity, dim), jnp.float32), self._emb_sharding
         )
@@ -127,22 +130,19 @@ class ShardedGallery:
             raise ValueError(
                 f"gallery overflow: size {self.size} + {n} > capacity {self.capacity}"
             )
-        # np.array (copy): np.asarray on a jax array gives a read-only view.
-        emb_host = np.array(self.embeddings)
-        lab_host = np.array(self.labels)
-        val_host = np.array(self.valid)
-        emb_host[self.size : self.size + n] = embeddings
-        lab_host[self.size : self.size + n] = np.asarray(labels, np.int32)
-        val_host[self.size : self.size + n] = True
-        self._install(emb_host, lab_host, val_host, self.size + n)
+        # Host mirrors are the source of truth for enrolment: a device
+        # readback here would trigger the axon backend's sync-poll mode
+        # (see module docstring of runtime.recognizer).
+        self._host_emb[self.size : self.size + n] = embeddings
+        self._host_lab[self.size : self.size + n] = np.asarray(labels, np.int32)
+        self._host_val[self.size : self.size + n] = True
+        self._install(self._host_emb, self._host_lab, self._host_val, self.size + n)
 
     def reset(self) -> None:
-        self._install(
-            np.zeros((self.capacity, self.dim), np.float32),
-            np.full((self.capacity,), self.labels_pad, np.int32),
-            np.zeros((self.capacity,), bool),
-            0,
-        )
+        self._host_emb = np.zeros((self.capacity, self.dim), np.float32)
+        self._host_lab = np.full((self.capacity,), self.labels_pad, np.int32)
+        self._host_val = np.zeros((self.capacity,), bool)
+        self._install(self._host_emb, self._host_lab, self._host_val, 0)
 
     def _install(self, emb: np.ndarray, lab: np.ndarray, val: np.ndarray, size: int) -> None:
         self.embeddings = jax.device_put(jnp.asarray(emb), self._emb_sharding)
@@ -151,10 +151,11 @@ class ShardedGallery:
         self.size = size
 
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+        """Host-mirror copies (no device readback)."""
         return (
-            np.asarray(self.embeddings),
-            np.asarray(self.labels),
-            np.asarray(self.valid),
+            self._host_emb.copy(),
+            self._host_lab.copy(),
+            self._host_val.copy(),
             self.size,
         )
 
@@ -166,6 +167,9 @@ class ShardedGallery:
         self.embeddings = other.embeddings
         self.labels = other.labels
         self.valid = other.valid
+        self._host_emb = other._host_emb
+        self._host_lab = other._host_lab
+        self._host_val = other._host_val
         self.size = other.size
 
     # ---- matching (device-side) ----

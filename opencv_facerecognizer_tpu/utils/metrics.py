@@ -1,0 +1,60 @@
+"""Structured per-batch metrics (SURVEY.md §5.5): counters + latency
+percentiles + a JSONL sink. The north-star metric (faces/sec/chip) falls out
+of the per-batch records."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, IO, Optional
+
+
+class Metrics:
+    """Thread-safe counters + bounded latency windows + optional JSONL sink."""
+
+    def __init__(self, sink: Optional[IO[str]] = None, window: int = 512):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._latencies: Dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
+        self._sink = sink
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._latencies[name].append(seconds)
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def percentile(self, name: str, q: float) -> float:
+        with self._lock:
+            values = sorted(self._latencies.get(name, ()))
+        if not values:
+            return float("nan")
+        idx = min(int(q / 100.0 * len(values)), len(values) - 1)
+        return values[idx]
+
+    def log(self, event: str, **fields) -> None:
+        if self._sink is None:
+            return
+        record = {"ts": time.time(), "event": event, **fields}
+        line = json.dumps(record)
+        with self._lock:
+            self._sink.write(line + "\n")
+            self._sink.flush()
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            for name, values in self._latencies.items():
+                if values:
+                    ordered = sorted(values)
+                    out[f"{name}_p50_ms"] = ordered[len(ordered) // 2] * 1e3
+                    out[f"{name}_p95_ms"] = ordered[min(int(0.95 * len(ordered)), len(ordered) - 1)] * 1e3
+        return out

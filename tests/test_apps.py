@@ -1,0 +1,123 @@
+"""CLI app tests: the reference's script surface (SURVEY.md §2.1
+"Packaging/CLI") driven through main(argv)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.apps import recognize as recognize_app
+from opencv_facerecognizer_tpu.apps import train as train_app
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces, make_synthetic_scenes
+
+
+def _write_dataset(root, images, labels, names):
+    import cv2
+
+    for name in names:
+        os.makedirs(os.path.join(root, name), exist_ok=True)
+    counters = {}
+    for img, label in zip(images, labels):
+        subject = names[label]
+        i = counters.get(subject, 0)
+        counters[subject] = i + 1
+        cv2.imwrite(os.path.join(root, subject, f"{i}.png"), img.astype(np.uint8))
+
+
+def test_train_app_classic(tmp_path, capsys):
+    X, y, names = make_synthetic_faces(4, 6, (32, 32), seed=51)
+    data_dir = str(tmp_path / "data")
+    _write_dataset(data_dir, X, y, names)
+    model_path = str(tmp_path / "model.ckpt")
+    plot_path = str(tmp_path / "eigen.png")
+    rc = train_app.main([
+        data_dir, model_path, "--model", "fisherfaces", "--image-size", "32", "32",
+        "--kfold", "2", "--eigenfaces-plot", plot_path,
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "mean k-fold accuracy" in out
+    assert os.path.exists(model_path)
+    assert os.path.exists(plot_path)
+
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    model = serialization.load_model(model_path)
+    assert model.subject_names == names
+
+
+def test_train_app_rejects_bad_dataset(tmp_path):
+    with pytest.raises((ValueError, FileNotFoundError)):
+        train_app.main([str(tmp_path / "nope"), str(tmp_path / "m.ckpt")])
+
+
+@pytest.mark.slow
+def test_recognize_app_dir_mode(tmp_path, capsys):
+    import cv2
+
+    # 1) train + save a tiny cnn model on face crops
+    X, y, names = make_synthetic_faces(3, 6, (32, 32), seed=53, noise=8.0)
+    data_dir = str(tmp_path / "gallery")
+    _write_dataset(data_dir, X, y, names)
+    model_path = str(tmp_path / "cnn.ckpt")
+    rc = train_app.main([
+        data_dir, model_path, "--model", "cnn", "--image-size", "32", "32",
+        "--kfold", "0", "--embed-dim", "32", "--train-steps", "30",
+    ])
+    assert rc == 0
+
+    # shrink the cnn for test speed: retrain tiny variant directly
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+
+    scenes, boxes, counts = make_synthetic_scenes(32, (96, 96), max_faces=2, seed=55)
+    det = CNNFaceDetector(features=(8, 16, 32), head_features=32, max_faces=4,
+                          score_threshold=0.25)
+    det.train(scenes, boxes, counts, steps=150, batch_size=16, learning_rate=2e-3)
+    det_path = str(tmp_path / "det.ckpt")
+    det.save(det_path)
+
+    # 2) frames dir to replay
+    frames_dir = str(tmp_path / "frames")
+    os.makedirs(frames_dir)
+    test_scenes, _, test_counts = make_synthetic_scenes(4, (96, 96), max_faces=2, seed=57)
+    for i, scene in enumerate(test_scenes):
+        cv2.imwrite(os.path.join(frames_dir, f"f{i}.png"), scene.astype(np.uint8))
+
+    rc = recognize_app.main([
+        "--model", model_path, "--detector", det_path, "--gallery", data_dir,
+        "--source", "dir", "--dir", frames_dir, "--frame-size", "96", "96",
+        "--batch-size", "4", "--similarity-threshold", "0.0",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(lines) == 4
+    results = [json.loads(l) for l in lines]
+    files = sorted(r["meta"]["file"] for r in results)
+    assert files == [f"f{i}.png" for i in range(4)]
+    for r in results:
+        for face in r["faces"]:
+            assert face["name"] in names or face["name"] == "unknown"
+
+
+def test_detector_checkpoint_roundtrip(tmp_path):
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+
+    scenes, boxes, counts = make_synthetic_scenes(8, (64, 64), max_faces=1, seed=59)
+    det = CNNFaceDetector(features=(8, 8, 16), head_features=16, max_faces=2)
+    det.train(scenes, boxes, counts, steps=10, batch_size=8)
+    path = str(tmp_path / "det.ckpt")
+    det.save(path)
+    restored = CNNFaceDetector.load(path)
+    assert restored.max_faces == 2
+    b1, s1, v1 = (np.asarray(v) for v in det.detect_batch(scenes[:2]))
+    b2, s2, v2 = (np.asarray(v) for v in restored.detect_batch(scenes[:2]))
+    np.testing.assert_allclose(b1, b2, atol=1e-5)
+    np.testing.assert_array_equal(v1, v2)
+
+
+def test_detector_save_before_train_raises(tmp_path):
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+
+    with pytest.raises(RuntimeError):
+        CNNFaceDetector().save(str(tmp_path / "x.ckpt"))
