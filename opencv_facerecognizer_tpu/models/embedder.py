@@ -233,7 +233,17 @@ class CNNEmbedding(AbstractFeature):
             X = np.stack([np.asarray(v) for v in X])
         x = np.asarray(normalize_faces(X, self.input_size))
         y = np.asarray(y, dtype=np.int32)
-        num_classes = int(y.max()) + 1 if len(y) else 1
+        # Remap to 0-based contiguous indices before sizing the ArcFace
+        # head: sparse labels ({5, 900}) must not allocate a 901-row head,
+        # and negative labels must not silently produce wrong one-hot rows.
+        # (The mapping itself isn't kept: the head is training-only scaffold;
+        # prediction goes through the classifier's own label handling.)
+        if len(y):
+            classes, y = np.unique(y, return_inverse=True)
+            y = y.astype(np.int32)
+            num_classes = len(classes)
+        else:
+            num_classes = 1
         params = self._params
         if params is None:
             params = init_embedder(self.net, num_classes, self.input_size, self.seed)

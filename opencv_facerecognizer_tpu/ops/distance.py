@@ -106,29 +106,47 @@ def histogram_intersection(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
     return -jnp.sum(jnp.minimum(pb, qb), axis=-1)
 
 
-def bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Bin Ratio Dissimilarity: sum (p-q)^2 / (p+q)^2."""
+def _brd_numerator(p: jnp.ndarray, q: jnp.ndarray):
+    """Shared bin-ratio pieces: per-pair cross factor a = |1 - <p,q>| (one
+    matmul) and the per-bin numerator (p-q)^2 + 2a*p*q, following the
+    upstream facerec-lineage BinRatioDistance definition — the cross term
+    couples every bin to the whole-vector dot product, which the plain
+    (p-q)^2/(p+q)^2 form drops (ADVICE round 1).
+
+    DOMAIN CAVEAT (applies upstream too): the formula assumes histograms
+    normalized to sum 1, where <p,q> <= 1 and a shrinks as vectors align.
+    On descriptors whose rows sum to S > 1 — e.g. SpatialHistogram output,
+    which L1-normalizes per grid cell so the concatenation sums to the cell
+    count — <p,q> can exceed 1 and a GROWS with correlation, which can
+    invert nearest-neighbor rankings. Rescale such features by 1/S (or use
+    chi_square) before trusting the BRD family."""
+    p2, q2 = _as_2d(p), _as_2d(q)
+    a = jnp.abs(1.0 - _mm(p2, q2.T))[:, :, None]  # [Q, G, 1]
     pb, qb = _broadcast_pair(p, q)
     d = pb - qb
-    s = jnp.maximum(pb + qb, _EPS)
-    return jnp.sum((d / s) * d / s, axis=-1)
+    num = d * d + 2.0 * a * pb * qb
+    return num, d, pb + qb
+
+
+def bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
+    """Bin Ratio Dissimilarity: sum ((p-q)^2 + 2|1-p.q| p q) / (p+q)^2."""
+    num, _, s = _brd_numerator(p, q)
+    s = jnp.maximum(s, _EPS)
+    return jnp.abs(jnp.sum(num / (s * s), axis=-1))
 
 
 def l1_bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """L1-weighted Bin Ratio Dissimilarity: sum |p-q| (p-q)^2 / (p+q)^2."""
-    pb, qb = _broadcast_pair(p, q)
-    d = pb - qb
-    s = jnp.maximum(pb + qb, _EPS)
-    return jnp.sum(jnp.abs(d) * (d / s) * (d / s), axis=-1)
+    """L1-weighted BRD: sum |p-q| ((p-q)^2 + 2|1-p.q| p q) / (p+q)^2."""
+    num, d, s = _brd_numerator(p, q)
+    s = jnp.maximum(s, _EPS)
+    return jnp.abs(jnp.sum(jnp.abs(d) * num / (s * s), axis=-1))
 
 
 def chi_square_bin_ratio(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:
-    """Chi-square-weighted Bin Ratio Dissimilarity: sum (p-q)^2/(p+q) * (p-q)^2/(p+q)^2."""
-    pb, qb = _broadcast_pair(p, q)
-    d = pb - qb
-    s = jnp.maximum(pb + qb, _EPS)
-    r = d / s
-    return jnp.sum((d * d / s) * r * r, axis=-1)
+    """Chi-square-weighted BRD: sum ((p-q)^2/(p+q)) ((p-q)^2 + 2|1-p.q| p q) / (p+q)^2."""
+    num, d, s = _brd_numerator(p, q)
+    s = jnp.maximum(s, _EPS)
+    return jnp.abs(jnp.sum((d * d / s) * num / (s * s), axis=-1))
 
 
 def manhattan(p: jnp.ndarray, q: jnp.ndarray) -> jnp.ndarray:

@@ -58,9 +58,15 @@ def histogram_equalize(x: jnp.ndarray, num_bins: int = 256) -> jnp.ndarray:
     idx = jnp.clip(x, 0.0, 255.0) * ((num_bins - 1) / 255.0)
     idx = jnp.round(idx).astype(jnp.int32)
     flat = idx.reshape(x.shape[:-2] + (n,))
-    # Histogram via one-hot matmul: [.., n] x [num_bins] -> [.., num_bins].
-    onehot = jax.nn.one_hot(flat, num_bins, dtype=jnp.float32)
-    hist = jnp.sum(onehot, axis=-2)
+    # Histogram via scatter-add, O(n) memory per image — a one-hot matmul
+    # here would materialize [.., H*W, num_bins] (64 MB f32 for one 256x256
+    # frame), a trap as soon as this runs on frames rather than 70x70 crops.
+    def _hist_1d(f):
+        return jnp.zeros((num_bins,), jnp.float32).at[f].add(1.0)
+
+    hist = jax.vmap(_hist_1d)(flat.reshape((-1, n))).reshape(
+        x.shape[:-2] + (num_bins,)
+    )
     cdf = jnp.cumsum(hist, axis=-1)
     cdf_min = jnp.take_along_axis(
         cdf, jnp.argmax((hist > 0).astype(jnp.int32), axis=-1)[..., None], axis=-1

@@ -22,7 +22,8 @@ Design:
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import threading
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -83,6 +84,22 @@ def match_global(q, g, valid, labels, *, k: int, mesh: Mesh):
     return top_labels, top_vals, top_gidx
 
 
+class GalleryData(NamedTuple):
+    """One immutable snapshot of the device-visible gallery state.
+
+    Reader side of the concurrency story: all reads go through a single
+    ``self._data`` attribute load (atomic at Python level), so a reader can
+    never observe a mixed snapshot (e.g. new valid mask against old
+    embeddings). Writer side: ``add``/``reset``/``swap_from`` serialize on
+    an internal lock, so concurrent enrolments can't both claim the same
+    rows."""
+
+    embeddings: jnp.ndarray  # [capacity, dim], P(tp, None)
+    labels: jnp.ndarray  # [capacity], replicated
+    valid: jnp.ndarray  # [capacity], P(tp)
+    size: int
+
+
 class ShardedGallery:
     """Enrolled gallery of L2-normalized embeddings, row-sharded over tp."""
 
@@ -98,7 +115,6 @@ class ShardedGallery:
         # Round capacity up so every tp shard is equal (static shapes).
         self.capacity = int(np.ceil(capacity / tp) * tp)
         self.dim = int(dim)
-        self.size = 0
         self.labels_pad = labels_pad
         self._emb_sharding = NamedSharding(mesh, P(TP_AXIS, None))
         self._lab_sharding = NamedSharding(mesh, P())
@@ -106,16 +122,41 @@ class ShardedGallery:
         self._host_emb = np.zeros((self.capacity, dim), np.float32)
         self._host_lab = np.full((self.capacity,), labels_pad, np.int32)
         self._host_val = np.zeros((self.capacity,), bool)
-        self.embeddings = jax.device_put(
-            jnp.zeros((self.capacity, dim), jnp.float32), self._emb_sharding
-        )
-        self.labels = jax.device_put(
-            jnp.full((self.capacity,), labels_pad, jnp.int32), self._lab_sharding
-        )
-        self.valid = jax.device_put(
-            jnp.zeros((self.capacity,), bool), self._valid_sharding
+        self._write_lock = threading.Lock()
+        self._data = GalleryData(
+            embeddings=jax.device_put(
+                jnp.zeros((self.capacity, dim), jnp.float32), self._emb_sharding
+            ),
+            labels=jax.device_put(
+                jnp.full((self.capacity,), labels_pad, jnp.int32), self._lab_sharding
+            ),
+            valid=jax.device_put(
+                jnp.zeros((self.capacity,), bool), self._valid_sharding
+            ),
+            size=0,
         )
         self._match_cache = {}
+
+    # Single-attribute snapshot: the only device-state read path.
+    @property
+    def data(self) -> GalleryData:
+        return self._data
+
+    @property
+    def embeddings(self) -> jnp.ndarray:
+        return self._data.embeddings
+
+    @property
+    def labels(self) -> jnp.ndarray:
+        return self._data.labels
+
+    @property
+    def valid(self) -> jnp.ndarray:
+        return self._data.valid
+
+    @property
+    def size(self) -> int:
+        return self._data.size
 
     # ---- enrolment (host-side; serving never blocks on these) ----
 
@@ -126,29 +167,36 @@ class ShardedGallery:
             np.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-12
         )
         n = embeddings.shape[0]
-        if self.size + n > self.capacity:
-            raise ValueError(
-                f"gallery overflow: size {self.size} + {n} > capacity {self.capacity}"
-            )
-        # Host mirrors are the source of truth for enrolment: a device
-        # readback here would trigger the axon backend's sync-poll mode
-        # (see module docstring of runtime.recognizer).
-        self._host_emb[self.size : self.size + n] = embeddings
-        self._host_lab[self.size : self.size + n] = np.asarray(labels, np.int32)
-        self._host_val[self.size : self.size + n] = True
-        self._install(self._host_emb, self._host_lab, self._host_val, self.size + n)
+        with self._write_lock:
+            size = self.size
+            if size + n > self.capacity:
+                raise ValueError(
+                    f"gallery overflow: size {size} + {n} > capacity {self.capacity}"
+                )
+            # Host mirrors are the source of truth for enrolment: a device
+            # readback here would trigger the axon backend's sync-poll mode
+            # (see module docstring of runtime.recognizer).
+            self._host_emb[size : size + n] = embeddings
+            self._host_lab[size : size + n] = np.asarray(labels, np.int32)
+            self._host_val[size : size + n] = True
+            self._install(self._host_emb, self._host_lab, self._host_val, size + n)
 
     def reset(self) -> None:
-        self._host_emb = np.zeros((self.capacity, self.dim), np.float32)
-        self._host_lab = np.full((self.capacity,), self.labels_pad, np.int32)
-        self._host_val = np.zeros((self.capacity,), bool)
-        self._install(self._host_emb, self._host_lab, self._host_val, 0)
+        with self._write_lock:
+            self._host_emb = np.zeros((self.capacity, self.dim), np.float32)
+            self._host_lab = np.full((self.capacity,), self.labels_pad, np.int32)
+            self._host_val = np.zeros((self.capacity,), bool)
+            self._install(self._host_emb, self._host_lab, self._host_val, 0)
 
     def _install(self, emb: np.ndarray, lab: np.ndarray, val: np.ndarray, size: int) -> None:
-        self.embeddings = jax.device_put(jnp.asarray(emb), self._emb_sharding)
-        self.labels = jax.device_put(jnp.asarray(lab), self._lab_sharding)
-        self.valid = jax.device_put(jnp.asarray(val), self._valid_sharding)
-        self.size = size
+        # Build the full snapshot first, publish with ONE attribute write —
+        # serving threads reading self._data never see a partial install.
+        self._data = GalleryData(
+            embeddings=jax.device_put(jnp.asarray(emb), self._emb_sharding),
+            labels=jax.device_put(jnp.asarray(lab), self._lab_sharding),
+            valid=jax.device_put(jnp.asarray(val), self._valid_sharding),
+            size=size,
+        )
 
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
         """Host-mirror copies (no device readback)."""
@@ -164,13 +212,13 @@ class ShardedGallery:
         the double-buffered reload path (SURVEY.md §5.3): build ``other``
         off to the side, then swap refs; in-flight match calls keep using
         the old arrays they captured."""
-        self.embeddings = other.embeddings
-        self.labels = other.labels
-        self.valid = other.valid
-        self._host_emb = other._host_emb
-        self._host_lab = other._host_lab
-        self._host_val = other._host_val
-        self.size = other.size
+        with self._write_lock:
+            self._host_emb = other._host_emb
+            self._host_lab = other._host_lab
+            self._host_val = other._host_val
+            # Device-visible swap is the single _data assignment (last, so
+            # the host mirrors are already consistent when readers see it).
+            self._data = other._data
 
     # ---- matching (device-side) ----
 
@@ -198,4 +246,5 @@ class ShardedGallery:
         dp = self.mesh.shape[DP_AXIS]
         if queries.shape[0] % dp:
             raise ValueError(f"query count {queries.shape[0]} not divisible by dp={dp}")
-        return self._matcher(int(k))(queries, self.embeddings, self.valid, self.labels)
+        data = self._data  # one snapshot read; never mix fields across writes
+        return self._matcher(int(k))(queries, data.embeddings, data.valid, data.labels)

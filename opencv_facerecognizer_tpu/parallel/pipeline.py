@@ -100,12 +100,12 @@ class RecognitionPipeline:
         key = frames.shape
         if key not in self._step_cache:
             self._step_cache[key] = self._build_step(*key)
-        g = self.gallery
+        data = self.gallery.data  # one atomic snapshot (see GalleryData)
         return self._step_cache[key](
             self.detector.params,
             self.embed_params,
-            g.embeddings,
-            g.valid,
-            g.labels,
+            data.embeddings,
+            data.valid,
+            data.labels,
             frames,
         )

@@ -50,7 +50,6 @@ STATUS_TOPIC = "ocvfacerec/status"
 
 @dataclass
 class _Enrolment:
-    subject_label: int
     subject_name: str
     needed: int
     crops: List[np.ndarray] = field(default_factory=list)
@@ -123,12 +122,10 @@ class RecognizerService:
             name = str(message.get("subject", f"subject_{len(self.subject_names)}"))
             count = int(message.get("count", 5))
             with self._enrol_lock:
-                if name in self.subject_names:
-                    label = self.subject_names.index(name)
-                else:
-                    label = len(self.subject_names)
-                    self.subject_names.append(name)
-                self._enrolment = _Enrolment(label, name, count)
+                # The label is assigned (and subject_names grown) only when
+                # _finish_enrolment succeeds — an abandoned or superseded
+                # enrolment must not leave a name with zero gallery rows.
+                self._enrolment = _Enrolment(name, count)
             self.connector.publish(STATUS_TOPIC, {"status": "enrolling", "subject": name,
                                                   "count": count})
         elif cmd == "stats":
@@ -279,16 +276,29 @@ class RecognizerService:
             emb = np.array(self._embed_chunk(self.pipeline.embed_params, padded))
             embeddings.append(emb[: len(part)])
         emb = np.concatenate(embeddings)
-        self.pipeline.gallery.add(
-            emb, np.full(len(emb), enrolment.subject_label, np.int32)
-        )
+        with self._enrol_lock:
+            if enrolment.subject_name in self.subject_names:
+                label = self.subject_names.index(enrolment.subject_name)
+            else:
+                label = len(self.subject_names)
+                self.subject_names.append(enrolment.subject_name)
+        try:
+            self.pipeline.gallery.add(emb, np.full(len(emb), label, np.int32))
+        except Exception:
+            # Roll back a name we just reserved: the gallery has no rows
+            # for it, so leaving it would skew label->name indices.
+            with self._enrol_lock:
+                if (label == len(self.subject_names) - 1
+                        and self.subject_names[label] == enrolment.subject_name):
+                    self.subject_names.pop()
+            raise
         self.metrics.incr("subjects_enrolled")
         self.connector.publish(
             STATUS_TOPIC,
             {
                 "status": "enrolled",
                 "subject": enrolment.subject_name,
-                "label": enrolment.subject_label,
+                "label": label,
                 "gallery_size": self.pipeline.gallery.size,
             },
         )

@@ -36,6 +36,33 @@ def _imread_gray(path: str) -> Optional[np.ndarray]:
         return None
 
 
+def _resize_gray(img: np.ndarray, image_size: Tuple[int, int]) -> np.ndarray:
+    """Host-side bilinear resize to (H, W). cv2 when importable, else PIL,
+    else the device resize — this environment ships no usable cv2, and the
+    CLI entry points always pass image_size, so the fallback chain is the
+    difference between the apps starting and an ImportError."""
+    h, w = int(image_size[0]), int(image_size[1])
+    if img.shape == (h, w):
+        return np.asarray(img, dtype=np.float32)
+    try:
+        import cv2
+
+        return cv2.resize(img, (w, h)).astype(np.float32)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+
+        resized = Image.fromarray(np.asarray(img, np.float32), mode="F").resize(
+            (w, h), Image.BILINEAR
+        )
+        return np.asarray(resized, dtype=np.float32)
+    except ImportError:
+        from opencv_facerecognizer_tpu.ops import image as image_ops
+
+        return np.asarray(image_ops.resize(img, (h, w)), dtype=np.float32)
+
+
 def read_images(
     path: str, image_size: Optional[Tuple[int, int]] = None
 ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
@@ -61,9 +88,7 @@ def read_images(
             if img is None:
                 continue
             if image_size is not None:
-                import cv2
-
-                img = cv2.resize(img, (image_size[1], image_size[0])).astype(np.float32)
+                img = _resize_gray(img, image_size)
             images.append(img)
             labels.append(label)
             count += 1

@@ -21,9 +21,15 @@ ORACLES = {
     "normalized_correlation": lambda p, q: 1.0 - np.corrcoef(p, q)[0, 1],
     "chi_square": lambda p, q: np.sum((p - q) ** 2 / (p + q)),
     "histogram_intersection": lambda p, q: -np.sum(np.minimum(p, q)),
-    "bin_ratio": lambda p, q: np.sum((p - q) ** 2 / (p + q) ** 2),
-    "l1_bin_ratio": lambda p, q: np.sum(np.abs(p - q) * (p - q) ** 2 / (p + q) ** 2),
-    "chi_square_brd": lambda p, q: np.sum(((p - q) ** 2 / (p + q)) * ((p - q) ** 2 / (p + q) ** 2)),
+    # Bin-ratio family: upstream-lineage formula with the 2|1-p.q|pq cross
+    # term (couples each bin to the whole-vector dot product).
+    "bin_ratio": lambda p, q: abs(np.sum(
+        ((p - q) ** 2 + 2 * abs(1 - np.dot(p, q)) * p * q) / (p + q) ** 2)),
+    "l1_bin_ratio": lambda p, q: abs(np.sum(
+        np.abs(p - q) * ((p - q) ** 2 + 2 * abs(1 - np.dot(p, q)) * p * q) / (p + q) ** 2)),
+    "chi_square_brd": lambda p, q: abs(np.sum(
+        ((p - q) ** 2 / (p + q))
+        * ((p - q) ** 2 + 2 * abs(1 - np.dot(p, q)) * p * q) / (p + q) ** 2)),
     "manhattan": lambda p, q: np.sum(np.abs(p - q)),
 }
 
@@ -45,8 +51,16 @@ def test_scalar_contract_on_vector_pair():
 
 
 def test_self_distance_is_minimal():
+    # The bin-ratio family's cross term assumes rows summing to 1 (the BRD
+    # papers' domain); self-minimality only holds there. NOTE this is NOT
+    # what SpatialHistogram emits (it normalizes per grid cell, so rows sum
+    # to the cell count) — see the domain caveat in ops/distance.py: BRD on
+    # such features needs a 1/S rescale first.
+    P_hist = P / P.sum(axis=1, keepdims=True)
+    brd_family = {"bin_ratio", "l1_bin_ratio", "chi_square_brd"}
     for name, cls in D.DISTANCES.items():
-        d = np.asarray(cls()(P, P))
+        data = P_hist if name in brd_family else P
+        d = np.asarray(cls()(data, data))
         # diagonal should be the row minimum (self is most similar)
         assert np.all(np.diag(d) <= d.min(axis=1) + 1e-4), name
 
