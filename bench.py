@@ -2,10 +2,38 @@
 match pipeline (the BASELINE.json:5 north-star metric; baseline target
 2000 faces/sec/chip on v5e).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
-supporting numbers on stderr. Runs on whatever jax.devices() offers (the
-driver runs it on the real chip; `JAX_PLATFORMS=axon` is already the
-environment default there).
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+Everything a reviewer needs to believe (or attack) the number goes to stderr
+and ``BENCH_DETAIL.json``:
+
+- analytic FLOPs of the compiled graph (XLA cost analysis) -> TFLOP/s and
+  MFU vs the 197 TFLOP/s bf16 peak of a v5e chip;
+- batch sweep {8, 32, 128};
+- DISTINCT pre-generated input batches cycled per iteration (no backend
+  same-buffer caching) — frames are synthetic scenes with real faces, and
+  the detector is briefly trained first, so "valid faces" is meaningful;
+- device compute timed by CHAINED DIFFERENCING (see below) — the only
+  defensible method on this backend;
+- the H2D transfer cost measured separately per batch size;
+- slot throughput (batch x max_faces slots — what the graph always
+  computes) reported separately from valid-face throughput (slots the
+  trained detector actually marked valid).
+
+TIMING METHOD — critical on the axon (tunneled PJRT) backend:
+``block_until_ready`` does NOT await execution here (measured: a 275-GFLOP
+matmul "blocks" in 0.03 ms, and a naive per-iteration timed loop yields
+>250% MFU at batch 128 — physically impossible). Forced readbacks would
+work but drop the process into ~100 ms sync-poll mode, quantizing every
+later measurement. So device compute is timed by running the fused step K1
+and K2 times CHAINED inside one jit (iteration i's frames carry a 1e-30-
+scaled dependency on iteration i-1's outputs, forcing serialization), with
+one tiny readback at the end; (T(K2) - T(K1)) / (K2 - K1) cancels the fixed
+dispatch+sync overhead and yields true sustained per-batch time. The method
+reproduces 218 TFLOP/s on a bare 4096^3 bf16 matmul (nominal peak 197) —
+calibration within instrument error. Per-iteration latency percentiles are
+NOT reported for device compute (they would be dispatch-latency fiction);
+end-to-end serving latency lives in bench_serving.py, where readbacks are
+part of the path being measured.
 """
 
 import json
@@ -18,84 +46,244 @@ import jax
 import jax.numpy as jnp
 
 BASELINE_FACES_PER_SEC = 2000.0
+V5E_BF16_PEAK_TFLOPS = 197.0
+BATCH_SWEEP = (8, 32, 128)
+HEADLINE_BATCH = 32
+DISTINCT_INPUTS = 8
+CHAIN_K1, CHAIN_K2 = 4, 34  # chained-differencing iteration counts
+H2D_ITERS = 20
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _graph_flops(compiled) -> float:
+    """Analytic FLOPs of a compiled executable via XLA cost analysis."""
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        return float(ca.get("flops", float("nan")))
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort per backend
+        return float("nan")
 
 
 def main():
     from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector, decode_detections
-    from opencv_facerecognizer_tpu.models.embedder import FaceEmbedNet, init_embedder, normalize_faces
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder, normalize_faces,
+    )
     from opencv_facerecognizer_tpu.ops import image as image_ops
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
 
     dev = jax.devices()[0]
-    print(f"device: {dev}", file=sys.stderr)
+    _log(f"device: {dev}")
 
-    # Serving-shaped workload: VGA-ish frames, 8 face slots each, 112x112
+    # Serving-shaped workload: 256x256 frames, 8 face slots each, 112x112
     # aligned crops, 128-d embeddings vs a 16k gallery in HBM.
-    batch, height, width = 32, 256, 256
+    height, width = 256, 256
     face_size = (112, 112)
     max_faces = 8
     gallery_size, embed_dim = 16384, 128
 
     det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3)
-    det_params = det.net.init(jax.random.PRNGKey(0), jnp.zeros((1, height, width)))["params"]
     net = FaceEmbedNet(embed_dim=embed_dim)
     emb_params = init_embedder(net, num_classes=64, input_shape=face_size, seed=0)["net"]
+
+    # Brief detector training on synthetic scenes so the valid-face numbers
+    # mean something (an untrained detector on noise detects ~nothing).
+    t0 = time.perf_counter()
+    train_scenes, train_boxes, train_counts = make_synthetic_scenes(
+        num_scenes=64, scene_size=(height, width), max_faces=max_faces,
+        face_size_range=(24, 56), seed=7,
+    )
+    det.train(train_scenes, train_boxes, train_counts, steps=200, batch_size=16)
+    _log(f"detector warm-trained in {time.perf_counter() - t0:.1f}s")
 
     rng = np.random.default_rng(0)
     gallery = rng.normal(size=(gallery_size, embed_dim)).astype(np.float32)
     gallery /= np.linalg.norm(gallery, axis=-1, keepdims=True)
     labels = rng.integers(0, 512, size=gallery_size).astype(np.int32)
-
-    @jax.jit
-    def step(det_params, emb_params, gallery, labels, frames):
-        outputs = det.net.apply({"params": det_params}, frames)
-        boxes, det_scores, valid = decode_detections(
-            outputs, max_faces, det.score_threshold, det.iou_threshold
-        )
-        crops = image_ops.batched_crop_resize(frames, boxes, face_size)
-        flat = crops.reshape((batch * max_faces, *face_size))
-        emb = net.apply({"params": emb_params}, normalize_faces(flat, face_size))
-        sims = jax.lax.dot_general(
-            emb.astype(jnp.bfloat16), gallery.astype(jnp.bfloat16),
-            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-        )
-        top_sims, top_idx = jax.lax.top_k(sims, 1)
-        return boxes, valid, jnp.take(labels, top_idx), top_sims
-
-    frames = jnp.asarray(rng.uniform(0, 255, size=(batch, height, width)).astype(np.float32))
     g = jnp.asarray(gallery)
-    l = jnp.asarray(labels)
+    lab = jnp.asarray(labels)
+    det_params = det.params
 
-    t0 = time.perf_counter()
-    out = step(det_params, emb_params, g, l, frames)
-    jax.block_until_ready(out)
-    compile_s = time.perf_counter() - t0
-    print(f"first call (incl compile): {compile_s:.1f}s", file=sys.stderr)
+    def make_step(batch):
+        def step(det_params, emb_params, gallery, labels, frames):
+            outputs = det.net.apply({"params": det_params}, frames)
+            boxes, det_scores, valid = decode_detections(
+                outputs, max_faces, det.score_threshold, det.iou_threshold
+            )
+            crops = image_ops.batched_crop_resize(frames, boxes, face_size)
+            flat = crops.reshape((batch * max_faces, *face_size))
+            emb = net.apply({"params": emb_params}, normalize_faces(flat, face_size))
+            sims = jax.lax.dot_general(
+                emb.astype(jnp.bfloat16), gallery.astype(jnp.bfloat16),
+                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            )
+            top_sims, top_idx = jax.lax.top_k(sims, 1)
+            return boxes, valid, jnp.take(labels, top_idx), top_sims
 
-    # Steady state: timed loop, per-batch latencies for p50.
-    iters = 30
-    lat = []
-    for _ in range(iters):
+        return step
+
+    def make_chained(batch, step):
+        """K serialized runs of ``step`` in ONE jit: frames for iteration i
+        carry a negligible (1e-30-scaled) dependency on iteration i-1's
+        outputs, so XLA cannot overlap or elide any of them. Returns a tiny
+        accumulator whose readback forces completion of the whole chain."""
+
+        def chained(det_params, emb_params, gallery, labels, frames_stack, k):
+            def body(i, carry):
+                dep, acc = carry
+                frames = jax.lax.dynamic_index_in_dim(
+                    frames_stack, i % DISTINCT_INPUTS, axis=0, keepdims=False
+                )
+                boxes, valid, top_labels, top_sims = step(
+                    det_params, emb_params, gallery, labels, frames + dep
+                )
+                dep = (jnp.sum(top_sims) + jnp.sum(boxes)) * 1e-30
+                acc = acc + jnp.sum(valid) + dep
+                return dep, acc
+
+            _, acc = jax.lax.fori_loop(0, k, body, (jnp.float32(0.0), jnp.float32(0.0)))
+            return acc
+
+        return jax.jit(chained, static_argnums=5)
+
+    detail = {"device": str(dev), "config": {
+        "frame": [height, width], "max_faces": max_faces, "face_size": list(face_size),
+        "gallery_size": gallery_size, "embed_dim": embed_dim,
+        "distinct_inputs": DISTINCT_INPUTS,
+        "chain_k": [CHAIN_K1, CHAIN_K2], "h2d_iters": H2D_ITERS,
+        "bf16_peak_tflops": V5E_BF16_PEAK_TFLOPS,
+        "timing_method": "chained differencing (see bench.py module docstring)",
+    }, "sweep": {}}
+    headline = None
+
+    # -- pass 0: DISTINCT input batches per batch size (different seeds) --
+    all_host = {}
+    all_dev = {}
+    for batch in BATCH_SWEEP:
+        host_inputs = []
+        dev_inputs = []
+        for i in range(DISTINCT_INPUTS):
+            scenes, _, _ = make_synthetic_scenes(
+                num_scenes=batch, scene_size=(height, width), max_faces=max_faces,
+                face_size_range=(24, 56), seed=100 + i,
+            )
+            host_inputs.append(np.asarray(scenes, np.float32))
+            dev_inputs.append(jax.device_put(jnp.asarray(scenes, jnp.float32)))
+        all_host[batch] = host_inputs
+        all_dev[batch] = dev_inputs
+
+    # -- pass 1: H2D transfer timing for ALL batch sizes, BEFORE any D2H
+    # readback happens (the first readback flips this backend into ~100 ms
+    # sync-poll mode, which would quantize these measurements) --
+    for batch in BATCH_SWEEP:
+        h2d_lat = []
+        for it in range(H2D_ITERS):
+            arr = all_host[batch][it % DISTINCT_INPUTS]
+            t0 = time.perf_counter()
+            frames = jax.device_put(arr)
+            jax.block_until_ready(frames)
+            h2d_lat.append(time.perf_counter() - t0)
+        h2d_lat = np.asarray(h2d_lat)
+        frame_mb = batch * height * width * 4 / 1e6
+        detail["sweep"][str(batch)] = {"h2d_transfer": {
+            "mb_per_batch": round(frame_mb, 2),
+            "p50_ms": round(float(np.percentile(h2d_lat, 50) * 1e3), 3),
+            "p99_ms": round(float(np.percentile(h2d_lat, 99) * 1e3), 3),
+            "mean_ms": round(float(h2d_lat.mean()) * 1e3, 3),
+            "gb_per_s": round(frame_mb / 1e3 / float(h2d_lat.mean()), 3),
+        }}
+        _log(f"[batch {batch}] h2d {h2d_lat.mean() * 1e3:.2f} ms/batch "
+             f"({frame_mb / 1e3 / h2d_lat.mean():.3f} GB/s)")
+
+    # -- pass 2: compile + chained-differencing device compute + valid runs --
+    for batch in BATCH_SWEEP:
+        step = make_step(batch)
         t0 = time.perf_counter()
-        out = step(det_params, emb_params, g, l, frames)
-        jax.block_until_ready(out)
-        lat.append(time.perf_counter() - t0)
-    lat = np.asarray(lat)
-    faces_per_batch = batch * max_faces
-    faces_per_sec = faces_per_batch / lat.mean()
-    p50_ms = float(np.percentile(lat, 50) * 1e3)
-    print(
-        f"steady: {faces_per_sec:,.0f} faces/sec/chip "
-        f"({batch} frames x {max_faces} slots, p50 {p50_ms:.2f} ms/batch, "
-        f"gallery {gallery_size})",
-        file=sys.stderr,
-    )
+        compiled = jax.jit(step).lower(
+            det_params, emb_params, g, lab, all_dev[batch][0]
+        ).compile()
+        flops = _graph_flops(compiled)
+        compile_s = time.perf_counter() - t0
 
+        frames_stack = jnp.stack(all_dev[batch])  # [DISTINCT_INPUTS, B, H, W]
+        chained = make_chained(batch, step)
+
+        def timed_chain(k):
+            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+            _ = np.asarray(acc)  # warm: compile this k
+            t0 = time.perf_counter()
+            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+            _ = np.asarray(acc)  # forces completion of the whole chain
+            return time.perf_counter() - t0
+
+        t_k1 = timed_chain(CHAIN_K1)
+        t_k2 = timed_chain(CHAIN_K2)
+        mean_s = max((t_k2 - t_k1) / (CHAIN_K2 - CHAIN_K1), 1e-9)
+        slot_tput = batch * max_faces / mean_s
+        tflops = flops / mean_s / 1e12 if np.isfinite(flops) else float("nan")
+        mfu = tflops / V5E_BF16_PEAK_TFLOPS if np.isfinite(tflops) else float("nan")
+
+        # valid-slot fraction: one untimed run per distinct input
+        valid_frac = float(np.mean([
+            np.asarray(compiled(det_params, emb_params, g, lab, frames)[1]).mean()
+            for frames in all_dev[batch]
+        ]))
+        valid_tput = slot_tput * valid_frac
+
+        entry = detail["sweep"][str(batch)]
+        h2d_mean_s = entry["h2d_transfer"]["mean_ms"] / 1e3
+        entry.update({
+            "compile_s": round(compile_s, 2),
+            "analytic_gflop_per_batch": round(flops / 1e9, 3) if np.isfinite(flops) else None,
+            "valid_slot_fraction": round(valid_frac, 4),
+            "device_compute": {
+                "method": f"chained diff (K={CHAIN_K1} vs {CHAIN_K2}, one readback each)",
+                "chain_times_s": [round(t_k1, 4), round(t_k2, 4)],
+                "mean_ms_per_batch": round(mean_s * 1e3, 3),
+                "slot_throughput_per_s": round(slot_tput, 1),
+                "valid_face_throughput_per_s": round(valid_tput, 1),
+                "tflops_per_s": round(tflops, 2) if np.isfinite(tflops) else None,
+                "mfu_vs_bf16_peak": round(mfu, 4) if np.isfinite(mfu) else None,
+            },
+            "e2e_estimate": {
+                "note": "device compute + H2D transfer, serialized; the "
+                        "serving runtime overlaps these, so this is an "
+                        "upper bound per batch",
+                "ms_per_batch": round((mean_s + h2d_mean_s) * 1e3, 3),
+                "valid_face_throughput_per_s": round(
+                    batch * max_faces * valid_frac / (mean_s + h2d_mean_s), 1
+                ),
+            },
+        })
+        _log(f"[batch {batch}] compile {compile_s:.1f}s, "
+             f"{flops / 1e9:.1f} GFLOP/batch; device {mean_s * 1e3:.3f} ms/batch "
+             f"-> {slot_tput:,.0f} slots/s, {tflops:.1f} TFLOP/s, MFU {mfu:.1%}; "
+             f"valid {valid_frac:.3f} -> {valid_tput:,.0f} faces/s")
+        if batch == HEADLINE_BATCH:
+            headline = valid_tput
+
+    with open("BENCH_DETAIL.json", "w") as fh:
+        json.dump(detail, fh, indent=2)
+    _log("wrote BENCH_DETAIL.json")
+
+    hb = detail["sweep"][str(HEADLINE_BATCH)]
     print(json.dumps({
-        "metric": "faces/sec/chip (fused detect-align-embed-match, 256x256 frames, "
-                  "8 slots, 16k gallery)",
-        "value": round(float(faces_per_sec), 1),
+        "metric": (
+            f"detected faces/sec/chip, fused detect-align-embed-match "
+            f"(256x256 scene frames, {max_faces} slots, 16k gallery, batch "
+            f"{HEADLINE_BATCH}, distinct inputs, trained detector, chained-"
+            f"diff timing; valid-slot fraction {hb['valid_slot_fraction']}, "
+            f"slot throughput {hb['device_compute']['slot_throughput_per_s']:,.0f}/s, "
+            f"MFU {hb['device_compute']['mfu_vs_bf16_peak']}, "
+            f"h2d {hb['h2d_transfer']['mean_ms']} ms/batch separate)"
+        ),
+        "value": round(float(headline), 1),
         "unit": "faces/s",
-        "vs_baseline": round(float(faces_per_sec) / BASELINE_FACES_PER_SEC, 3),
+        "vs_baseline": round(float(headline) / BASELINE_FACES_PER_SEC, 3),
     }))
 
 

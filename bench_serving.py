@@ -1,0 +1,187 @@
+"""End-to-end serving latency under offered load (VERDICT round-1 item #8).
+
+Drives the full RecognizerService path — connector -> FrameBatcher ->
+fused device pipeline -> async readback -> result publish — at fixed
+offered frame rates and records the user-visible latency per frame
+(send time -> result publish time), INCLUDING batching delay, device
+compute, and device->host readback. This is the path the <15 ms p50
+north-star target (BASELINE.json:5) is about; bench.py measures the bare
+device step.
+
+Prints one JSON line per offered rate and writes BENCH_SERVING.json.
+
+Caveat recorded in the artifact: on this box the chip sits behind a
+tunneled PJRT backend whose first device->host readback puts the process
+into ~100 ms sync-poll mode (see runtime/recognizer.py docstring) — an
+artifact of the tunnel, not the chip; the service's async-readback design
+exists precisely to amortize it (latency stays flat as offered load grows).
+
+Run:  PYTHONPATH=. python bench_serving.py [--rates 50 200 500]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def build_stack(frame_hw=(256, 256), batch_size=8, flush_ms=10.0,
+                gallery_size=1024):
+    import jax
+
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import FaceEmbedNet, init_embedder
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    h, w = frame_hw
+    det = CNNFaceDetector(max_faces=8, score_threshold=0.3)
+    scenes, boxes, counts = make_synthetic_scenes(
+        num_scenes=48, scene_size=(h, w), max_faces=8,
+        face_size_range=(24, 56), seed=7,
+    )
+    det.train(scenes, boxes, counts, steps=150, batch_size=16)
+
+    net = FaceEmbedNet(embed_dim=128)
+    emb_params = init_embedder(net, num_classes=16, input_shape=(112, 112),
+                               seed=0)["net"]
+    rng = np.random.default_rng(0)
+    gal_emb = rng.normal(size=(gallery_size, 128)).astype(np.float32)
+    mesh = make_mesh()
+    gallery = ShardedGallery(capacity=gallery_size, dim=128, mesh=mesh)
+    gallery.add(gal_emb, rng.integers(0, 64, gallery_size).astype(np.int32))
+    pipeline = RecognitionPipeline(det, net, emb_params, gallery,
+                                   face_size=(112, 112))
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipeline, connector, batch_size=batch_size, frame_shape=(h, w),
+        flush_timeout=flush_ms / 1e3, similarity_threshold=0.0,
+        metrics=Metrics(),
+    )
+    # Distinct frames to cycle (no same-buffer effects).
+    frames = [np.asarray(s, np.float32) for s in make_synthetic_scenes(
+        num_scenes=16, scene_size=(h, w), max_faces=8,
+        face_size_range=(24, 56), seed=9,
+    )[0]]
+    return service, connector, frames
+
+
+def drive_rate(service, connector, frames, rate_hz: float, duration_s: float):
+    """Offer frames at rate_hz for duration_s; return latency stats."""
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC,
+    )
+
+    done = {}
+    lock = threading.Lock()
+
+    def on_result(topic, message):
+        seq = (message.get("meta") or {}).get("seq")
+        if seq is not None:
+            with lock:
+                done[seq] = time.perf_counter()
+
+    connector.subscribe(RESULT_TOPIC, on_result)
+
+    sent = {}
+    interval = 1.0 / rate_hz
+    n = int(duration_s * rate_hz)
+    start = time.perf_counter()
+    for i in range(n):
+        target = start + i * interval
+        now = time.perf_counter()
+        if target > now:
+            time.sleep(target - now)
+        sent[i] = time.perf_counter()
+        connector.inject(FRAME_TOPIC, {"frame": frames[i % len(frames)],
+                                       "meta": {"seq": i}})
+    # allow the tail to drain
+    deadline = time.perf_counter() + 10.0
+    while time.perf_counter() < deadline:
+        with lock:
+            if len(done) >= n:
+                break
+        time.sleep(0.02)
+
+    with lock:
+        lat = np.asarray([
+            (done[i] - sent[i]) * 1e3 for i in sent if i in done
+        ])
+    completed = len(lat)
+    stats = {
+        "offered_hz": rate_hz,
+        "offered_frames": n,
+        "completed_frames": completed,
+        "dropped_frames": n - completed,
+        "achieved_hz": round(completed / duration_s, 1),
+    }
+    if completed:
+        stats.update({
+            "e2e_p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "e2e_p90_ms": round(float(np.percentile(lat, 90)), 2),
+            "e2e_p99_ms": round(float(np.percentile(lat, 99)), 2),
+            "e2e_mean_ms": round(float(lat.mean()), 2),
+        })
+    return stats
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rates", type=float, nargs="+",
+                        default=[25.0, 50.0, 100.0, 200.0])
+    parser.add_argument("--duration", type=float, default=10.0)
+    # Tunnel-aware defaults: one device round-trip is ~300 ms here, so
+    # serve full-ish batches (32) and let frames pool up to 100 ms — tiny
+    # flushes would burn a whole round-trip per frame.
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--flush-ms", type=float, default=100.0)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    print("building stack (detector warm-training)...", file=sys.stderr)
+    service, connector, frames = build_stack(
+        batch_size=args.batch_size, flush_ms=args.flush_ms
+    )
+    service.start(warmup=True)
+    try:
+        results = []
+        for rate in args.rates:
+            print(f"offered rate {rate} frames/s x {args.duration}s ...",
+                  file=sys.stderr)
+            stats = drive_rate(service, connector, frames, rate, args.duration)
+            stats["faces_found"] = service.metrics.counter("faces_found")
+            results.append(stats)
+            print(json.dumps(stats))
+    finally:
+        service.stop()
+
+    artifact = {
+        "device": str(jax.devices()[0]),
+        "config": {"batch_size": args.batch_size,
+                   "flush_ms": args.flush_ms,
+                   "frame": [256, 256], "duration_s": args.duration},
+        "note": ("end-to-end: connector->batcher->fused device call->async "
+                 "readback->publish; includes batching delay and D2H. The "
+                 "tunneled backend's ~100 ms sync-poll readback floor is an "
+                 "environment artifact the async drain amortizes."),
+        "rates": results,
+        "metrics": service.metrics.summary(),
+    }
+    with open("BENCH_SERVING.json", "w") as fh:
+        json.dump(artifact, fh, indent=2)
+    print("wrote BENCH_SERVING.json", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,8 +30,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--detector", required=True, help="detector checkpoint (CNNFaceDetector.save)")
     p.add_argument("--gallery", required=True,
                    help="dataset dir to enroll at startup (folder per subject)")
-    p.add_argument("--source", choices=["jsonl", "dir"], default="jsonl")
+    p.add_argument("--source", choices=["jsonl", "socket", "dir"], default="jsonl")
     p.add_argument("--dir", help="image directory for --source dir")
+    p.add_argument("--port", type=int, default=5600,
+                   help="TCP port for --source socket (JSONL over TCP)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address for --source socket")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler trace of the first "
+                        "--profile-batches batches into this directory "
+                        "(open with TensorBoard or xprof)")
+    p.add_argument("--profile-batches", type=int, default=20)
     p.add_argument("--frame-size", type=int, nargs=2, default=(256, 256), metavar=("H", "W"))
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--flush-ms", type=float, default=30.0)
@@ -76,7 +85,7 @@ def _load_stack(args):
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from opencv_facerecognizer_tpu.runtime.connector import (
-        FakeConnector, JSONLConnector, encode_frame,
+        FakeConnector, JSONLConnector, SocketConnector, encode_frame,
     )
     from opencv_facerecognizer_tpu.runtime.recognizer import (
         FRAME_TOPIC, RESULT_TOPIC, RecognizerService,
@@ -89,6 +98,8 @@ def main(argv=None) -> int:
 
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout)
+    elif args.source == "socket":
+        connector = SocketConnector(host=args.host, port=args.port, listen=True)
     else:
         connector = FakeConnector()
 
@@ -102,6 +113,26 @@ def main(argv=None) -> int:
         metrics=metrics,
     )
     service.start()
+
+    profiling = False
+    if args.profile_dir:
+        import jax
+
+        # Post-warmup so the trace shows steady-state device work, not the
+        # one-off XLA compiles (SURVEY.md §5.1; read with TensorBoard's
+        # profile plugin or xprof pointed at the directory).
+        jax.profiler.start_trace(args.profile_dir)
+        profiling = True
+
+    def _stop_profile_if_due() -> None:
+        nonlocal profiling
+        if profiling and metrics.counter("batches_dispatched") >= args.profile_batches:
+            import jax
+
+            jax.profiler.stop_trace()
+            profiling = False
+            print(f"profile trace written to {args.profile_dir}", file=sys.stderr)
+
     try:
         if args.source == "dir":
             import json
@@ -124,15 +155,25 @@ def main(argv=None) -> int:
             deadline = time.monotonic() + 60
             while (len(connector.messages(RESULT_TOPIC)) < len(files)
                    and time.monotonic() < deadline):
+                _stop_profile_if_due()
                 time.sleep(0.05)
             for message in connector.messages(RESULT_TOPIC):
                 print(json.dumps(message))
         else:
-            while True:
-                time.sleep(0.5)
+            # Serve until the input stream/socket ends (stdin EOF terminates
+            # the process instead of spinning forever) or Ctrl-C; then let
+            # every frame already accepted finish and publish before the
+            # teardown in `finally` discards the queues.
+            while not connector.eof.wait(timeout=0.5):
+                _stop_profile_if_due()
+            service.drain()
     except KeyboardInterrupt:
         pass
     finally:
+        if profiling:
+            import jax
+
+            jax.profiler.stop_trace()
         service.stop()
         summary = metrics.summary()
         if summary:

@@ -29,6 +29,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--train-steps", type=int, default=200)
     p.add_argument("--eigenfaces-plot", default=None,
                    help="optional PNG path: render top subspace components")
+    p.add_argument("--profile-dir",
+                   help="capture a jax.profiler trace of the whole train+"
+                        "validate run into this directory (open with "
+                        "TensorBoard or xprof)")
     return p
 
 
@@ -47,7 +51,18 @@ def main(argv=None) -> int:
         train_steps=args.train_steps,
     )
     trainer = TheTrainer(config)
-    model = trainer.train_from_dir(args.dataset, model_path=args.model_path)
+    if args.profile_dir:
+        import jax
+
+        jax.profiler.start_trace(args.profile_dir)
+    try:
+        model = trainer.train_from_dir(args.dataset, model_path=args.model_path)
+    finally:
+        if args.profile_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"profile trace written to {args.profile_dir}", file=sys.stderr)
     if trainer.validation:
         for result in trainer.validation.results:
             print(result)

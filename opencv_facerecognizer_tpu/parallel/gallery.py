@@ -123,6 +123,7 @@ class ShardedGallery:
         self._host_lab = np.full((self.capacity,), labels_pad, np.int32)
         self._host_val = np.zeros((self.capacity,), bool)
         self._write_lock = threading.Lock()
+        self.grow_count = 0
         self._data = GalleryData(
             embeddings=jax.device_put(
                 jnp.zeros((self.capacity, dim), jnp.float32), self._emb_sharding
@@ -161,7 +162,17 @@ class ShardedGallery:
     # ---- enrolment (host-side; serving never blocks on these) ----
 
     def add(self, embeddings: np.ndarray, labels: np.ndarray) -> None:
-        """Append L2-normalized rows; raises when capacity would overflow."""
+        """Append L2-normalized rows, auto-growing on overflow.
+
+        Growth doubles capacity (tp-aligned) and installs the bigger
+        arrays — the same double-buffered install as ``swap_from``, so
+        serving threads keep matching against the old snapshot until the
+        new one is published. The static-shape change means the matcher
+        (and the fused pipeline step) recompile once on the next call;
+        ``grow_count`` exposes how often that happened so operators can
+        pre-size ``capacity`` instead (a mid-serving XLA compile stalls
+        that batch by seconds on real hardware).
+        """
         embeddings = np.asarray(embeddings, np.float32)
         embeddings = embeddings / np.maximum(
             np.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-12
@@ -170,9 +181,7 @@ class ShardedGallery:
         with self._write_lock:
             size = self.size
             if size + n > self.capacity:
-                raise ValueError(
-                    f"gallery overflow: size {size} + {n} > capacity {self.capacity}"
-                )
+                self._grow_locked(size + n)
             # Host mirrors are the source of truth for enrolment: a device
             # readback here would trigger the axon backend's sync-poll mode
             # (see module docstring of runtime.recognizer).
@@ -180,6 +189,25 @@ class ShardedGallery:
             self._host_lab[size : size + n] = np.asarray(labels, np.int32)
             self._host_val[size : size + n] = True
             self._install(self._host_emb, self._host_lab, self._host_val, size + n)
+
+    def _grow_locked(self, needed: int) -> None:
+        """Double capacity (tp-aligned) until ``needed`` rows fit; caller
+        holds the write lock."""
+        tp = self.mesh.shape[TP_AXIS]
+        new_capacity = max(self.capacity, 1)
+        while new_capacity < needed:
+            new_capacity *= 2
+        new_capacity = int(np.ceil(new_capacity / tp) * tp)
+        emb = np.zeros((new_capacity, self.dim), np.float32)
+        lab = np.full((new_capacity,), self.labels_pad, np.int32)
+        val = np.zeros((new_capacity,), bool)
+        emb[: self.capacity] = self._host_emb
+        lab[: self.capacity] = self._host_lab
+        val[: self.capacity] = self._host_val
+        self._host_emb, self._host_lab, self._host_val = emb, lab, val
+        self.capacity = new_capacity
+        self._match_cache.clear()  # compiled for the old static shape
+        self.grow_count += 1
 
     def reset(self) -> None:
         with self._write_lock:
@@ -212,7 +240,13 @@ class ShardedGallery:
         the double-buffered reload path (SURVEY.md §5.3): build ``other``
         off to the side, then swap refs; in-flight match calls keep using
         the old arrays they captured."""
+        if other.dim != self.dim:
+            raise ValueError(f"dim mismatch: {other.dim} != {self.dim}")
         with self._write_lock:
+            if other.capacity != self.capacity:
+                # Different static shape: cached matchers no longer apply.
+                self.capacity = other.capacity
+                self._match_cache.clear()
             self._host_emb = other._host_emb
             self._host_lab = other._host_lab
             self._host_val = other._host_val

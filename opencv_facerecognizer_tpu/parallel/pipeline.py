@@ -19,6 +19,7 @@ from typing import Any, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from opencv_facerecognizer_tpu.models import detector as detector_mod
@@ -34,6 +35,35 @@ class RecognitionResult(NamedTuple):
     valid: jnp.ndarray  # [B, K] bool
     labels: jnp.ndarray  # [B, K, k] gallery labels, best first
     similarities: jnp.ndarray  # [B, K, k] cosine similarity
+
+
+def pack_result(result: "RecognitionResult") -> jnp.ndarray:
+    """[B, K, 6 + 2k] f32: boxes | det_score | valid | labels | sims.
+
+    One output array instead of five: on a tunneled backend every blocking
+    device->host readback pays a ~100 ms sync-poll floor (measured: 5
+    separate readbacks 503 ms/batch, 1 packed readback 105 ms/batch), so
+    the serving loop reads back exactly one array per batch. Labels ride
+    as f32 (exact for values < 2^24 — far beyond any gallery capacity).
+    """
+    return jnp.concatenate([
+        result.boxes,
+        result.det_scores[..., None],
+        result.valid[..., None].astype(jnp.float32),
+        result.labels.astype(jnp.float32),
+        result.similarities,
+    ], axis=-1)
+
+
+def unpack_result(packed: np.ndarray, top_k: int) -> RecognitionResult:
+    """Host-side inverse of ``pack_result`` (numpy views, no copies)."""
+    return RecognitionResult(
+        boxes=packed[..., 0:4],
+        det_scores=packed[..., 4],
+        valid=packed[..., 5] > 0.5,
+        labels=packed[..., 6:6 + top_k].astype(np.int32),
+        similarities=packed[..., 6 + top_k:6 + 2 * top_k],
+    )
 
 
 class RecognitionPipeline:
@@ -55,6 +85,7 @@ class RecognitionPipeline:
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
         self._step_cache: Dict[Tuple[int, int, int], Any] = {}
+        self._packed_cache: Dict[Tuple[int, int, int], Any] = {}
 
     def _build_step(self, batch: int, height: int, width: int):
         mesh = self.gallery.mesh
@@ -102,6 +133,31 @@ class RecognitionPipeline:
             self._step_cache[key] = self._build_step(*key)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         return self._step_cache[key](
+            self.detector.params,
+            self.embed_params,
+            data.embeddings,
+            data.valid,
+            data.labels,
+            frames,
+        )
+
+    def recognize_batch_packed(self, frames: jnp.ndarray) -> jnp.ndarray:
+        """Same fused step, but the outputs leave the device as ONE packed
+        [B, K, 6 + 2k] f32 array (see ``pack_result``) — the serving loop's
+        single-readback path. Decode host-side with ``unpack_result``."""
+        frames = jnp.asarray(frames, jnp.float32)
+        key = frames.shape
+        if key not in self._packed_cache:
+            step = self._step_cache.get(key)
+            if step is None:
+                step = self._step_cache[key] = self._build_step(*key)
+
+            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr):
+                return pack_result(step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
+
+            self._packed_cache[key] = jax.jit(packed_step)
+        data = self.gallery.data
+        return self._packed_cache[key](
             self.detector.params,
             self.embed_params,
             data.embeddings,

@@ -107,6 +107,11 @@ class FrameBatcher:
         return frames, metas, count
 
     @property
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._frames)
+
+    @property
     def stats(self):
         with self._lock:
             return {

@@ -1,17 +1,23 @@
 """Middleware connectors (SURVEY.md §1 L8, §5.8): the pluggable transport
 boundary the reference put behind ``mwconnector/abstractconnector.py``.
 
-Three transports ship:
+Four transports ship:
 - ``FakeConnector`` — in-process pub-sub; the test/bench transport (the
   SURVEY.md §4 prescription: the serving loop must be testable without ROS).
 - ``JSONLConnector`` — newline-delimited JSON over arbitrary streams
-  (stdin/stdout, files, sockets wrapped as files): the shippable default in
-  an environment with no ROS/RSB. Frames travel as base64 raw bytes +
-  shape/dtype.
+  (stdin/stdout, files): the shippable default in an environment with no
+  ROS/RSB. Frames travel as base64 raw bytes + shape/dtype. Signals EOF via
+  the ``eof`` event so apps can shut down when the input stream ends.
+- ``SocketConnector`` — the same JSONL framing over TCP: the second real
+  remote transport (fills the slot the reference's RSB connector held,
+  SURVEY.md §2.1 "RSB recognizer" — rsb itself is not installable here).
+  Server mode accepts many clients and broadcasts published messages to all
+  of them; client mode connects out.
 - ``ROSConnector`` — the reference's primary transport (rosconnector.py
-  equivalent): implemented against rospy/cv_bridge when present, raising a
-  clear error here (no ROS in this image). Same interface, so swapping is a
-  constructor change.
+  equivalent): subscribes ``sensor_msgs/Image``, publishes results as JSON
+  on a ``std_msgs/String`` topic. Import-guarded: constructing it without
+  rospy raises with a pointer to the alternatives; the message-handling
+  bodies are real and unit-tested against a mocked rospy.
 
 Messages are dicts; topics are strings. Handlers run on the connector's
 dispatch thread — keep them cheap (the recognizer's handler just enqueues
@@ -21,7 +27,11 @@ into the FrameBatcher).
 from __future__ import annotations
 
 import base64
+import io
 import json
+import os
+import select
+import socket
 import threading
 from typing import Any, Callable, Dict, IO, List, Optional
 
@@ -90,86 +100,446 @@ class FakeConnector(MiddlewareConnector):
             return [m for t, m in self.sent if t == topic]
 
 
-class JSONLConnector(MiddlewareConnector):
+def _parse_jsonl_line(line: str):
+    """One JSONL wire line -> (topic, data) or None if malformed/empty."""
+    line = line.strip()
+    if not line:
+        return None
+    try:
+        obj = json.loads(line)
+        return obj["topic"], obj.get("data", {})
+    except (json.JSONDecodeError, KeyError, TypeError):
+        return "__malformed__", None
+
+
+class _TopicDispatchConnector(MiddlewareConnector):
+    """Shared handler registry + JSONL-line handling for the wire
+    transports (JSONL/socket/ROS all dispatch the same way; one body)."""
+
+    def __init__(self):
+        self._handlers: Dict[str, List[Handler]] = {}
+        self._lock = threading.Lock()
+        self.malformed_lines = 0
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def _dispatch(self, topic: str, data: Dict[str, Any]) -> None:
+        with self._lock:
+            handlers = list(self._handlers.get(topic, ()))
+        for handler in handlers:
+            handler(topic, data)
+
+    def _handle_line(self, line: str) -> None:
+        parsed = _parse_jsonl_line(line)
+        if parsed is None:
+            return
+        topic, data = parsed
+        if data is None:
+            self.malformed_lines += 1
+            return
+        self._dispatch(topic, data)
+
+
+class JSONLConnector(_TopicDispatchConnector):
     """One JSON object per line: {"topic": ..., "data": {...}}.
 
     A reader thread dispatches incoming lines to subscribed handlers;
     ``publish`` writes lines to the output stream. Malformed lines are
     counted and skipped, never fatal (SURVEY.md §5.3).
+
+    Lifecycle: ``eof`` is set when the reader finishes (input stream ended
+    or ``stop()`` was called) — apps wait on it to shut down instead of
+    spinning forever. For real-fd streams (stdin, pipes, socket files) the
+    reader multiplexes the fd against a self-pipe with ``select``, so
+    ``stop()`` genuinely unblocks a reader waiting for input. (Closing the
+    stream from another thread — the obvious alternative — deadlocks on the
+    buffered reader's internal lock in CPython.)
     """
 
-    def __init__(self, in_stream: Optional[IO[str]] = None, out_stream: Optional[IO[str]] = None):
+    def __init__(
+        self,
+        in_stream: Optional[IO[str]] = None,
+        out_stream: Optional[IO[str]] = None,
+    ):
+        super().__init__()
         self._in = in_stream
         self._out = out_stream
-        self._handlers: Dict[str, List[Handler]] = {}
-        self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._running = False
-        self.malformed_lines = 0
+        self._wake_r: Optional[int] = None
+        self._wake_w: Optional[int] = None
+        self.eof = threading.Event()
 
     def publish(self, topic: str, message: Dict[str, Any]) -> None:
         if self._out is None:
             return
         line = json.dumps({"topic": topic, "data": message})
         with self._lock:
-            self._out.write(line + "\n")
-            self._out.flush()
-
-    def subscribe(self, topic: str, handler: Handler) -> None:
-        with self._lock:
-            self._handlers.setdefault(topic, []).append(handler)
+            try:
+                self._out.write(line + "\n")
+                self._out.flush()
+            except (ValueError, OSError):
+                # Stream closed during shutdown, or the consumer died
+                # (BrokenPipeError) — either way publishing must never kill
+                # the serving loop thread that called it.
+                pass
 
     def start(self) -> None:
         if self._in is None or self._thread is not None:
             return
         self._running = True
+        self._wake_r, self._wake_w = os.pipe()
         self._thread = threading.Thread(target=self._read_loop, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
         self._running = False
+        if self._wake_w is not None:
+            try:
+                os.write(self._wake_w, b"x")  # wake a select()-blocked reader
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        for fd in (self._wake_r, self._wake_w):
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._wake_r = self._wake_w = None
 
     def _read_loop(self) -> None:
-        for line in self._in:
-            if not self._running:
-                break
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-                topic = obj["topic"]
-                data = obj.get("data", {})
-            except (json.JSONDecodeError, KeyError, TypeError):
-                self.malformed_lines += 1
-                continue
-            with self._lock:
-                handlers = list(self._handlers.get(topic, ()))
-            for handler in handlers:
-                handler(topic, data)
-
-
-class ROSConnector(MiddlewareConnector):
-    """The reference's ROS transport (SURVEY.md §2.1 "ROS recognizer node"):
-    subscribe sensor_msgs/Image via cv_bridge, publish recognition results.
-    Requires rospy; this environment ships without ROS, so construction
-    fails with a pointer to the drop-in alternatives."""
-
-    def __init__(self, image_topic: str = "/camera/image_raw",
-                 result_topic: str = "/ocvfacerec/results"):
+        stream = self._in
         try:
-            import rospy  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "rospy is not installed in this environment; use JSONLConnector "
-                "or FakeConnector, which implement the same MiddlewareConnector "
-                "interface"
-            ) from e
+            fd = stream.fileno()
+        except (OSError, AttributeError, ValueError, io.UnsupportedOperation):
+            fd = None
+        try:
+            if fd is None:
+                # In-memory stream (StringIO etc.): iteration never blocks.
+                for line in stream:
+                    if not self._running:
+                        break
+                    self._handle_line(line)
+            else:
+                self._read_loop_fd(fd)
+        except ValueError:
+            pass  # stream closed under us
+        finally:
+            self.eof.set()
+
+    def _read_loop_fd(self, fd: int) -> None:
+        """select() on the stream fd + the wake pipe; split lines manually
+        (the raw fd bypasses the TextIO buffer, so all reads go through
+        here — do not mix with stream.readline())."""
+        buf = b""
+        while self._running:
+            ready, _, _ = select.select([fd, self._wake_r], [], [])
+            if self._wake_r in ready:
+                break  # stop() requested
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                # True EOF: a final line without a trailing newline is
+                # still a line (matches text-stream iteration semantics).
+                if buf.strip():
+                    self._handle_line(buf.decode("utf-8", errors="replace"))
+                break
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not self._running:
+                    return
+                self._handle_line(line.decode("utf-8", errors="replace"))
+
+
+class SocketConnector(_TopicDispatchConnector):
+    """JSONL framing over TCP — the second real remote transport.
+
+    ``SocketConnector(port=N, listen=True)`` binds and accepts any number of
+    clients; every ``publish`` is broadcast to all connected clients, every
+    client line is dispatched to subscribed handlers. ``listen=False``
+    connects out to ``(host, port)``. Either end speaks the exact
+    JSONLConnector wire format, so a JSONL client can talk to a socket
+    server through ``nc`` unchanged.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, listen: bool = False):
+        super().__init__()
+        self.host = host
+        self.port = port
+        self.listen = listen
+        # Serializes sendall across publisher threads: interleaved partial
+        # writes from concurrent publishes would splice two JSON lines into
+        # one corrupt frame on the wire.
+        self._send_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._server_sock: Optional[socket.socket] = None
+        self._client_socks: List[socket.socket] = []
+        self._running = False
+        self.eof = threading.Event()
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        if self.listen:
+            self._server_sock = socket.create_server((self.host, self.port))
+            self.port = self._server_sock.getsockname()[1]
+            accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+            accept_thread.start()
+            self._threads.append(accept_thread)
+        else:
+            sock = socket.create_connection((self.host, self.port), timeout=10.0)
+            sock.settimeout(None)
+            self._attach(sock)
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _addr = self._server_sock.accept()
+            except OSError:
+                break  # server socket closed by stop()
+            self._attach(sock)
+        self.eof.set()
+
+    def _attach(self, sock: socket.socket) -> None:
+        with self._lock:
+            self._client_socks.append(sock)
+        thread = threading.Thread(target=self._read_loop, args=(sock,), daemon=True)
+        thread.start()
+        self._threads.append(thread)
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        fh = sock.makefile("r", encoding="utf-8", errors="replace")
+        try:
+            for line in fh:
+                if not self._running:
+                    break
+                self._handle_line(line)
+        except (OSError, ValueError):
+            pass  # peer gone or socket closed during shutdown
+        finally:
+            with self._lock:
+                if sock in self._client_socks:
+                    self._client_socks.remove(sock)
+                remaining = len(self._client_socks)
+            if not self.listen or (not self._running and remaining == 0):
+                self.eof.set()
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        payload = (json.dumps({"topic": topic, "data": message}) + "\n").encode()
+        with self._lock:
+            socks = list(self._client_socks)
+        dead = []
+        with self._send_lock:
+            for sock in socks:
+                try:
+                    sock.sendall(payload)
+                except OSError:
+                    dead.append(sock)
+        if dead:
+            with self._lock:
+                for sock in dead:
+                    if sock in self._client_socks:
+                        self._client_socks.remove(sock)
+
+    def stop(self) -> None:
+        self._running = False
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._client_socks)
+            self._client_socks.clear()
+        for sock in socks:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for thread in self._threads:
+            thread.join(timeout=2.0)
+        self._threads.clear()
+
+
+def decode_ros_image(msg) -> np.ndarray:
+    """sensor_msgs/Image -> float32 grayscale [H, W] without cv_bridge.
+
+    Handles the encodings a camera driver actually emits: mono8/mono16
+    directly, rgb8/bgr8/rgba8/bgra8 via the standard luma weights. Honors
+    ``step`` (row stride) and ``is_bigendian`` for mono16.
+    """
+    h, w, step = int(msg.height), int(msg.width), int(msg.step)
+    enc = str(msg.encoding).lower()
+    raw = np.frombuffer(bytes(msg.data), dtype=np.uint8)
+    channels = {"mono8": 1, "mono16": 2, "rgb8": 3, "bgr8": 3,
+                "rgba8": 4, "bgra8": 4}
+    if enc not in channels:
+        raise ValueError(f"unsupported image encoding: {msg.encoding!r}")
+    rows = raw.reshape(h, step)[:, : w * channels[enc]]
+    if enc == "mono8":
+        return rows.astype(np.float32)
+    if enc == "mono16":
+        dt = ">u2" if getattr(msg, "is_bigendian", 0) else "<u2"
+        img16 = rows.reshape(h, w, 2).copy().view(dt)[..., 0]
+        return (img16.astype(np.float32) / 257.0)  # 16-bit -> 0..255 scale
+    c = channels[enc]
+    rgb = rows.reshape(h, w, c)[..., :3].astype(np.float32)
+    if enc.startswith("bgr"):
+        rgb = rgb[..., ::-1]
+    return rgb @ np.asarray([0.299, 0.587, 0.114], np.float32)
+
+
+class ROSConnector(_TopicDispatchConnector):
+    """The reference's ROS transport (SURVEY.md §2.1 "ROS recognizer node",
+    BASELINE.json:5/:10 — the named target workload's transport).
+
+    - ``sensor_msgs/Image`` on ``image_topic`` -> decoded grayscale frame
+      dispatched to FRAME_TOPIC subscribers (same dict schema as the other
+      connectors, so RecognizerService is transport-agnostic).
+    - ``std_msgs/String`` JSON on ``control_topic`` -> control commands
+      (enroll/stats — the reference's retrain/restart channel).
+    - ``publish`` serializes result/status dicts as JSON into
+      ``std_msgs/String`` on ``result_topic``/``status_topic`` (custom msg
+      types would need a catkin build; String-JSON keeps the node drop-in).
+
+    rospy is imported at construction and the node handles are injectable
+    for tests (a mocked rospy module exercises the full body without ROS).
+    """
+
+    def __init__(
+        self,
+        image_topic: str = "/camera/image_raw",
+        result_topic: str = "/ocvfacerec/results",
+        control_topic: str = "/ocvfacerec/control",
+        status_topic: str = "/ocvfacerec/status",
+        node_name: str = "ocvf_recognizer",
+        rospy_module=None,
+    ):
+        if rospy_module is None:
+            try:
+                import rospy as rospy_module  # type: ignore[no-redef]
+            except ImportError as e:
+                raise ImportError(
+                    "rospy is not installed in this environment; use "
+                    "JSONLConnector, SocketConnector, or FakeConnector, which "
+                    "implement the same MiddlewareConnector interface"
+                ) from e
+        super().__init__()
+        self._rospy = rospy_module
         self.image_topic = image_topic
         self.result_topic = result_topic
-        # Full implementation intentionally deferred until a ROS environment
-        # exists to run it against; the serving loop only depends on the
-        # MiddlewareConnector interface.
+        self.control_topic = control_topic
+        self.status_topic = status_topic
+        self.node_name = node_name
+        self._publishers: Dict[str, Any] = {}
+        self._subscribers: List[Any] = []
+        self._started = False
+        self.frames_malformed = 0
+
+    # Topic names on the app side (FRAME_TOPIC et al.) map onto the ROS
+    # graph names given in the constructor.
+    def _ros_topic_for(self, topic: str) -> str:
+        from opencv_facerecognizer_tpu.runtime import recognizer as rec
+
+        return {
+            rec.RESULT_TOPIC: self.result_topic,
+            rec.STATUS_TOPIC: self.status_topic,
+        }.get(topic, topic)
+
+    def start(self) -> None:
+        if self._started:
+            return
+        rospy = self._rospy
+        rospy.init_node(self.node_name, anonymous=True, disable_signals=True)
+        self._string_cls = self._string_msg_cls()
+        self._subscribers.append(
+            rospy.Subscriber(self.image_topic, self._image_msg_cls(), self._on_image)
+        )
+        self._subscribers.append(
+            rospy.Subscriber(self.control_topic, self._string_cls, self._on_control)
+        )
+        self._started = True
+
+    @staticmethod
+    def _string_msg_cls():
+        try:
+            from std_msgs.msg import String  # only exists beside rospy
+        except ImportError:
+            class String:  # stand-in with std_msgs/String's one field
+                def __init__(self, data: str = ""):
+                    self.data = data
+
+        return String
+
+    @staticmethod
+    def _image_msg_cls():
+        try:
+            from sensor_msgs.msg import Image  # only exists beside rospy
+        except ImportError:
+            class Image:  # stand-in; only used as the Subscriber type arg
+                pass
+
+        return Image
+
+    def _on_image(self, msg) -> None:
+        from opencv_facerecognizer_tpu.runtime import recognizer as rec
+
+        try:
+            frame = decode_ros_image(msg)
+        except Exception:  # noqa: BLE001 — malformed frame must not kill the node
+            self.frames_malformed += 1
+            return
+        stamp = getattr(getattr(msg, "header", None), "stamp", None)
+        message = {**encode_frame(frame),
+                   "meta": {"stamp": str(stamp) if stamp is not None else None}}
+        self._dispatch(rec.FRAME_TOPIC, message)
+
+    def _on_control(self, msg) -> None:
+        from opencv_facerecognizer_tpu.runtime import recognizer as rec
+
+        parsed = _parse_jsonl_line(getattr(msg, "data", ""))
+        if parsed is None:
+            return
+        topic, data = parsed
+        if data is None:
+            # Accept bare command payloads too: {"cmd": "enroll", ...}
+            try:
+                data = json.loads(msg.data)
+                topic = rec.CONTROL_TOPIC
+            except (json.JSONDecodeError, TypeError):
+                return
+        self._dispatch(topic if topic != "__malformed__" else rec.CONTROL_TOPIC, data)
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        if not self._started:
+            return
+        ros_topic = self._ros_topic_for(topic)
+        with self._lock:
+            pub = self._publishers.get(ros_topic)
+            if pub is None:
+                pub = self._rospy.Publisher(ros_topic, self._string_cls, queue_size=16)
+                self._publishers[ros_topic] = pub
+        pub.publish(self._string_cls(data=json.dumps(message)))
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def stop(self) -> None:
+        for sub in self._subscribers:
+            try:
+                sub.unregister()
+            except Exception:  # noqa: BLE001 — rospy teardown is best-effort
+                pass
+        self._subscribers.clear()
+        self._started = False

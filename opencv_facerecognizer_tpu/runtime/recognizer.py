@@ -63,7 +63,12 @@ class RecognizerService:
         batch_size: int = 8,
         frame_shape: Optional[tuple] = None,
         flush_timeout: float = 0.05,
-        inflight_depth: int = 32,
+        # Backpressure knob: beyond this many undrained batches the loop
+        # BLOCKS on the oldest readback before dispatching more. Keep it
+        # shallow — each in-flight batch is a full device round-trip of
+        # latency debt (~300 ms on a tunneled backend); a deep queue turns
+        # into seconds of backlog while the batcher keeps accepting frames.
+        inflight_depth: int = 4,
         similarity_threshold: float = 0.3,
         subject_names: Optional[List[str]] = None,
         metrics: Optional[Metrics] = None,
@@ -80,6 +85,9 @@ class RecognizerService:
         self._inflight: deque = deque()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # True while a popped batch is between get_batch() and the
+        # in-flight queue — drain() must not declare victory in that window.
+        self._dispatching = False
         self._enrolment: Optional[_Enrolment] = None
         self._enrol_lock = threading.Lock()
 
@@ -151,12 +159,25 @@ class RecognizerService:
         the first batch and the first enroll command pay no compile stall."""
         t0 = time.perf_counter()
         zeros = np.zeros((self.batcher.batch_size, *self.batcher.frame_shape), np.float32)
-        result = self.pipeline.recognize_batch(zeros)
+        packed = self.pipeline.recognize_batch_packed(zeros)
         chunk = np.zeros((self._enrol_chunk, *self.pipeline.face_size), np.float32)
         emb = self._embed_chunk(self.pipeline.embed_params, chunk)
-        for arr in (*result, emb):
+        for arr in (packed, emb):
             arr.block_until_ready() if hasattr(arr, "block_until_ready") else None
         self.metrics.observe("warmup", time.perf_counter() - t0)
+
+    def drain(self, timeout: float = 120.0) -> bool:
+        """Block until every accepted frame has been batched, computed, AND
+        published (or timeout). Call at end-of-stream BEFORE stop() —
+        stop() tears the loop down promptly and discards whatever is still
+        queued, which is right for Ctrl-C but wrong for a finite stream."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if (self.batcher.pending == 0 and not self._dispatching
+                    and not self._inflight):
+                return True
+            time.sleep(0.05)
+        return False
 
     def stop(self) -> None:
         self._running = False
@@ -178,17 +199,21 @@ class RecognizerService:
                 self._drain()
                 continue
             frames, metas, count = batch
+            self._dispatching = True
             t0 = time.perf_counter()
             try:
-                result = self.pipeline.recognize_batch(frames)
-                # Fire the transfers now; materialize later without blocking.
-                for arr in result:
-                    arr.copy_to_host_async()
+                # Packed path: ONE output array -> one D2H readback per
+                # batch (a tunneled backend charges ~100 ms per blocking
+                # readback; five separate arrays measured 5x slower).
+                packed = self.pipeline.recognize_batch_packed(frames)
+                packed.copy_to_host_async()
             except Exception:  # noqa: BLE001 — a bad batch must not kill serving
                 logging.getLogger(__name__).exception("recognition batch failed")
                 self.metrics.incr("batches_failed")
+                self._dispatching = False
                 continue
-            self._inflight.append((result, frames, metas, count, t0))
+            self._inflight.append((packed, frames, metas, count, t0))
+            self._dispatching = False
             self.metrics.incr("batches_dispatched")
             self.metrics.incr("frames_processed", count)
             self._drain()
@@ -197,20 +222,23 @@ class RecognizerService:
     def _drain(self, force: bool = False) -> None:
         """Materialize finished batches; block only when over depth/forced."""
         while self._inflight:
-            result, frames, metas, count, t0 = self._inflight[0]
-            ready = result.labels.is_ready() and result.boxes.is_ready()
-            if not (ready or force or len(self._inflight) > self.inflight_depth):
+            packed, frames, metas, count, t0 = self._inflight[0]
+            if not (packed.is_ready() or force
+                    or len(self._inflight) > self.inflight_depth):
                 break
             self._inflight.popleft()
-            self._publish(result, frames, metas, count)
+            self._publish(packed, frames, metas, count)
             self.metrics.observe("batch_latency", time.perf_counter() - t0)
 
-    def _publish(self, result, frames, metas, count) -> None:
-        boxes = np.array(result.boxes)
-        det_scores = np.array(result.det_scores)
-        valid = np.array(result.valid)
-        labels = np.array(result.labels)
-        sims = np.array(result.similarities)
+    def _publish(self, packed, frames, metas, count) -> None:
+        from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
+
+        result = unpack_result(np.asarray(packed), self.pipeline.top_k)
+        boxes = result.boxes
+        det_scores = result.det_scores
+        valid = result.valid
+        labels = result.labels
+        sims = result.similarities
         for i in range(count):
             faces = []
             for j in range(boxes.shape[1]):
@@ -282,8 +310,14 @@ class RecognizerService:
             else:
                 label = len(self.subject_names)
                 self.subject_names.append(enrolment.subject_name)
+        before_grow = self.pipeline.gallery.grow_count
         try:
             self.pipeline.gallery.add(emb, np.full(len(emb), label, np.int32))
+            grown = self.pipeline.gallery.grow_count - before_grow
+            if grown:
+                # Auto-grow saved the enrolment but forced a recompile-sized
+                # stall on the next match — surface it so operators pre-size.
+                self.metrics.incr("gallery_grown", grown)
         except Exception:
             # Roll back a name we just reserved: the gallery has no rows
             # for it, so leaving it would skew label->name indices.

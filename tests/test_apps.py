@@ -52,11 +52,13 @@ def test_train_app_rejects_bad_dataset(tmp_path):
         train_app.main([str(tmp_path / "nope"), str(tmp_path / "m.ckpt")])
 
 
-@pytest.mark.slow
-def test_recognize_app_dir_mode(tmp_path, capsys):
+@pytest.fixture(scope="module")
+def app_artifacts(tmp_path_factory):
+    """Trained CNN model + detector checkpoints, gallery dir, frames dir —
+    shared by the recognize-app tests (training them is the slow part)."""
     import cv2
 
-    # 1) train + save a tiny cnn model on face crops
+    tmp_path = tmp_path_factory.mktemp("app_artifacts")
     X, y, names = make_synthetic_faces(3, 6, (32, 32), seed=53, noise=8.0)
     data_dir = str(tmp_path / "gallery")
     _write_dataset(data_dir, X, y, names)
@@ -67,7 +69,6 @@ def test_recognize_app_dir_mode(tmp_path, capsys):
     ])
     assert rc == 0
 
-    # shrink the cnn for test speed: retrain tiny variant directly
     from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
 
     scenes, boxes, counts = make_synthetic_scenes(32, (96, 96), max_faces=2, seed=55)
@@ -77,17 +78,29 @@ def test_recognize_app_dir_mode(tmp_path, capsys):
     det_path = str(tmp_path / "det.ckpt")
     det.save(det_path)
 
-    # 2) frames dir to replay
     frames_dir = str(tmp_path / "frames")
     os.makedirs(frames_dir)
     test_scenes, _, test_counts = make_synthetic_scenes(4, (96, 96), max_faces=2, seed=57)
     for i, scene in enumerate(test_scenes):
         cv2.imwrite(os.path.join(frames_dir, f"f{i}.png"), scene.astype(np.uint8))
 
+    return {
+        "data_dir": data_dir, "model_path": model_path, "det_path": det_path,
+        "frames_dir": frames_dir, "names": names, "test_scenes": test_scenes,
+        "tmp_path": tmp_path,
+    }
+
+
+@pytest.mark.slow
+def test_recognize_app_dir_mode(app_artifacts, capsys):
+    a = app_artifacts
+    profile_dir = str(a["tmp_path"] / "trace")
     rc = recognize_app.main([
-        "--model", model_path, "--detector", det_path, "--gallery", data_dir,
-        "--source", "dir", "--dir", frames_dir, "--frame-size", "96", "96",
+        "--model", a["model_path"], "--detector", a["det_path"],
+        "--gallery", a["data_dir"],
+        "--source", "dir", "--dir", a["frames_dir"], "--frame-size", "96", "96",
         "--batch-size", "4", "--similarity-threshold", "0.0",
+        "--profile-dir", profile_dir, "--profile-batches", "1",
     ])
     assert rc == 0
     lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
@@ -97,7 +110,61 @@ def test_recognize_app_dir_mode(tmp_path, capsys):
     assert files == [f"f{i}.png" for i in range(4)]
     for r in results:
         for face in r["faces"]:
-            assert face["name"] in names or face["name"] == "unknown"
+            assert face["name"] in a["names"] or face["name"] == "unknown"
+    # --profile-dir produced a loadable trace (SURVEY.md §5.1)
+    trace_files = [
+        os.path.join(root, f)
+        for root, _dirs, fs in os.walk(profile_dir) for f in fs
+    ]
+    assert trace_files, "profiler trace directory is empty"
+
+
+@pytest.mark.slow
+def test_recognize_app_jsonl_stdin_eof_terminates(app_artifacts, monkeypatch, capsys):
+    """Regression: jsonl mode used to spin `while True` forever after stdin
+    EOF; it must now shut down cleanly on its own."""
+    import io
+    import sys
+    import threading
+
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+
+    a = app_artifacts
+    n_frames = 5
+    lines = [
+        json.dumps({"topic": FRAME_TOPIC,
+                    "data": {**encode_frame(a["test_scenes"][i % 4].astype(np.float32)),
+                             "meta": {"seq": i}}})
+        for i in range(n_frames)
+    ]
+    # Final line deliberately lacks the trailing newline: still a message.
+    stdin_text = "\n".join(lines + [
+        json.dumps({"topic": "ocvfacerec/control", "data": {"cmd": "stats"}})
+    ])
+    monkeypatch.setattr(sys, "stdin", io.StringIO(stdin_text))
+
+    rc_box = {}
+
+    def run():
+        rc_box["rc"] = recognize_app.main([
+            "--model", a["model_path"], "--detector", a["det_path"],
+            "--gallery", a["data_dir"], "--source", "jsonl",
+            "--frame-size", "96", "96", "--batch-size", "2",
+            "--similarity-threshold", "0.0",
+        ])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=300)
+    assert not t.is_alive(), "jsonl mode did not terminate on stdin EOF"
+    assert rc_box["rc"] == 0
+    # EOF shutdown must DRAIN, not drop: every piped frame gets a result.
+    out_lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    results = [json.loads(l) for l in out_lines
+               if json.loads(l).get("topic") == "ocvfacerec/results"]
+    seqs = sorted(r["data"]["meta"]["seq"] for r in results)
+    assert seqs == list(range(n_frames)), seqs
 
 
 def test_detector_checkpoint_roundtrip(tmp_path):

@@ -1,0 +1,347 @@
+"""Transport-layer tests (SURVEY.md §5.8, §2.1 ROS/RSB rows): EOF/shutdown
+semantics, the TCP socket transport (two-process round-trip), the real
+ROSConnector body against a mocked rospy, and gallery auto-grow."""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.runtime.connector import (
+    JSONLConnector,
+    ROSConnector,
+    SocketConnector,
+    decode_frame,
+    decode_ros_image,
+    encode_frame,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------- JSONL shutdown semantics ----------
+
+
+def test_jsonl_eof_event_set_when_stream_ends():
+    c = JSONLConnector(io.StringIO('{"topic": "t", "data": {}}\n'), io.StringIO())
+    c.start()
+    assert c.eof.wait(timeout=5.0)
+    c.stop()
+
+
+def test_jsonl_stop_unblocks_reader_without_input():
+    # A pipe with no writer activity: the reader thread blocks in readline.
+    r_fd, w_fd = os.pipe()
+    reader = os.fdopen(r_fd, "r")
+    c = JSONLConnector(reader, io.StringIO())
+    c.start()
+    time.sleep(0.1)
+    assert c._thread.is_alive()
+    t0 = time.monotonic()
+    c.stop()  # closes the stream -> reader unblocks
+    assert time.monotonic() - t0 < 2.5
+    assert c._thread is None
+    assert c.eof.is_set()
+    os.close(w_fd)
+
+
+# ---------- socket transport ----------
+
+
+def test_socket_connector_roundtrip_in_process():
+    server = SocketConnector(listen=True)
+    received = []
+    server.subscribe("frames", lambda t, m: received.append(m))
+    server.start()
+
+    client = SocketConnector(port=server.port)
+    results = []
+    client.subscribe("results", lambda t, m: results.append(m))
+    client.start()
+
+    frame = np.arange(12, dtype=np.float32).reshape(3, 4)
+    client.publish("frames", {**encode_frame(frame), "meta": {"seq": 1}})
+    deadline = time.monotonic() + 5
+    while not received and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert received, "server never received the client frame"
+    np.testing.assert_array_equal(decode_frame(received[0]), frame)
+
+    server.publish("results", {"name": "alice", "seq": 1})
+    deadline = time.monotonic() + 5
+    while not results and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert results == [{"name": "alice", "seq": 1}]
+
+    client.stop()
+    server.stop()
+
+
+_CHILD_ECHO = """
+import sys
+sys.path.insert(0, {root!r})
+from opencv_facerecognizer_tpu.runtime.connector import SocketConnector, \\
+    decode_frame, encode_frame
+
+# Child = the "service": accepts a frame, answers with a result message.
+server = SocketConnector(listen=True)
+
+def on_frame(topic, message):
+    frame = decode_frame(message)
+    server.publish("results", {{"mean": float(frame.mean()),
+                                "meta": message.get("meta")}})
+
+server.subscribe("frames", on_frame)
+server.start()
+print(server.port, flush=True)
+server.eof.wait(timeout=30)
+server.stop()
+"""
+
+
+def test_socket_connector_two_process_roundtrip():
+    """Frames -> results across a real process boundary over TCP."""
+    child = subprocess.Popen(
+        [sys.executable, "-c", _CHILD_ECHO.format(root=REPO_ROOT)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        port = int(child.stdout.readline())
+        client = SocketConnector(port=port)
+        results = []
+        client.subscribe("results", lambda t, m: results.append(m))
+        client.start()
+        frame = np.full((4, 4), 7.0, dtype=np.float32)
+        client.publish("frames", {**encode_frame(frame), "meta": {"n": 42}})
+        deadline = time.monotonic() + 10
+        while not results and time.monotonic() < deadline:
+            time.sleep(0.02)
+        client.stop()
+        assert results and results[0]["mean"] == 7.0
+        assert results[0]["meta"] == {"n": 42}
+    finally:
+        child.terminate()
+        child.wait(timeout=10)
+
+
+# ---------- ROS image decoding ----------
+
+
+class _ImageMsg:
+    def __init__(self, height, width, encoding, data, step=None, is_bigendian=0):
+        self.height = height
+        self.width = width
+        self.encoding = encoding
+        self.data = data
+        bpp = {"mono8": 1, "mono16": 2, "rgb8": 3, "bgr8": 3,
+               "rgba8": 4, "bgra8": 4}[encoding]
+        self.step = step if step is not None else width * bpp
+        self.is_bigendian = is_bigendian
+        self.header = type("H", (), {"stamp": "12.5"})()
+
+
+def test_decode_ros_image_mono8_with_row_padding():
+    img = np.arange(6, dtype=np.uint8).reshape(2, 3)
+    padded = np.concatenate([img, np.zeros((2, 2), np.uint8)], axis=1)  # step=5
+    msg = _ImageMsg(2, 3, "mono8", padded.tobytes(), step=5)
+    np.testing.assert_array_equal(decode_ros_image(msg), img.astype(np.float32))
+
+
+def test_decode_ros_image_bgr8_luma():
+    rgb = np.zeros((1, 2, 3), np.uint8)
+    rgb[0, 0] = (255, 0, 0)  # pure red
+    rgb[0, 1] = (0, 255, 0)  # pure green
+    bgr = rgb[..., ::-1]
+    msg = _ImageMsg(1, 2, "bgr8", bgr.tobytes())
+    gray = decode_ros_image(msg)
+    np.testing.assert_allclose(gray[0], [255 * 0.299, 255 * 0.587], rtol=1e-5)
+
+
+def test_decode_ros_image_rejects_unknown_encoding():
+    msg = _ImageMsg(1, 1, "mono8", b"\x00")
+    msg.encoding = "yuv422"
+    with pytest.raises(ValueError, match="encoding"):
+        decode_ros_image(msg)
+
+
+# ---------- ROSConnector against a mocked rospy ----------
+
+
+class _FakePublisher:
+    def __init__(self, topic, msg_cls, queue_size=0):
+        self.topic = topic
+        self.published = []
+
+    def publish(self, msg):
+        self.published.append(msg)
+
+
+class _FakeSubscriber:
+    def __init__(self, topic, msg_cls, callback):
+        self.topic = topic
+        self.callback = callback
+        self.unregistered = False
+
+    def unregister(self):
+        self.unregistered = True
+
+
+class _FakeRospy:
+    def __init__(self):
+        self.node = None
+        self.publishers = []
+        self.subscribers = []
+
+    def init_node(self, name, **kwargs):
+        self.node = (name, kwargs)
+
+    def Subscriber(self, topic, msg_cls, callback):
+        sub = _FakeSubscriber(topic, msg_cls, callback)
+        self.subscribers.append(sub)
+        return sub
+
+    def Publisher(self, topic, msg_cls, queue_size=0):
+        pub = _FakePublisher(topic, msg_cls, queue_size)
+        self.publishers.append(pub)
+        return pub
+
+
+@pytest.fixture
+def ros_stack():
+    rospy = _FakeRospy()
+    conn = ROSConnector(rospy_module=rospy)
+    conn.start()
+    return rospy, conn
+
+
+def test_ros_connector_image_to_frame_topic(ros_stack):
+    from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+
+    rospy, conn = ros_stack
+    assert rospy.node[0] == "ocvf_recognizer"
+    got = []
+    conn.subscribe(FRAME_TOPIC, lambda t, m: got.append(m))
+
+    img = np.arange(20, dtype=np.uint8).reshape(4, 5)
+    image_sub = next(s for s in rospy.subscribers if s.topic == conn.image_topic)
+    image_sub.callback(_ImageMsg(4, 5, "mono8", img.tobytes()))
+    assert len(got) == 1
+    np.testing.assert_array_equal(decode_frame(got[0]), img.astype(np.float32))
+    assert got[0]["meta"]["stamp"] == "12.5"
+
+    # malformed image: counted, not fatal
+    bad = _ImageMsg(4, 5, "mono8", b"\x00\x01")  # too short
+    image_sub.callback(bad)
+    assert conn.frames_malformed == 1
+    assert len(got) == 1
+
+
+def test_ros_connector_control_and_result_paths(ros_stack):
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        CONTROL_TOPIC, RESULT_TOPIC,
+    )
+
+    rospy, conn = ros_stack
+    commands = []
+    conn.subscribe(CONTROL_TOPIC, lambda t, m: commands.append(m))
+    control_sub = next(s for s in rospy.subscribers if s.topic == conn.control_topic)
+
+    # Bare command payload (what a human types into rostopic pub).
+    control_sub.callback(type("S", (), {"data": '{"cmd": "stats"}'})())
+    # Full wire form too.
+    control_sub.callback(type("S", (), {
+        "data": json.dumps({"topic": CONTROL_TOPIC,
+                            "data": {"cmd": "enroll", "subject": "bob"}})})())
+    assert commands == [{"cmd": "stats"}, {"cmd": "enroll", "subject": "bob"}]
+
+    conn.publish(RESULT_TOPIC, {"faces": [], "meta": None})
+    pub = next(p for p in rospy.publishers if p.topic == conn.result_topic)
+    assert json.loads(pub.published[0].data) == {"faces": [], "meta": None}
+
+
+def test_ros_connector_stop_unregisters(ros_stack):
+    rospy, conn = ros_stack
+    conn.stop()
+    assert all(s.unregistered for s in rospy.subscribers)
+
+
+# ---------- gallery auto-grow ----------
+
+
+def test_gallery_auto_grows_and_preserves_rows():
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+
+    mesh = make_mesh()
+    # capacity rounds up to a tp multiple (8 devices -> min 8 rows)
+    gal = ShardedGallery(capacity=4, dim=8, mesh=mesh)
+    base_capacity = gal.capacity
+    rng = np.random.default_rng(0)
+    e1 = rng.normal(size=(base_capacity, 8)).astype(np.float32)
+    gal.add(e1, np.arange(base_capacity, dtype=np.int32))
+    assert gal.grow_count == 0
+
+    e2 = rng.normal(size=(3, 8)).astype(np.float32)
+    gal.add(e2, np.asarray([10, 11, 12], np.int32))  # overflows -> grows
+    assert gal.grow_count == 1
+    assert gal.size == base_capacity + 3
+    assert gal.capacity >= base_capacity + 3
+    assert gal.capacity % mesh.shape["tp"] == 0
+
+    # All rows still match to their own labels after the grow.
+    all_e = np.concatenate([e1, e2])
+    all_e /= np.linalg.norm(all_e, axis=-1, keepdims=True)
+    want = list(range(base_capacity)) + [10, 11, 12]
+    dp = mesh.shape["dp"]
+    q = len(all_e) // dp * dp
+    labels, sims, _ = gal.match(np.asarray(all_e[:q]), k=1)
+    assert np.asarray(labels).flatten().tolist() == want[:q]
+
+
+def test_gallery_swap_from_adopts_capacity():
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+
+    mesh = make_mesh()
+    small = ShardedGallery(capacity=4, dim=8, mesh=mesh)
+    big = ShardedGallery(capacity=32, dim=8, mesh=mesh)
+    e = np.eye(8, dtype=np.float32)
+    big.add(e, np.arange(8, dtype=np.int32))
+    small.swap_from(big)
+    assert small.capacity == big.capacity
+    assert small.size == 8
+    # And further adds land in the adopted (bigger) arrays.
+    small.add(np.ones((1, 8), np.float32), np.asarray([99], np.int32))
+    assert small.size == 9
+
+    tiny = ShardedGallery(capacity=4, dim=5, mesh=mesh)
+    with pytest.raises(ValueError, match="dim"):
+        small.swap_from(tiny)
+
+
+def test_gallery_concurrent_adds_lose_no_rows():
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+
+    mesh = make_mesh()
+    gal = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    rng = np.random.default_rng(1)
+    chunks = [rng.normal(size=(2, 4)).astype(np.float32) for _ in range(8)]
+
+    def add_chunk(i):
+        gal.add(chunks[i], np.full(2, i, np.int32))
+
+    threads = [threading.Thread(target=add_chunk, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert gal.size == 16  # every concurrent add claimed distinct rows
+    labels = gal.data.labels
+    counts = {i: int((np.asarray(labels) == i).sum()) for i in range(8)}
+    assert all(v == 2 for v in counts.values()), counts

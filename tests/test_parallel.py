@@ -63,12 +63,16 @@ def test_gallery_partial_fill_and_masking():
     assert np.all(idx < 5)  # never matches an invalid padded row
 
 
-def test_gallery_overflow_raises():
+def test_gallery_overflow_auto_grows():
+    # Overflow no longer raises: capacity doubles (tp-aligned) and the
+    # enrolment lands (see test_connectors.py for the full growth suite).
     mesh = make_mesh(tp=8)
     g = ShardedGallery(capacity=8, dim=4, mesh=mesh)
     g.add(RNG.normal(size=(8, 4)).astype(np.float32), np.arange(8, dtype=np.int32))
-    with pytest.raises(ValueError, match="overflow"):
-        g.add(RNG.normal(size=(1, 4)).astype(np.float32), np.array([9], dtype=np.int32))
+    g.add(RNG.normal(size=(1, 4)).astype(np.float32), np.array([9], dtype=np.int32))
+    assert g.grow_count == 1
+    assert g.size == 9
+    assert g.capacity == 16 and g.capacity % 8 == 0
 
 
 def test_gallery_incremental_enrolment():
