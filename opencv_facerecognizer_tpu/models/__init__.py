@@ -8,6 +8,7 @@ Implementations are batched, jittable device functions.
 
 from opencv_facerecognizer_tpu.models.classifier import (
     AbstractClassifier,
+    KernelSVM,
     NearestNeighbor,
     SVM,
 )
@@ -27,6 +28,7 @@ from opencv_facerecognizer_tpu.models.model import ExtendedPredictableModel, Pre
 from opencv_facerecognizer_tpu.models.operators import (
     ChainOperator,
     CombineOperator,
+    CombineOperatorND,
     FeatureOperator,
 )
 
@@ -35,6 +37,7 @@ __all__ = [
     "AbstractFeature",
     "ChainOperator",
     "CombineOperator",
+    "CombineOperatorND",
     "ExtendedPredictableModel",
     "FeatureOperator",
     "Fisherfaces",
@@ -42,6 +45,7 @@ __all__ = [
     "Identity",
     "LDA",
     "MinMaxNormalize",
+    "KernelSVM",
     "NearestNeighbor",
     "PCA",
     "PredictableModel",

@@ -69,6 +69,64 @@ class ChainOperator(FeatureOperator):
         return self.extract(X)
 
 
+class CombineOperatorND(FeatureOperator):
+    """Concatenate both features' outputs along a chosen axis *without*
+    flattening (SURVEY.md §2.1 "Feature operators": upstream
+    ``operators.py`` CombineOperatorND).
+
+    Unlike :class:`CombineOperator`, per-sample structure is preserved: two
+    features emitting ``(B, H, W)`` maps combine to ``(B, H, 2W)`` with
+    ``hstack_axis=-1``. Both features must agree on every axis except the
+    concatenation axis. ``hstack_axis`` addresses the *per-sample* axes
+    (0 = first sample axis), so batched and single-sample calls concatenate
+    along the same semantic axis.
+    """
+
+    name = "combine_operator_nd"
+
+    def __init__(self, model1: AbstractFeature, model2: AbstractFeature,
+                 hstack_axis: int = -1):
+        super().__init__(model1, model2)
+        self.hstack_axis = int(hstack_axis)
+
+    def _axis(self, out_ndim: int, batched: bool) -> int:
+        # Negative axes already count from the end; shift non-negative
+        # per-sample axes past the batch dim when the output is batched.
+        if self.hstack_axis < 0:
+            return self.hstack_axis
+        return self.hstack_axis + (1 if batched else 0)
+
+    def get_config(self):
+        cfg = super().get_config()
+        cfg["hstack_axis"] = self.hstack_axis
+        return cfg
+
+    @classmethod
+    def from_config(cls, config):
+        from opencv_facerecognizer_tpu.utils import serialization
+
+        return cls(
+            serialization.deserialize_spec(config["model1"]),
+            serialization.deserialize_spec(config["model2"]),
+            hstack_axis=config.get("hstack_axis", -1),
+        )
+
+    def compute(self, X, y):
+        a = jnp.asarray(self.model1.compute(X, y))
+        b = jnp.asarray(self.model2.compute(X, y))
+        return jnp.concatenate([a, b], axis=self._axis(a.ndim, batched=True))
+
+    def extract(self, X):
+        X = jnp.asarray(X, dtype=jnp.float32)
+        batched = X.ndim != self.sample_ndim
+        a = jnp.asarray(self.model1.extract(X))
+        b = jnp.asarray(self.model2.extract(X))
+        return jnp.concatenate([a, b], axis=self._axis(a.ndim, batched))
+
+    def _extract_batch(self, X):
+        return self.extract(X)
+
+
 class CombineOperator(FeatureOperator):
     """Concatenate both features' flattened outputs along the last axis."""
 

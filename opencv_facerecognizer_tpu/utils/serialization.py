@@ -48,8 +48,10 @@ def _registry() -> Dict[str, type]:
             f.MinMaxNormalize,
             o.ChainOperator,
             o.CombineOperator,
+            o.CombineOperatorND,
             c.NearestNeighbor,
             c.SVM,
+            c.KernelSVM,
             m.PredictableModel,
             m.ExtendedPredictableModel,
         ):

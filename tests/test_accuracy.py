@@ -1,0 +1,116 @@
+"""Accuracy regression bands (VERDICT round-1 item #3; SURVEY.md §6
+"first build milestone").
+
+Two layers of guard:
+
+1. ``test_measured_block_*`` parses the MEASURED block that
+   ``scripts/measure_accuracy.py`` wrote into BASELINE.md (full-scale runs
+   on the real chip) and asserts each recorded number sits above its band —
+   so a regressed re-measurement cannot be silently recorded.
+2. ``test_canary_*`` re-runs scaled-down versions of the same configs in
+   the CPU suite so an algorithmic regression (PCA/LDA/LBP/k-NN math) fails
+   fast here, without waiting for the next full measurement.
+
+Bands leave margin below the measured values (BASELINE.md: eigenfaces
+0.9575, fisherfaces 0.8117, lbph 0.5250, cnn 0.9890) to absorb seed/backend
+jitter while still catching real regressions.
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer, TrainerConfig
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# config key -> (BASELINE.md row label prefix, minimum acceptable accuracy)
+MEASURED_BANDS = {
+    "eigenfaces": ("Eigenfaces", 0.90),
+    "fisherfaces": ("Fisherfaces", 0.75),
+    "lbph": ("LBPH", 0.45),
+    "cnn": ("CNN ArcFace", 0.97),
+}
+
+
+def _measured_rows():
+    text = open(os.path.join(REPO, "BASELINE.md")).read()
+    m = re.search(r"<!-- MEASURED:BEGIN.*?-->(.*?)<!-- MEASURED:END -->",
+                  text, flags=re.S)
+    assert m, "BASELINE.md lacks the MEASURED block (run scripts/measure_accuracy.py)"
+    rows = {}
+    for line in m.group(1).splitlines():
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if len(cells) >= 2 and "**" in cells[1]:
+            acc = float(re.search(r"\*\*([0-9.]+)", cells[1]).group(1))
+            rows[cells[0]] = acc
+    return rows
+
+
+@pytest.mark.parametrize("key", sorted(MEASURED_BANDS))
+def test_measured_block_above_band(key):
+    label, band = MEASURED_BANDS[key]
+    rows = _measured_rows()
+    matching = [acc for name, acc in rows.items() if name.startswith(label)]
+    assert matching, f"no measured row starting with {label!r} in BASELINE.md"
+    assert matching[0] >= band, (
+        f"{label}: measured {matching[0]} fell below band {band} — "
+        "accuracy regressed; investigate before re-recording")
+
+
+def _canary_kfold(model_kind, num_subjects, per_subject, kfold, **kw):
+    X, y, names = make_synthetic_faces(
+        num_subjects=num_subjects, per_subject=per_subject, size=(48, 48), **kw)
+    trainer = TheTrainer(TrainerConfig(model=model_kind, kfold=kfold))
+    trainer.train(X, y, names, validate=True)
+    return trainer.mean_accuracy
+
+
+def test_canary_eigenfaces():
+    acc = _canary_kfold("eigenfaces", 12, 8, 3, seed=1)
+    assert acc >= 0.90, f"eigenfaces canary accuracy {acc:.3f}"
+
+
+def test_canary_fisherfaces_illumination():
+    # 48x48 under-resolves the TanTriggs DoG band for this config
+    # (measured 0.64 there vs 0.88 at 56x56), so this canary keeps 56x56.
+    X, y, names = make_synthetic_faces(num_subjects=10, per_subject=8,
+                                       size=(56, 56), seed=2,
+                                       illumination=0.7, noise=14.0)
+    trainer = TheTrainer(TrainerConfig(model="fisherfaces", kfold=3))
+    trainer.train(X, y, names, validate=True)
+    acc = trainer.mean_accuracy
+    assert acc >= 0.75, f"fisherfaces canary accuracy {acc:.3f}"
+
+
+def test_canary_lbph_noise():
+    acc = _canary_kfold("lbph", 12, 8, 3, seed=3, noise=18.0)
+    assert acc >= 0.40, f"lbph canary accuracy {acc:.3f}"
+
+
+def test_canary_cnn_verification():
+    """Tiny ArcFace train + disjoint-identity verification (the CNN row's
+    canary; full 6000-pair protocol runs in scripts/measure_accuracy.py)."""
+    from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
+    from opencv_facerecognizer_tpu.utils.verification import (
+        make_verification_pairs, verification_accuracy)
+
+    size = (32, 32)
+    X_tr, y_tr, _ = make_synthetic_faces(num_subjects=12, per_subject=8,
+                                         size=size, seed=11, noise=10.0)
+    X_te, y_te, _ = make_synthetic_faces(num_subjects=8, per_subject=8,
+                                         size=size, seed=77, noise=10.0)
+    emb = CNNEmbedding(embed_dim=32, input_size=size, stem_features=8,
+                       stage_features=(16, 32), stage_blocks=(1, 1),
+                       train_steps=150, batch_size=32, learning_rate=2e-3,
+                       seed=3)
+    emb.compute(X_tr, y_tr)
+    e = np.array(emb._extract_batch(np.asarray(X_te, np.float32)))
+    a, b, same = make_verification_pairs(y_te, num_pairs=600, seed=5)
+    acc, _, _ = verification_accuracy(e[a], e[b], same, folds=5)
+    # This tiny config plateaus at 0.82-0.85 (vs 0.989 at full scale);
+    # an algorithmic break lands near 0.5, so 0.75 separates cleanly.
+    assert acc >= 0.75, f"cnn verification canary accuracy {acc:.3f}"

@@ -6,6 +6,7 @@ import pytest
 from opencv_facerecognizer_tpu.models import (
     ChainOperator,
     CombineOperator,
+    CombineOperatorND,
     Fisherfaces,
     HistogramEqualization,
     Identity,
@@ -97,6 +98,45 @@ def test_combine_operator_concatenates():
     assert out.shape == (36, 4 + 2 * 2 * 256)
     single = np.asarray(comb.extract(X[1]))
     np.testing.assert_allclose(single, out[1], atol=1e-3)
+
+
+def test_combine_operator_nd_preserves_structure():
+    # Two image-shaped features concatenated without flattening: widths add.
+    comb = CombineOperatorND(TanTriggsPreprocessing(), HistogramEqualization())
+    out = np.asarray(comb.compute(X, Y))
+    assert out.shape == (36, 24, 48)
+    a = np.asarray(TanTriggsPreprocessing().compute(X, Y))
+    b = np.asarray(HistogramEqualization().compute(X, Y))
+    np.testing.assert_allclose(out, np.concatenate([a, b], axis=-1), atol=1e-5)
+    single = np.asarray(comb.extract(X[3]))
+    np.testing.assert_allclose(single, out[3], atol=1e-5)
+    # Non-negative axes address per-sample dims, so batched and single calls
+    # concatenate along the same semantic axis (heights add with axis 0).
+    comb0 = CombineOperatorND(TanTriggsPreprocessing(), HistogramEqualization(),
+                              hstack_axis=0)
+    out0 = np.asarray(comb0.compute(X, Y))
+    assert out0.shape == (36, 48, 24)
+    single0 = np.asarray(comb0.extract(X[3]))
+    assert single0.shape == (48, 24)
+    np.testing.assert_allclose(single0, out0[3], atol=1e-5)
+
+
+def test_combine_operator_nd_roundtrips(tmp_path):
+    from opencv_facerecognizer_tpu.models import NearestNeighbor, PredictableModel
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    feat = ChainOperator(
+        CombineOperatorND(TanTriggsPreprocessing(), HistogramEqualization()),
+        PCA(6),
+    )
+    model = PredictableModel(feat, NearestNeighbor())
+    model.compute(X, Y)
+    path = str(tmp_path / "nd.msgpack")
+    serialization.save_model(path, model)
+    restored = serialization.load_model(path)
+    assert restored.feature.model1.hstack_axis == -1
+    pred0 = model.predict(X[0])[0]
+    assert restored.predict(X[0])[0] == pred0
 
 
 def test_chain_pca_lda_single_sample():

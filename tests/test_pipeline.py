@@ -62,10 +62,11 @@ def test_fused_pipeline_runs_sharded(pipeline_setup, dp, tp):
     assert result.valid.shape == (8, 4)
     assert result.labels.shape == (8, 4, 2)
     assert result.similarities.shape == (8, 4, 2)
-    # detections should roughly track ground truth face counts
+    # detection quality bar (raised from gt//2 per VERDICT round-1 #4):
+    # >=90% of ground-truth faces must come out of the fused graph valid.
     det_count = int(np.asarray(result.valid).sum())
     gt_count = int(counts[:8].sum())
-    assert det_count >= gt_count // 2
+    assert det_count >= int(np.ceil(0.9 * gt_count)), (det_count, gt_count)
     # matched labels for valid faces must be real gallery labels
     valid = np.asarray(result.valid)
     lbl = np.asarray(result.labels)[..., 0]
