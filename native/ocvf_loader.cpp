@@ -1,0 +1,279 @@
+// Native host-side image loader for opencv_facerecognizer_tpu.
+//
+// The reference's host decode path was native C++ (OpenCV's imread/resize —
+// SURVEY.md §2.2 "cv2.resize, cv2.cvtColor, image decode"). This is the
+// rebuild's native equivalent for the formats the classic face datasets
+// actually use (ORL/AT&T and Yale-B ship PGM; PPM/BMP cover the other
+// uncompressed cases): decode -> grayscale luminance -> fused bilinear
+// resize straight into a caller-provided float32 buffer, so read_images can
+// pack a training batch without any intermediate Python objects. JPEG/PNG
+// fall back to PIL in utils/native.py (libjpeg/libpng linkage isn't worth
+// it when the fallback already covers them).
+//
+// Build: g++ -O3 -shared -fPIC -o libocvf_loader.so ocvf_loader.cpp
+// (utils/native.py does this on demand and caches the .so).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int kErrRead = -1;
+constexpr int kErrFormat = -2;
+constexpr int kErrBounds = -3;
+
+struct GrayImage {
+  int h = 0;
+  int w = 0;
+  std::vector<float> px;  // luminance, [0, 255]
+};
+
+// ---- PNM (P2/P3/P5/P6) ----
+
+bool pnm_token(const uint8_t* d, int64_t n, int64_t& pos, long& out) {
+  // Skip whitespace and '#' comments, then parse one non-negative integer.
+  while (pos < n) {
+    uint8_t c = d[pos];
+    if (c == '#') {
+      while (pos < n && d[pos] != '\n') pos++;
+    } else if (c == ' ' || c == '\t' || c == '\r' || c == '\n') {
+      pos++;
+    } else {
+      break;
+    }
+  }
+  if (pos >= n || d[pos] < '0' || d[pos] > '9') return false;
+  long v = 0;
+  while (pos < n && d[pos] >= '0' && d[pos] <= '9') {
+    v = v * 10 + (d[pos] - '0');
+    pos++;
+  }
+  out = v;
+  return true;
+}
+
+int decode_pnm(const uint8_t* d, int64_t n, GrayImage& img) {
+  if (n < 2 || d[0] != 'P') return kErrFormat;
+  int kind = d[1] - '0';
+  if (kind != 2 && kind != 3 && kind != 5 && kind != 6) return kErrFormat;
+  bool color = (kind == 3 || kind == 6);
+  bool ascii = (kind == 2 || kind == 3);
+  int64_t pos = 2;
+  long w, h, maxval;
+  if (!pnm_token(d, n, pos, w) || !pnm_token(d, n, pos, h) ||
+      !pnm_token(d, n, pos, maxval))
+    return kErrFormat;
+  if (w <= 0 || h <= 0 || w > 1 << 16 || h > 1 << 16 || maxval <= 0 ||
+      maxval > 65535)
+    return kErrFormat;
+  img.h = (int)h;
+  img.w = (int)w;
+  img.px.resize((size_t)h * w);
+  double scale = 255.0 / (double)maxval;
+  int64_t count = (int64_t)h * w * (color ? 3 : 1);
+
+  if (ascii) {
+    std::vector<long> vals((size_t)count);
+    for (int64_t i = 0; i < count; i++) {
+      if (!pnm_token(d, n, pos, vals[(size_t)i])) return kErrBounds;
+    }
+    for (int64_t i = 0; i < (int64_t)h * w; i++) {
+      double v = color ? 0.299 * vals[(size_t)(3 * i)] +
+                             0.587 * vals[(size_t)(3 * i + 1)] +
+                             0.114 * vals[(size_t)(3 * i + 2)]
+                       : (double)vals[(size_t)i];
+      img.px[(size_t)i] = (float)(v * scale);
+    }
+    return 0;
+  }
+
+  pos += 1;  // exactly one whitespace byte after maxval in binary PNM
+  int bytes_per = maxval > 255 ? 2 : 1;
+  if (pos + count * bytes_per > n) return kErrBounds;
+  const uint8_t* p = d + pos;
+  for (int64_t i = 0; i < (int64_t)h * w; i++) {
+    double c0, c1, c2;
+    if (bytes_per == 1) {
+      if (color) {
+        c0 = p[3 * i]; c1 = p[3 * i + 1]; c2 = p[3 * i + 2];
+      } else {
+        c0 = c1 = c2 = p[i];
+      }
+    } else {  // 16-bit PNM is big-endian
+      auto rd = [&](int64_t j) { return (double)((p[2 * j] << 8) | p[2 * j + 1]); };
+      if (color) {
+        c0 = rd(3 * i); c1 = rd(3 * i + 1); c2 = rd(3 * i + 2);
+      } else {
+        c0 = c1 = c2 = rd(i);
+      }
+    }
+    double v = color ? 0.299 * c0 + 0.587 * c1 + 0.114 * c2 : c0;
+    img.px[(size_t)i] = (float)(v * scale);
+  }
+  return 0;
+}
+
+// ---- BMP (uncompressed 8/24/32-bit) ----
+
+uint32_t le32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+uint16_t le16(const uint8_t* p) { return (uint16_t)(p[0] | (p[1] << 8)); }
+
+int decode_bmp(const uint8_t* d, int64_t n, GrayImage& img) {
+  if (n < 54 || d[0] != 'B' || d[1] != 'M') return kErrFormat;
+  uint32_t data_off = le32(d + 10);
+  uint32_t hdr_size = le32(d + 14);
+  if (hdr_size < 40) return kErrFormat;
+  int32_t w = (int32_t)le32(d + 18);
+  int32_t h = (int32_t)le32(d + 22);
+  uint16_t bpp = le16(d + 28);
+  uint32_t compression = le32(d + 30);
+  bool bottom_up = h > 0;
+  int32_t ah = bottom_up ? h : -h;
+  if (w <= 0 || ah <= 0 || w > 1 << 16 || ah > 1 << 16) return kErrFormat;
+  if (compression != 0 || (bpp != 8 && bpp != 24 && bpp != 32))
+    return kErrFormat;
+
+  const uint8_t* palette = nullptr;
+  uint32_t pal_colors = 256;
+  if (bpp == 8) {
+    uint32_t colors = le32(d + 46);
+    if (colors == 0 || colors > 256) colors = 256;
+    // int64 arithmetic: uint32 sums here can wrap on crafted headers and
+    // pass the check, leaving the pixel loop reading past the buffer.
+    int64_t pal_off = 14 + (int64_t)hdr_size;
+    int64_t pal_end = pal_off + 4 * (int64_t)colors;
+    if (pal_end > (int64_t)data_off || pal_end > n) return kErrFormat;
+    palette = d + pal_off;  // BGRA quads
+    pal_colors = colors;    // pixel indices are clamped to this below
+  }
+  int64_t row_bytes = (((int64_t)w * bpp + 31) / 32) * 4;
+  if ((int64_t)data_off + row_bytes * ah > n) return kErrBounds;
+
+  img.h = ah;
+  img.w = w;
+  img.px.resize((size_t)ah * w);
+  for (int32_t y = 0; y < ah; y++) {
+    const uint8_t* row = d + data_off + row_bytes * (bottom_up ? ah - 1 - y : y);
+    for (int32_t x = 0; x < w; x++) {
+      double b, g, r;
+      if (bpp == 8) {
+        uint32_t ci = row[x];
+        if (ci >= pal_colors) ci = pal_colors - 1;  // corrupt pixel index
+        const uint8_t* q = palette + 4 * ci;
+        b = q[0]; g = q[1]; r = q[2];
+      } else {
+        const uint8_t* q = row + (bpp / 8) * x;
+        b = q[0]; g = q[1]; r = q[2];
+      }
+      img.px[(size_t)y * w + x] = (float)(0.299 * r + 0.587 * g + 0.114 * b);
+    }
+  }
+  return 0;
+}
+
+int decode_any(const uint8_t* d, int64_t n, GrayImage& img) {
+  if (n >= 2 && d[0] == 'P' && d[1] >= '2' && d[1] <= '6')
+    return decode_pnm(d, n, img);
+  if (n >= 2 && d[0] == 'B' && d[1] == 'M') return decode_bmp(d, n, img);
+  return kErrFormat;
+}
+
+// Bilinear resize (align_corners=false, the cv2/PIL convention) into out.
+void resize_bilinear(const GrayImage& img, int oh, int ow, float* out) {
+  if (oh == img.h && ow == img.w) {
+    memcpy(out, img.px.data(), sizeof(float) * (size_t)oh * ow);
+    return;
+  }
+  double sy = (double)img.h / oh, sx = (double)img.w / ow;
+  for (int y = 0; y < oh; y++) {
+    double fy = (y + 0.5) * sy - 0.5;
+    int y0 = (int)fy;
+    if (fy < 0) { fy = 0; y0 = 0; }
+    int y1 = y0 + 1 < img.h ? y0 + 1 : img.h - 1;
+    double wy = fy - y0;
+    for (int x = 0; x < ow; x++) {
+      double fx = (x + 0.5) * sx - 0.5;
+      int x0 = (int)fx;
+      if (fx < 0) { fx = 0; x0 = 0; }
+      int x1 = x0 + 1 < img.w ? x0 + 1 : img.w - 1;
+      double wx = fx - x0;
+      const float* p = img.px.data();
+      double top = p[(size_t)y0 * img.w + x0] * (1 - wx) +
+                   p[(size_t)y0 * img.w + x1] * wx;
+      double bot = p[(size_t)y1 * img.w + x0] * (1 - wx) +
+                   p[(size_t)y1 * img.w + x1] * wx;
+      out[(size_t)y * ow + x] = (float)(top * (1 - wy) + bot * wy);
+    }
+  }
+}
+
+int load_file(const char* path, std::vector<uint8_t>& buf) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrRead;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  if (sz <= 0) { fclose(f); return kErrRead; }
+  buf.resize((size_t)sz);
+  size_t got = fread(buf.data(), 1, (size_t)sz, f);
+  fclose(f);
+  return got == (size_t)sz ? 0 : kErrRead;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe dims without decoding pixels. Returns 0 and fills h/w on success.
+int ocvf_probe(const uint8_t* data, int64_t len, int* h, int* w) {
+  GrayImage img;
+  int rc = decode_any(data, len, img);  // simple formats: decode IS cheap
+  if (rc != 0) return rc;
+  *h = img.h;
+  *w = img.w;
+  return 0;
+}
+
+// Decode + grayscale + resize to [out_h, out_w] float32 (0..255 range).
+// out_h/out_w <= 0 means native size — caller must have probed.
+int ocvf_decode_gray(const uint8_t* data, int64_t len, int out_h, int out_w,
+                     float* out) {
+  GrayImage img;
+  int rc = decode_any(data, len, img);
+  if (rc != 0) return rc;
+  if (out_h <= 0 || out_w <= 0) {
+    out_h = img.h;
+    out_w = img.w;
+  }
+  resize_bilinear(img, out_h, out_w, out);
+  return 0;
+}
+
+// File variant.
+int ocvf_load_gray(const char* path, int out_h, int out_w, float* out) {
+  std::vector<uint8_t> buf;
+  int rc = load_file(path, buf);
+  if (rc != 0) return rc;
+  return ocvf_decode_gray(buf.data(), (int64_t)buf.size(), out_h, out_w, out);
+}
+
+// Pack a batch of files into one [count, out_h, out_w] float32 buffer.
+// status[i] receives the per-file return code; returns number decoded OK.
+int ocvf_load_batch(const char* const* paths, int count, int out_h, int out_w,
+                    float* out, int* status) {
+  int ok = 0;
+  for (int i = 0; i < count; i++) {
+    status[i] = ocvf_load_gray(paths[i], out_h, out_w,
+                               out + (size_t)i * out_h * out_w);
+    if (status[i] == 0) ok++;
+  }
+  return ok;
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: fused gallery similarity + streaming top-k.
+
+The hot op of the serving path (SURVEY.md §3.4: the reference's
+``NearestNeighbor.predict`` "distances to ALL gallery vectors -> argsort"
+loop) is a [Q, D] x [D, N] similarity matmul followed by top-k. The XLA
+formulation (``parallel.gallery.match_global``) materializes the [Q, N]
+score matrix in HBM before ``lax.top_k`` reads it back — at Q=256 over a
+1M-row gallery that is a 1 GB f32 round-trip per batch, pure HBM-bandwidth
+waste for k<=8 survivors per query.
+
+This kernel streams the gallery through VMEM in [block_n, D] tiles
+(flash-attention-style): each grid step computes one [block_q, block_n]
+score tile on the MXU and folds it into a running [block_q, k] top-k
+accumulator that lives in the output VMEM block across the gallery-tile
+grid axis — the [Q, N] matrix never exists anywhere. Scores use bf16
+operands with f32 accumulation (MXU native); the merge is k static
+max-extract passes on the VPU (k is small and static, so no sort network
+is needed).
+
+Used by ``ShardedGallery`` as the single-shard fast path; the XLA
+formulation stays both the multi-chip GSPMD path (XLA cannot partition a
+custom call across tp shards) and the correctness oracle in tests, which
+run this kernel in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # plain float: a jnp scalar would be a captured constant in the kernel
+
+
+def _match_kernel(q_ref, g_ref, valid_ref, vals_ref, idx_ref, *, k: int,
+                  block_n: int):
+    """One (query-block, gallery-tile) grid step.
+
+    q_ref [BQ, D]; g_ref [BN, D]; valid_ref [1, BN] f32 (0/1);
+    vals_ref/idx_ref [BQ, k] — the running top-k, revisited across the
+    gallery-tile grid axis (accumulator pattern: same output block for
+    every j, written back after the last visit).
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        vals_ref[:] = jnp.full(vals_ref.shape, NEG_INF, jnp.float32)
+        idx_ref[:] = jnp.full(idx_ref.shape, -1, jnp.int32)
+
+    # MXU: bf16 operands, f32 accumulation (same precision split as the
+    # XLA path in parallel.gallery.match_global).
+    s = jax.lax.dot_general(
+        q_ref[:].astype(jnp.bfloat16),
+        g_ref[:].astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [BQ, BN]
+    s = jnp.where(valid_ref[:] > 0.5, s, NEG_INF)
+    bq = s.shape[0]
+    col = j * block_n + jax.lax.broadcasted_iota(jnp.int32, (bq, block_n), 1)
+
+    cand_vals = jnp.concatenate([vals_ref[:], s], axis=1)  # [BQ, k+BN]
+    cand_idx = jnp.concatenate([idx_ref[:], col], axis=1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, cand_vals.shape, 1)
+    new_vals, new_idx = [], []
+    for _ in range(k):  # k is small and static: unrolled VPU max-extracts
+        best = jnp.max(cand_vals, axis=1, keepdims=True)  # [BQ, 1]
+        am = jnp.argmax(cand_vals, axis=1)  # [BQ]
+        hit = pos == am[:, None]  # first-max one-hot
+        best_idx = jnp.sum(jnp.where(hit, cand_idx, 0), axis=1,
+                           keepdims=True)  # [BQ, 1]
+        new_vals.append(best)
+        new_idx.append(best_idx)
+        cand_vals = jnp.where(hit, NEG_INF, cand_vals)
+    vals_ref[:] = jnp.concatenate(new_vals, axis=1)
+    idx_ref[:] = jnp.concatenate(new_idx, axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("k", "block_q", "block_n", "interpret")
+)
+def streaming_match_topk(q, g, valid, *, k: int = 1, block_q: int = 128,
+                         block_n: int = 512, interpret: bool = False):
+    """Top-k cosine/dot similarity of queries against a gallery, streamed.
+
+    q [Q, D] float; g [N, D] float; valid [N] bool/0-1 mask.
+    Returns (sims [Q, k] f32, indices [Q, k] int32); invalid rows never
+    surface (masked to -1e30 / index of a masked row only when fewer than
+    k valid rows exist). Q and N are padded up to block multiples here,
+    so any sizes work; D should be modest (fits VMEM with the tiles).
+    """
+    q = jnp.asarray(q, jnp.float32)
+    g = jnp.asarray(g, jnp.float32)
+    qn, d = q.shape
+    n = g.shape[0]
+    block_q = min(block_q, max(8, int(np.ceil(qn / 8) * 8)))
+    block_n = min(block_n, n) if n >= 128 else n
+    q_pad = (-qn) % block_q
+    n_pad = (-n) % block_n
+    if q_pad:
+        q = jnp.pad(q, ((0, q_pad), (0, 0)))
+    if n_pad:
+        g = jnp.pad(g, ((0, n_pad), (0, 0)))
+    validf = jnp.pad(
+        jnp.asarray(valid, jnp.float32), (0, n_pad)
+    ).reshape(1, -1)
+    grid = (q.shape[0] // block_q, g.shape[0] // block_n)
+    vals, idx = pl.pallas_call(
+        functools.partial(_match_kernel, k=k, block_n=block_n),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_n, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_q, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_q, k), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((q.shape[0], k), jnp.float32),
+            jax.ShapeDtypeStruct((q.shape[0], k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(q, g, validf)
+    return vals[:qn], idx[:qn]

@@ -103,14 +103,21 @@ class GalleryData(NamedTuple):
 class ShardedGallery:
     """Enrolled gallery of L2-normalized embeddings, row-sharded over tp."""
 
+    #: capacity above which the pallas streaming kernel beats the XLA
+    #: materialize+top_k path on real hardware (measured on v5e: 1.08x at
+    #: 131k rows, 1.73x at 1M; parity/noise at 16k).
+    PALLAS_MIN_CAPACITY = 65536
+
     def __init__(
         self,
         capacity: int,
         dim: int,
         mesh: Mesh,
         labels_pad: int = -1,
+        use_pallas: Optional[bool] = None,
     ):
         self.mesh = mesh
+        self._use_pallas_cfg = use_pallas
         tp = mesh.shape[TP_AXIS]
         # Round capacity up so every tp shard is equal (static shapes).
         self.capacity = int(np.ceil(capacity / tp) * tp)
@@ -256,18 +263,57 @@ class ShardedGallery:
 
     # ---- matching (device-side) ----
 
+    def _pallas_enabled(self) -> bool:
+        """Single-device large-gallery fast path: the streaming pallas
+        kernel (ops.pallas_match) never materializes [Q, capacity] in HBM.
+        Multi-chip stays on the GSPMD formulation — XLA cannot partition a
+        custom call across the tp axis."""
+        if self._use_pallas_cfg is not None:
+            return bool(self._use_pallas_cfg)
+        dev = self.mesh.devices.flat[0]
+        return (
+            self.mesh.size == 1
+            and dev.platform == "tpu"
+            and self.capacity >= self.PALLAS_MIN_CAPACITY
+        )
+
+    def match_fn(self, k: int):
+        """Pure ``(q, emb, valid, labels) -> (labels, sims, idx)`` match
+        function with the pallas-vs-GSPMD selection applied — shared by
+        ``match()`` and the fused pipeline step (``parallel.pipeline``), so
+        every caller of the hot op gets the streaming fast path, not just
+        direct ``gallery.match()`` users. Not jitted here: callers inline
+        it into their own jitted graphs."""
+        if self._pallas_enabled():
+            from opencv_facerecognizer_tpu.ops.pallas_match import (
+                streaming_match_topk,
+            )
+
+            interpret = self.mesh.devices.flat[0].platform != "tpu"
+
+            def fn(q, g, valid, labels):
+                vals, idx = streaming_match_topk(
+                    q, g, valid, k=k, interpret=interpret
+                )
+                return jnp.take(labels, idx), vals, idx
+
+            return fn
+        return functools.partial(match_global, k=k, mesh=self.mesh)
+
     def _matcher(self, k: int):
         if k not in self._match_cache:
-            kernel = functools.partial(match_global, k=k, mesh=self.mesh)
-            fn = jax.jit(
-                kernel,
-                in_shardings=(
-                    NamedSharding(self.mesh, P(DP_AXIS, None)),
-                    self._emb_sharding,
-                    self._valid_sharding,
-                    self._lab_sharding,
-                ),
-            )
+            if self._pallas_enabled():
+                fn = jax.jit(self.match_fn(k))
+            else:
+                fn = jax.jit(
+                    self.match_fn(k),
+                    in_shardings=(
+                        NamedSharding(self.mesh, P(DP_AXIS, None)),
+                        self._emb_sharding,
+                        self._valid_sharding,
+                        self._lab_sharding,
+                    ),
+                )
             self._match_cache[k] = fn
         return self._match_cache[k]
 

@@ -25,7 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from opencv_facerecognizer_tpu.models import detector as detector_mod
 from opencv_facerecognizer_tpu.models import embedder as embedder_mod
 from opencv_facerecognizer_tpu.ops import image as image_ops
-from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery, match_global
+from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
 
 
@@ -94,6 +94,10 @@ class RecognitionPipeline:
         face_size = self.face_size
         embed_net = self.embed_net
         max_faces = det.max_faces
+        # The gallery owns matcher selection (pallas streaming vs GSPMD
+        # global view) — the fused step inherits whichever fits the mesh
+        # and capacity; _step_key re-selects if the gallery grows.
+        match = self.gallery.match_fn(k)
 
         def step(det_params, emb_params, gallery_emb, gallery_valid, gallery_labels, frames):
             # 1) detect (dense convs; dp-sharded batch)
@@ -108,10 +112,10 @@ class RecognitionPipeline:
             emb = embed_net.apply(
                 {"params": emb_params}, embedder_mod.normalize_faces(flat, face_size)
             )  # [B*K, E] unit-norm
-            # 4) match against the tp-sharded gallery (GSPMD global view —
-            # see parallel.gallery.match_global for why not shard_map)
-            labels, sims, _ = match_global(
-                emb, gallery_emb, gallery_valid, gallery_labels, k=k, mesh=mesh
+            # 4) match against the gallery (selection in gallery.match_fn:
+            # GSPMD global view when sharded, pallas streaming single-chip)
+            labels, sims, _ = match(
+                emb, gallery_emb, gallery_valid, gallery_labels
             )
             return RecognitionResult(
                 boxes=boxes,
@@ -124,13 +128,21 @@ class RecognitionPipeline:
         frames_sharding = NamedSharding(mesh, P(DP_AXIS, None, None))
         return jax.jit(step, in_shardings=(None, None, None, None, None, frames_sharding))
 
+    def _step_key(self, frames: jnp.ndarray):
+        # Gallery capacity (and with it the pallas/GSPMD selection) can
+        # change at runtime via auto-grow — bake both into the cache key so
+        # a grown gallery re-selects its matcher instead of re-tracing the
+        # old closure at the new shapes.
+        return (*frames.shape, self.gallery.capacity,
+                self.gallery._pallas_enabled())
+
     def recognize_batch(self, frames: jnp.ndarray) -> RecognitionResult:
         """[B, H, W] frames -> RecognitionResult; B must divide by dp size,
         and B * max_faces must too (it does when B does)."""
         frames = jnp.asarray(frames, jnp.float32)
-        key = frames.shape
+        key = self._step_key(frames)
         if key not in self._step_cache:
-            self._step_cache[key] = self._build_step(*key)
+            self._step_cache[key] = self._build_step(*frames.shape)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         return self._step_cache[key](
             self.detector.params,
@@ -146,11 +158,11 @@ class RecognitionPipeline:
         [B, K, 6 + 2k] f32 array (see ``pack_result``) — the serving loop's
         single-readback path. Decode host-side with ``unpack_result``."""
         frames = jnp.asarray(frames, jnp.float32)
-        key = frames.shape
+        key = self._step_key(frames)
         if key not in self._packed_cache:
             step = self._step_cache.get(key)
             if step is None:
-                step = self._step_cache[key] = self._build_step(*key)
+                step = self._step_cache[key] = self._build_step(*frames.shape)
 
             def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr):
                 return pack_result(step(det_p, emb_p, g_emb, g_valid, g_lab, fr))

@@ -20,6 +20,15 @@ import numpy as np
 
 
 def _imread_gray(path: str) -> Optional[np.ndarray]:
+    # Native C++ decode first (PGM/PPM/BMP — the classic face-dataset
+    # formats; SURVEY.md §2.2's host decode path was native in the
+    # reference too). Unsupported formats fall through to cv2/PIL.
+    from opencv_facerecognizer_tpu.utils import native
+
+    if native.handles(path):
+        img = native.load_gray(path)
+        if img is not None:
+            return img
     try:
         import cv2
 
@@ -76,6 +85,8 @@ def read_images(
     subjects = sorted(
         d for d in os.listdir(path) if os.path.isdir(os.path.join(path, d))
     )
+    from opencv_facerecognizer_tpu.utils import native
+
     for subject in subjects:
         subject_dir = os.path.join(path, subject)
         files = sorted(os.listdir(subject_dir))
@@ -83,12 +94,24 @@ def read_images(
         # readable images cannot shift later subjects onto wrong names.
         label = len(names)
         count = 0
-        for fn in files:
-            img = _imread_gray(os.path.join(subject_dir, fn))
-            if img is None:
-                continue
-            if image_size is not None:
-                img = _resize_gray(img, image_size)
+        paths = [os.path.join(subject_dir, fn) for fn in files]
+        native_ok = np.zeros((len(paths),), bool)
+        batch = None
+        if image_size is not None and native.available():
+            # Fast path: decode+resize the subject's whole folder into one
+            # packed buffer in native code; failures fall back per-file.
+            native_paths = [p if native.handles(p) else "" for p in paths]
+            if any(native_paths):
+                batch, native_ok = native.load_batch(native_paths, image_size)
+        for i, p in enumerate(paths):
+            if native_ok[i]:
+                img = batch[i]
+            else:
+                img = _imread_gray(p)
+                if img is None:
+                    continue
+                if image_size is not None:
+                    img = _resize_gray(img, image_size)
             images.append(img)
             labels.append(label)
             count += 1

@@ -1,0 +1,189 @@
+"""ctypes bindings for the native C++ image loader (native/ocvf_loader.cpp).
+
+The reference's host decode/resize path was native C++ via OpenCV
+(SURVEY.md §2.2); this module is the rebuild's equivalent, covering the
+uncompressed formats the classic face datasets use (PGM/PPM/BMP — ORL and
+Yale-B are PGM). Anything else (JPEG/PNG) returns None here and
+``utils.dataset`` falls back to PIL.
+
+The shared library is compiled on demand with g++ (one time, cached next
+to the source as ``native/libocvf_loader.so``); pybind11 is not available
+in this environment, so the boundary is a flat ``extern "C"`` API over
+preallocated numpy buffers — zero copies on the Python side.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO, "native", "ocvf_loader.cpp")
+_SO = os.path.join(_REPO, "native", "libocvf_loader.so")
+
+_lock = threading.Lock()
+_lib_handle = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    # Compile to a private temp path and rename into place: a concurrent or
+    # interrupted build must never leave a truncated .so at _SO (dlopen of a
+    # half-written ELF would permanently disable the loader for readers, and
+    # the mtime check would skip rebuilding it).
+    tmp = f"{_SO}.build.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib_handle, _lib_failed
+    if _lib_handle is not None or _lib_failed:
+        return _lib_handle
+    with _lock:
+        if _lib_handle is not None or _lib_failed:
+            return _lib_handle
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not (os.path.exists(_SRC) and _build()):
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.ocvf_probe.restype = ctypes.c_int
+            lib.ocvf_probe.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ]
+            lib.ocvf_decode_gray.restype = ctypes.c_int
+            lib.ocvf_decode_gray.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.ocvf_load_gray.restype = ctypes.c_int
+            lib.ocvf_load_gray.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float),
+            ]
+            lib.ocvf_load_batch.restype = ctypes.c_int
+            lib.ocvf_load_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+                ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int),
+            ]
+            _lib_handle = lib
+        except OSError:
+            _lib_failed = True
+    return _lib_handle
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+_MAGIC = (b"P2", b"P3", b"P5", b"P6", b"BM")
+
+
+def handles(path_or_bytes) -> bool:
+    """Cheap magic-byte check: is this a format the native loader decodes?"""
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        head = bytes(path_or_bytes[:2])
+    else:
+        try:
+            with open(path_or_bytes, "rb") as f:
+                head = f.read(2)
+        except OSError:
+            return False
+    return head in _MAGIC
+
+
+def decode_gray(
+    data: bytes, size: Optional[Tuple[int, int]] = None
+) -> Optional[np.ndarray]:
+    """Decode PGM/PPM/BMP bytes -> float32 [H, W] (0..255), optionally
+    resized to ``size=(H, W)``. None when unsupported/undecodable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    buf = ctypes.create_string_buffer(data, len(data))
+    if size is None:
+        h, w = ctypes.c_int(), ctypes.c_int()
+        if lib.ocvf_probe(ctypes.cast(buf, ctypes.c_char_p), len(data),
+                          ctypes.byref(h), ctypes.byref(w)) != 0:
+            return None
+        oh, ow = h.value, w.value
+    else:
+        oh, ow = int(size[0]), int(size[1])
+    out = np.empty((oh, ow), np.float32)
+    rc = lib.ocvf_decode_gray(
+        ctypes.cast(buf, ctypes.c_char_p), len(data), oh, ow,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out if rc == 0 else None
+
+
+def load_gray(
+    path: str, size: Optional[Tuple[int, int]] = None
+) -> Optional[np.ndarray]:
+    """Load + decode + resize one file; None on any failure (caller falls
+    back to PIL)."""
+    lib = _lib()
+    if lib is None or not handles(path):
+        return None
+    if size is None:
+        try:
+            with open(path, "rb") as f:
+                return decode_gray(f.read(), None)
+        except OSError:
+            return None
+    out = np.empty((int(size[0]), int(size[1])), np.float32)
+    rc = lib.ocvf_load_gray(
+        path.encode(), int(size[0]), int(size[1]),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out if rc == 0 else None
+
+
+def load_batch(
+    paths: List[str], size: Tuple[int, int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack many files into one [N, H, W] float32 batch in native code.
+
+    Returns (batch, ok_mask); rows with ok_mask False were undecodable (the
+    caller decides whether to PIL-fallback or skip them).
+    """
+    lib = _lib()
+    n = len(paths)
+    oh, ow = int(size[0]), int(size[1])
+    out = np.zeros((n, oh, ow), np.float32)
+    if lib is None or n == 0:
+        return out, np.zeros((n,), bool)
+    arr = (ctypes.c_char_p * n)(*[p.encode() for p in paths])
+    status = np.empty((n,), np.int32)
+    lib.ocvf_load_batch(
+        arr, n, oh, ow,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        status.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+    )
+    return out, status == 0

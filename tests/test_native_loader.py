@@ -1,0 +1,147 @@
+"""Native C++ loader vs PIL oracle (native/ocvf_loader.cpp via utils.native).
+
+Builds the .so on first use (g++ is in the image); if the toolchain were
+ever absent, utils.native reports unavailable and read_images falls back to
+PIL — the skip below keeps the suite honest about which path ran.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.utils import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native loader unavailable (no g++?)"
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _write_pgm(path, img, maxval=255):
+    h, w = img.shape
+    with open(path, "wb") as f:
+        f.write(f"P5\n# comment\n{w} {h}\n{maxval}\n".encode())
+        if maxval > 255:
+            f.write(img.astype(">u2").tobytes())
+        else:
+            f.write(img.astype(np.uint8).tobytes())
+
+
+def _write_ppm(path, rgb):
+    h, w, _ = rgb.shape
+    with open(path, "wb") as f:
+        f.write(f"P6\n{w} {h}\n255\n".encode())
+        f.write(rgb.astype(np.uint8).tobytes())
+
+
+def _write_bmp24(path, rgb):
+    h, w, _ = rgb.shape
+    row = (w * 3 + 3) & ~3
+    data_size = row * h
+    with open(path, "wb") as f:
+        f.write(b"BM")
+        f.write(struct.pack("<IHHI", 54 + data_size, 0, 0, 54))
+        f.write(struct.pack("<IiiHHIIiiII", 40, w, h, 1, 24, 0, data_size,
+                            2835, 2835, 0, 0))
+        pad = b"\x00" * (row - w * 3)
+        for y in range(h - 1, -1, -1):  # bottom-up
+            bgr = rgb[y, :, ::-1].astype(np.uint8).tobytes()
+            f.write(bgr + pad)
+
+
+def test_pgm_roundtrip_exact(tmp_path):
+    img = RNG.integers(0, 256, size=(37, 29)).astype(np.uint8)
+    p = str(tmp_path / "a.pgm")
+    _write_pgm(p, img)
+    out = native.load_gray(p)
+    np.testing.assert_array_equal(out, img.astype(np.float32))
+
+
+def test_pgm_16bit_scales_to_255(tmp_path):
+    img = RNG.integers(0, 65536, size=(16, 16)).astype(np.uint16)
+    p = str(tmp_path / "a16.pgm")
+    _write_pgm(p, img, maxval=65535)
+    out = native.load_gray(p)
+    np.testing.assert_allclose(out, img * (255.0 / 65535.0), atol=1e-3)
+
+
+def test_ppm_luminance_matches_pil(tmp_path):
+    from PIL import Image
+
+    rgb = RNG.integers(0, 256, size=(24, 31, 3)).astype(np.uint8)
+    p = str(tmp_path / "c.ppm")
+    _write_ppm(p, rgb)
+    out = native.load_gray(p)
+    with Image.open(p) as im:
+        ref = np.asarray(im.convert("L"), np.float32)
+    # PIL rounds to uint8; we keep float — allow 1 level
+    np.testing.assert_allclose(out, ref, atol=1.0)
+
+
+def test_bmp_matches_pil(tmp_path):
+    from PIL import Image
+
+    rgb = RNG.integers(0, 256, size=(20, 26, 3)).astype(np.uint8)
+    p = str(tmp_path / "d.bmp")
+    _write_bmp24(p, rgb)
+    out = native.load_gray(p)
+    with Image.open(p) as im:
+        ref = np.asarray(im.convert("L"), np.float32)
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(out, ref, atol=1.0)
+
+
+def test_fused_resize_matches_separate(tmp_path):
+    from opencv_facerecognizer_tpu.utils.dataset import _resize_gray
+
+    img = RNG.integers(0, 256, size=(70, 60)).astype(np.uint8)
+    p = str(tmp_path / "r.pgm")
+    _write_pgm(p, img)
+    out = native.load_gray(p, size=(32, 32))
+    assert out.shape == (32, 32)
+    ref = _resize_gray(img.astype(np.float32), (32, 32))
+    # Same half-pixel bilinear convention as PIL: small interpolation slack
+    np.testing.assert_allclose(out, ref, atol=2.0)
+
+
+def test_load_batch_packs_and_flags_failures(tmp_path):
+    imgs = [RNG.integers(0, 256, size=(40, 40)).astype(np.uint8)
+            for _ in range(3)]
+    paths = []
+    for i, img in enumerate(imgs):
+        p = str(tmp_path / f"s{i}.pgm")
+        _write_pgm(p, img)
+        paths.append(p)
+    bad = str(tmp_path / "bad.pgm")
+    open(bad, "wb").write(b"P5\nnot really\n")
+    paths.insert(2, bad)
+    batch, ok = native.load_batch(paths, (40, 40))
+    assert batch.shape == (4, 40, 40)
+    np.testing.assert_array_equal(ok, [True, True, False, True])
+    np.testing.assert_array_equal(batch[0], imgs[0].astype(np.float32))
+    np.testing.assert_array_equal(batch[3], imgs[2].astype(np.float32))
+
+
+def test_read_images_uses_native_path(tmp_path):
+    from opencv_facerecognizer_tpu.utils.dataset import read_images
+
+    for subj in ("alice", "bob"):
+        d = tmp_path / subj
+        d.mkdir()
+        for i in range(3):
+            _write_pgm(str(d / f"{i}.pgm"),
+                       RNG.integers(0, 256, size=(50, 44)).astype(np.uint8))
+    X, y, names = read_images(str(tmp_path), image_size=(32, 32))
+    assert X.shape == (6, 32, 32) and names == ["alice", "bob"]
+    np.testing.assert_array_equal(np.unique(y), [0, 1])
+
+
+def test_malformed_inputs_rejected():
+    assert native.decode_gray(b"") is None
+    assert native.decode_gray(b"P5\n10 10\n255\nshort") is None
+    assert native.decode_gray(b"\x89PNG\r\n") is None  # unsupported magic
+    # truncated BMP header
+    assert native.decode_gray(b"BM" + b"\x00" * 20) is None

@@ -1,0 +1,85 @@
+"""Streaming pallas top-k matcher vs the lax.top_k oracle.
+
+Runs in interpret mode on the CPU suite (SURVEY.md §4 prescription: every
+kernel gets an oracle test); the compiled-TPU path is exercised by bench.py
+and the gallery fast path on the real chip.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.ops.pallas_match import streaming_match_topk
+
+RNG = np.random.default_rng(3)
+
+
+def _oracle(q, g, valid, k):
+    sims = q.astype(np.float32) @ g.astype(np.float32).T
+    sims = np.where(np.asarray(valid)[None, :], sims, -1e30)
+    idx = np.argsort(-sims, axis=1)[:, :k]
+    return np.take_along_axis(sims, idx, axis=1), idx
+
+
+def _normed(shape):
+    x = RNG.normal(size=shape).astype(np.float32)
+    return x / np.linalg.norm(x, axis=-1, keepdims=True)
+
+
+@pytest.mark.parametrize("qn,n,k", [(8, 256, 1), (16, 512, 4), (32, 1024, 8)])
+def test_streaming_topk_matches_oracle(qn, n, k):
+    q = _normed((qn, 64))
+    g = _normed((n, 64))
+    valid = np.ones(n, bool)
+    vals, idx = streaming_match_topk(jnp.asarray(q), jnp.asarray(g),
+                                     jnp.asarray(valid), k=k,
+                                     block_q=8, block_n=128, interpret=True)
+    ovals, _ = _oracle(q, g, valid, k)
+    # bf16 matmul: compare values loosely, and exact given re-scored indices
+    np.testing.assert_allclose(np.asarray(vals), ovals, atol=2e-2)
+    rescored = np.take_along_axis(q @ g.T, np.asarray(idx), axis=1)
+    np.testing.assert_allclose(np.sort(rescored), np.sort(ovals), atol=2e-2)
+
+
+def test_streaming_topk_masks_invalid_rows():
+    q = _normed((8, 32))
+    g = _normed((256, 32))
+    valid = np.zeros(256, bool)
+    valid[:7] = True  # fewer valid rows than would fill k on some tiles
+    vals, idx = streaming_match_topk(jnp.asarray(q), jnp.asarray(g),
+                                     jnp.asarray(valid), k=4,
+                                     block_q=8, block_n=64, interpret=True)
+    idx = np.asarray(idx)
+    vals = np.asarray(vals)
+    real = vals > -1e29
+    assert np.all(idx[real] < 7), "an invalid gallery row surfaced"
+    ovals, oidx = _oracle(q, g, valid, 4)
+    np.testing.assert_allclose(vals[real], ovals[real.nonzero()[0],
+                                                 real.nonzero()[1]], atol=2e-2)
+
+
+def test_streaming_topk_unaligned_sizes():
+    # Q and N not multiples of the blocks: padding path.
+    q = _normed((13, 48))
+    g = _normed((300, 48))
+    valid = np.ones(300, bool)
+    valid[250:] = False
+    vals, idx = streaming_match_topk(jnp.asarray(q), jnp.asarray(g),
+                                     jnp.asarray(valid), k=3,
+                                     block_q=8, block_n=128, interpret=True)
+    assert vals.shape == (13, 3) and idx.shape == (13, 3)
+    ovals, _ = _oracle(q, g, valid, 3)
+    np.testing.assert_allclose(np.asarray(vals), ovals, atol=2e-2)
+    assert np.all(np.asarray(idx) < 250)
+
+
+def test_streaming_topk_duplicate_scores_unique_indices():
+    # Identical gallery rows: the k winners must be k distinct indices.
+    g = np.tile(_normed((1, 16)), (64, 1)).astype(np.float32)
+    q = g[:4]
+    vals, idx = streaming_match_topk(jnp.asarray(q), jnp.asarray(g),
+                                     jnp.ones(64, bool), k=4,
+                                     block_q=8, block_n=32, interpret=True)
+    idx = np.asarray(idx)
+    for row in idx:
+        assert len(set(row.tolist())) == 4, row

@@ -106,3 +106,41 @@ def test_query_count_must_divide_dp():
     g.add(RNG.normal(size=(4, 4)).astype(np.float32), np.arange(4, dtype=np.int32))
     with pytest.raises(ValueError, match="divisible"):
         g.match(np.zeros((3, 4), dtype=np.float32), k=1)
+
+
+def test_gallery_pallas_path_matches_gspmd():
+    """use_pallas=True (interpret mode off-TPU) must agree with the GSPMD
+    matcher — the auto fast path may silently switch between them on
+    hardware, so they have to be interchangeable."""
+    rng = np.random.default_rng(17)
+    emb = rng.normal(size=(96, 16)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    labels = rng.integers(0, 12, size=96)
+    q = rng.normal(size=(8, 16)).astype(np.float32)
+    q /= np.linalg.norm(q, axis=-1, keepdims=True)
+
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                (DP_AXIS, TP_AXIS))
+    outs = {}
+    for use_pallas in (False, True):
+        g = ShardedGallery(capacity=128, dim=16, mesh=mesh,
+                           use_pallas=use_pallas)
+        g.add(emb, labels)
+        lab, sims, idx = (np.asarray(v) for v in g.match(q, k=3))
+        outs[use_pallas] = (lab, sims, idx)
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])
+    np.testing.assert_array_equal(outs[False][2], outs[True][2])
+    np.testing.assert_allclose(outs[False][1], outs[True][1], atol=1e-2)
+
+
+def test_gallery_pallas_autodetect_off_on_cpu():
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                (DP_AXIS, TP_AXIS))
+    g = ShardedGallery(capacity=1 << 17, dim=8, mesh=mesh)
+    assert not g._pallas_enabled()  # CPU backend: stays on GSPMD
