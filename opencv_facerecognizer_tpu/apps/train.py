@@ -25,6 +25,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-components", type=int, default=0)
     p.add_argument("--knn-k", type=int, default=1)
     p.add_argument("--no-tan-triggs", action="store_true")
+    p.add_argument("--classifier", default="nn",
+                   choices=["nn", "svm", "kernel_svm"],
+                   help="classifier stage over the feature projection")
+    p.add_argument("--svm-kernel", default="rbf",
+                   choices=["rbf", "poly", "linear"],
+                   help="kernel for --classifier kernel_svm")
     p.add_argument("--embed-dim", type=int, default=128)
     p.add_argument("--train-steps", type=int, default=200)
     p.add_argument("--eigenfaces-plot", default=None,
@@ -37,7 +43,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    # Contradictory combinations fail loudly instead of silently training a
+    # different model than the flags suggest.
+    if args.svm_kernel != "rbf" and args.classifier != "kernel_svm":
+        parser.error("--svm-kernel only applies with --classifier kernel_svm")
+    if args.knn_k != 1 and args.classifier != "nn":
+        parser.error(f"--knn-k only applies with --classifier nn "
+                     f"(got --classifier {args.classifier})")
     from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer, TrainerConfig
 
     config = TrainerConfig(
@@ -47,6 +61,8 @@ def main(argv=None) -> int:
         num_components=args.num_components,
         knn_k=args.knn_k,
         tan_triggs=not args.no_tan_triggs,
+        classifier=args.classifier,
+        svm_kernel=args.svm_kernel,
         embed_dim=args.embed_dim,
         train_steps=args.train_steps,
     )

@@ -24,8 +24,10 @@ from opencv_facerecognizer_tpu.models import (
     ChainOperator,
     ExtendedPredictableModel,
     Fisherfaces,
+    KernelSVM,
     NearestNeighbor,
     PCA,
+    SVM,
     SpatialHistogram,
     TanTriggsPreprocessing,
 )
@@ -50,6 +52,11 @@ class TrainerConfig:
     num_components: int = 0  # subspace dims (0 = auto)
     knn_k: int = 1
     tan_triggs: bool = True
+    # classifier stage: nn (default, per model family) | svm | kernel_svm —
+    # the reference's facerec lineage let any classifier pair with any
+    # feature (SURVEY.md §2.1 "Classifiers": NearestNeighbor and SVM).
+    classifier: str = "nn"
+    svm_kernel: str = "rbf"  # kernel_svm only: rbf | poly | linear
     # cnn backend knobs
     embed_dim: int = 128
     train_steps: int = 200
@@ -94,6 +101,14 @@ class TheTrainer:
             classifier = NearestNeighbor(CosineDistance(), k=cfg.knn_k)
         else:
             raise ValueError(f"unknown model type {self.config.model!r}")
+        if cfg.classifier == "svm":
+            classifier = SVM()
+        elif cfg.classifier == "kernel_svm":
+            classifier = KernelSVM(kernel=cfg.svm_kernel)
+        elif cfg.classifier != "nn":
+            raise ValueError(
+                f"unknown classifier {cfg.classifier!r}; pick nn | svm | kernel_svm"
+            )
         return ExtendedPredictableModel(
             feature, classifier, image_size=cfg.image_size, subject_names=subject_names
         )

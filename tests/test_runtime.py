@@ -354,3 +354,23 @@ def test_trainer_rejects_unknown_model_and_field():
     trainer = TheTrainer(model="nope")
     with pytest.raises(ValueError):
         trainer.train(*make_synthetic_faces(2, 2, (16, 16)))
+
+
+def test_trainer_classifier_swap(tmp_path):
+    """The reference let any classifier pair with any feature; the trainer
+    exposes nn | svm | kernel_svm over every model family."""
+    from opencv_facerecognizer_tpu.models import KernelSVM, SVM
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    X, y, names = make_synthetic_faces(5, 6, (24, 24), seed=41)
+    for clf_kind, clf_type in (("svm", SVM), ("kernel_svm", KernelSVM)):
+        trainer = TheTrainer(model="eigenfaces", image_size=(24, 24),
+                             kfold=0, classifier=clf_kind)
+        path = str(tmp_path / f"{clf_kind}.ckpt")
+        trainer.train(X, y, names, validate=False, model_path=path)
+        assert isinstance(trainer.model.classifier, clf_type)
+        restored = serialization.load_model(path)
+        pred, _ = restored.predict(X[:6])
+        assert (np.asarray(pred) == y[:6]).mean() >= 0.8, clf_kind
+    with pytest.raises(ValueError):
+        TheTrainer(classifier="nope").train(X, y, names, validate=False)
