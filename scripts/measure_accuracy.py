@@ -115,7 +115,7 @@ def main():
          results["eigenfaces_orl"]),
         ("Fisherfaces (TanTriggs+PCA+LDA+NN) k-fold, Yale-B-analog",
          results["fisherfaces_yaleb"]),
-        ("LBPH (SpatialHistogram+ChiSquare NN) k-fold, LFW-analog",
+        ("LBPH (SpatialHistogram r=2 + ChiSquare NN) k-fold, LFW-analog",
          results["lbph_lfw"]),
         ("CNN ArcFace embedding, 6000-pair verification, disjoint identities",
          results["cnn_verification"]),

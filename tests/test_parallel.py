@@ -144,3 +144,61 @@ def test_gallery_pallas_autodetect_off_on_cpu():
                 (DP_AXIS, TP_AXIS))
     g = ShardedGallery(capacity=1 << 17, dim=8, mesh=mesh)
     assert not g._pallas_enabled()  # CPU backend: stays on GSPMD
+
+
+@pytest.mark.parametrize("dp,tp", [(4, 2), (2, 4), (1, 8)])
+def test_pod_pallas_matcher_matches_gspmd(dp, tp):
+    """shard_map + per-shard pallas streaming kernel + collective merge
+    (the multi-chip pallas formulation) must agree with match_global."""
+    from opencv_facerecognizer_tpu.parallel.gallery import (
+        match_global, match_pod_pallas)
+
+    mesh = make_mesh(dp=dp, tp=tp)
+    rng = np.random.default_rng(23)
+    cap = 128
+    emb = rng.normal(size=(cap, 16)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    valid = np.ones(cap, bool)
+    valid[100:] = False
+    labels = rng.integers(0, 20, size=cap).astype(np.int32)
+    q = rng.normal(size=(8, 16)).astype(np.float32)
+    q /= np.linalg.norm(q, axis=-1, keepdims=True)
+
+    args = (jnp.asarray(q), jnp.asarray(emb), jnp.asarray(valid),
+            jnp.asarray(labels))
+    with mesh:
+        pod = match_pod_pallas(*args, k=3, mesh=mesh, interpret=True)
+    ref = match_global(*args, k=3, mesh=mesh)
+    for a, b in zip(pod, ref):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype.kind == "f":
+            np.testing.assert_allclose(a, b, atol=1e-2)
+        else:
+            np.testing.assert_array_equal(a, b)
+
+
+def test_pod_pallas_matcher_sparse_shards():
+    """Startup regime: fewer valid rows than k on most shards — sentinel
+    indices must stay -1 (masked), not alias a neighbor shard's rows."""
+    from opencv_facerecognizer_tpu.parallel.gallery import match_pod_pallas
+
+    mesh = make_mesh(dp=1, tp=8)
+    rng = np.random.default_rng(5)
+    cap = 64  # 8 rows/shard
+    emb = np.zeros((cap, 8), np.float32)
+    valid = np.zeros(cap, bool)
+    labels = np.full(cap, -1, np.int32)
+    emb[0] = rng.normal(size=8)
+    emb[0] /= np.linalg.norm(emb[0])
+    valid[0] = True
+    labels[0] = 7
+    q = np.tile(emb[0], (8, 1))
+    with mesh:
+        lab, sims, idx = (np.asarray(v) for v in match_pod_pallas(
+            jnp.asarray(q), jnp.asarray(emb), jnp.asarray(valid),
+            jnp.asarray(labels), k=3, mesh=mesh, interpret=True))
+    # best hit is the one real row
+    assert (idx[:, 0] == 0).all() and (lab[:, 0] == 7).all()
+    # everything else is masked: sentinel index, -inf-ish score
+    assert (idx[:, 1:] == -1).all(), idx
+    assert (sims[:, 1:] < -1e29).all()
