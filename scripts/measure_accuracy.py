@@ -113,7 +113,7 @@ def main():
     rows = [
         ("Eigenfaces (PCA+NN) k-fold, ORL-analog",
          results["eigenfaces_orl"]),
-        ("Fisherfaces (TanTriggs+PCA+LDA+NN) k-fold, Yale-B-analog",
+        ("Fisherfaces (TanTriggs s0=2,s1=4 + PCA+LDA+NN) k-fold, Yale-B-analog",
          results["fisherfaces_yaleb"]),
         ("LBPH (SpatialHistogram r=2 + ChiSquare NN) k-fold, LFW-analog",
          results["lbph_lfw"]),

@@ -12,9 +12,9 @@ Two layers of guard:
    fast here, without waiting for the next full measurement.
 
 Bands leave margin below the measured values (BASELINE.md: eigenfaces
-0.9575, fisherfaces 0.8117, lbph 0.9719 with the radius-2 default, cnn
-0.9890) to absorb seed/backend jitter while still catching real
-regressions.
+0.9575, fisherfaces 0.9717 with the sigma=2/4 TanTriggs default, lbph
+0.9719 with the radius-2 default, cnn 0.9890) to absorb seed/backend
+jitter while still catching real regressions.
 """
 
 import os
@@ -31,7 +31,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # config key -> (BASELINE.md row label prefix, minimum acceptable accuracy)
 MEASURED_BANDS = {
     "eigenfaces": ("Eigenfaces", 0.90),
-    "fisherfaces": ("Fisherfaces", 0.75),
+    "fisherfaces": ("Fisherfaces", 0.85),  # sigma-2/4 TT measured 0.9717; 0.8117 was sigma-1/2
     "lbph": ("LBPH", 0.85),  # radius-2 default measured 0.95+; 0.525 was radius-1
     "cnn": ("CNN ArcFace", 0.97),
 }
@@ -77,14 +77,15 @@ def test_canary_eigenfaces():
 
 def test_canary_fisherfaces_illumination():
     # 48x48 under-resolves the TanTriggs DoG band for this config
-    # (measured 0.64 there vs 0.88 at 56x56), so this canary keeps 56x56.
+    # (measured 0.64 there vs 0.88+ at 56x56), so this canary keeps 56x56.
     X, y, names = make_synthetic_faces(num_subjects=10, per_subject=8,
                                        size=(56, 56), seed=2,
                                        illumination=0.7, noise=14.0)
     trainer = TheTrainer(TrainerConfig(model="fisherfaces", kfold=3))
     trainer.train(X, y, names, validate=True)
     acc = trainer.mean_accuracy
-    assert acc >= 0.75, f"fisherfaces canary accuracy {acc:.3f}"
+    # the sigma0=2/sigma1=4 TanTriggs default measures 1.0 here
+    assert acc >= 0.85, f"fisherfaces canary accuracy {acc:.3f}"
 
 
 def test_canary_lbph_noise():
