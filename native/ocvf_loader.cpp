@@ -69,11 +69,17 @@ int decode_pnm(const uint8_t* d, int64_t n, GrayImage& img) {
   if (w <= 0 || h <= 0 || w > 1 << 16 || h > 1 << 16 || maxval <= 0 ||
       maxval > 65535)
     return kErrFormat;
+  double scale = 255.0 / (double)maxval;
+  int64_t count = (int64_t)h * w * (color ? 3 : 1);
+  // Bounds-check BEFORE allocating h*w pixels: a crafted header like
+  // "P5 60000 60000" over a 1-byte body must fail here, not in a 14 GB
+  // px.resize (std::bad_alloc aborts the process across the ctypes
+  // boundary). ASCII needs >= 2 bytes (digit + separator) per value.
+  int64_t min_body = ascii ? 2 * count - 1 : count * (maxval > 255 ? 2 : 1);
+  if (pos + min_body > n) return kErrBounds;
   img.h = (int)h;
   img.w = (int)w;
   img.px.resize((size_t)h * w);
-  double scale = 255.0 / (double)maxval;
-  int64_t count = (int64_t)h * w * (color ? 3 : 1);
 
   if (ascii) {
     std::vector<long> vals((size_t)count);

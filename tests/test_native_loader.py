@@ -145,3 +145,18 @@ def test_malformed_inputs_rejected():
     assert native.decode_gray(b"\x89PNG\r\n") is None  # unsupported magic
     # truncated BMP header
     assert native.decode_gray(b"BM" + b"\x00" * 20) is None
+
+
+def test_decoder_fuzz_no_crash():
+    """The C++ decoder must fail closed (None), never crash, on arbitrary
+    bytes — including buffers that start with valid magic numbers."""
+    rng = np.random.default_rng(0)
+    for i in range(300):
+        n = int(rng.integers(0, 2048))
+        buf = bytes(rng.integers(0, 256, size=n, dtype=np.uint8))
+        for prefix in (b"", b"P5\n", b"P6\n", b"P2\n", b"BM"):
+            out = native.decode_gray(prefix + buf, size=(16, 16))
+            assert out is None or out.shape == (16, 16)
+    # headers that declare more pixels than the buffer holds
+    assert native.decode_gray(b"P5\n60000 60000\n255\n\x00") is None
+    assert native.decode_gray(b"P5\n4 4\n65535\n" + b"\x00" * 8) is None
