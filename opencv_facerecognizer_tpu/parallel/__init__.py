@@ -12,7 +12,7 @@ Collectives ride ICI via ``shard_map`` + ``all_gather``/``psum``; the
 host-level application transport stays a separate layer (``runtime``).
 """
 
-from opencv_facerecognizer_tpu.parallel.mesh import make_mesh
+from opencv_facerecognizer_tpu.parallel.mesh import initialize_multihost, make_mesh
 from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 
-__all__ = ["ShardedGallery", "make_mesh"]
+__all__ = ["ShardedGallery", "initialize_multihost", "make_mesh"]

@@ -3,10 +3,19 @@
 One place decides how the available chips are split between the data-parallel
 (``dp``) and gallery-tensor-parallel (``tp``) axes, so every jitted graph in
 the framework agrees on axis names.
+
+Multi-host: ``initialize_multihost()`` below brings up the jax distributed
+runtime so ``jax.devices()`` spans every host's chips; ``make_mesh`` then
+builds the global mesh unchanged (GSPMD inserts ICI collectives within a
+slice and DCN collectives across slices — the comm-backend split the
+reference delegated to ROS/NCCL-era transports is entirely XLA's job here,
+SURVEY.md §5.8). Lay dp across hosts and tp within a slice so the gallery's
+all-gather rides ICI.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -15,6 +24,49 @@ from jax.sharding import Mesh
 
 DP_AXIS = "dp"
 TP_AXIS = "tp"
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the jax distributed runtime when running multi-host.
+
+    The TPU-native analog of the reference's process-level transport
+    bootstrap: after this, ``jax.devices()`` lists every host's chips and
+    the same ``make_mesh``/GSPMD graphs scale across DCN with no further
+    code changes. Arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``);
+    passing any argument explicitly also triggers initialization (jax then
+    autodetects whatever was left out, e.g. the coordinator on a TPU pod).
+
+    Returns True when the distributed runtime was (already) initialized,
+    False when neither arguments nor env vars ask for multi-host — callers
+    never need to branch.
+    """
+    if jax.distributed.is_initialized():
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if (coordinator_address is None and env_np is None
+            and num_processes is None and process_id is None):
+        return False  # nothing asked for multi-host; stay single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=(
+            num_processes if num_processes is not None
+            else int(env_np) if env_np else None
+        ),
+        process_id=(
+            process_id if process_id is not None
+            else int(env_pid) if env_pid else None
+        ),
+    )
+    return True
 
 
 def make_mesh(

@@ -202,3 +202,15 @@ def test_pod_pallas_matcher_sparse_shards():
     # everything else is masked: sentinel index, -inf-ish score
     assert (idx[:, 1:] == -1).all(), idx
     assert (sims[:, 1:] < -1e29).all()
+
+
+def test_initialize_multihost_single_process_noop(monkeypatch):
+    from opencv_facerecognizer_tpu.parallel.mesh import initialize_multihost
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    # no coordinator configured -> graceful single-process no-op
+    assert initialize_multihost() is False
+    # devices still visible, meshes still build
+    assert make_mesh().devices.size == len(jax.devices())
