@@ -27,10 +27,14 @@ work but drop the process into ~100 ms sync-poll mode, quantizing every
 later measurement. So device compute is timed by running the fused step K1
 and K2 times CHAINED inside one jit (iteration i's frames carry a 1e-30-
 scaled dependency on iteration i-1's outputs, forcing serialization), with
-one tiny readback at the end; (T(K2) - T(K1)) / (K2 - K1) cancels the fixed
-dispatch+sync overhead and yields true sustained per-batch time. The method
-reproduces 218 TFLOP/s on a bare 4096^3 bf16 matmul (nominal peak 197) —
-calibration within instrument error. Per-iteration latency percentiles are
+one tiny readback at the end; (min T(K2) - min T(K1)) / (K2 - K1), minima
+over MEASURE_PAIRS repeats PER CHAIN LENGTH, cancels the fixed
+dispatch+sync overhead and is robust to jitter (which only ever adds to a
+single chain's wall time; min-ing differenced pairs instead is biased low).
+K2 escalates up CHAIN_K2_LADDER until the delta clears the ~100 ms readback
+quantization (MIN_DELTA_S). The method reproduces 218 TFLOP/s on a bare
+4096^3 bf16 matmul (nominal peak 197) — calibration within instrument
+error. Per-iteration latency percentiles are
 NOT reported for device compute (they would be dispatch-latency fiction);
 end-to-end serving latency lives in bench_serving.py, where readbacks are
 part of the path being measured.
@@ -50,7 +54,14 @@ V5E_BF16_PEAK_TFLOPS = 197.0
 BATCH_SWEEP = (8, 32, 128)
 HEADLINE_BATCH = 32
 DISTINCT_INPUTS = 8
-CHAIN_K1, CHAIN_K2 = 4, 34  # chained-differencing iteration counts
+CHAIN_K1 = 4
+#: K2 escalation ladder: readbacks quantize at the backend's ~100 ms
+#: sync-poll interval, so the chain delta must dwarf it — escalate K2 until
+#: min(T(K2)) - min(T(K1)) >= MIN_DELTA_S. Fast configs (batch 8: ~0.27
+#: ms/batch) need the long chains; slow ones resolve at the short ones.
+CHAIN_K2_LADDER = (34, 154, 1024)
+MIN_DELTA_S = 0.25
+MEASURE_PAIRS = 3  # chains per length; min taken (jitter only adds time)
 H2D_ITERS = 20
 
 
@@ -108,7 +119,14 @@ def main():
     lab = jnp.asarray(labels)
     det_params = det.params
 
-    def make_step(batch):
+    def xla_matcher(emb, gallery):
+        sims = jax.lax.dot_general(
+            emb.astype(jnp.bfloat16), gallery.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+        )
+        return jax.lax.top_k(sims, 1)
+
+    def make_step(batch, matcher=xla_matcher):
         def step(det_params, emb_params, gallery, labels, frames):
             outputs = det.net.apply({"params": det_params}, frames)
             boxes, det_scores, valid = decode_detections(
@@ -117,14 +135,31 @@ def main():
             crops = image_ops.batched_crop_resize(frames, boxes, face_size)
             flat = crops.reshape((batch * max_faces, *face_size))
             emb = net.apply({"params": emb_params}, normalize_faces(flat, face_size))
-            sims = jax.lax.dot_general(
-                emb.astype(jnp.bfloat16), gallery.astype(jnp.bfloat16),
-                (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
-            )
-            top_sims, top_idx = jax.lax.top_k(sims, 1)
+            top_sims, top_idx = matcher(emb, gallery)
             return boxes, valid, jnp.take(labels, top_idx), top_sims
 
         return step
+
+    def measure_chained(run_chain):
+        """min-of-chains differencing with K2 escalation.
+
+        Jitter only ever ADDS to a single chain's wall time, so take the
+        min over repeats of each chain length separately, then difference
+        the minima. (Differencing individual pairs and min-ing THOSE is
+        biased low: an inflated T(K1) drags its pair's diff down —
+        observed as negative diffs at small batches.) Escalate K2 up the
+        ladder until the delta clears MIN_DELTA_S, i.e. comfortably above
+        the backend's ~100 ms readback quantization.
+        Returns (t1s, t2s, k2_used, per_batch_s_or_None)."""
+        t1s = [run_chain(CHAIN_K1) for _ in range(MEASURE_PAIRS)]
+        t2s, k2, delta = [], CHAIN_K2_LADDER[0], 0.0
+        for k2 in CHAIN_K2_LADDER:
+            t2s = [run_chain(k2) for _ in range(MEASURE_PAIRS)]
+            delta = min(t2s) - min(t1s)
+            if delta >= MIN_DELTA_S:
+                break
+        per_batch = delta / (k2 - CHAIN_K1)
+        return t1s, t2s, k2, (per_batch if per_batch > 1e-6 else None)
 
     def make_chained(batch, step):
         """K serialized runs of ``step`` in ONE jit: frames for iteration i
@@ -154,7 +189,8 @@ def main():
         "frame": [height, width], "max_faces": max_faces, "face_size": list(face_size),
         "gallery_size": gallery_size, "embed_dim": embed_dim,
         "distinct_inputs": DISTINCT_INPUTS,
-        "chain_k": [CHAIN_K1, CHAIN_K2], "h2d_iters": H2D_ITERS,
+        "chain_k1": CHAIN_K1, "chain_k2_ladder": list(CHAIN_K2_LADDER),
+        "min_delta_s": MIN_DELTA_S, "h2d_iters": H2D_ITERS,
         "bf16_peak_tflops": V5E_BF16_PEAK_TFLOPS,
         "timing_method": "chained differencing (see bench.py module docstring)",
     }, "sweep": {}}
@@ -220,9 +256,17 @@ def main():
             _ = np.asarray(acc)  # forces completion of the whole chain
             return time.perf_counter() - t0
 
-        t_k1 = timed_chain(CHAIN_K1)
-        t_k2 = timed_chain(CHAIN_K2)
-        mean_s = max((t_k2 - t_k1) / (CHAIN_K2 - CHAIN_K1), 1e-9)
+        t1s, t2s, k2_used, mean_s = measure_chained(timed_chain)
+        if mean_s is None:
+            detail["sweep"][str(batch)]["device_compute"] = {
+                "invalid": "min(T(K2)) - min(T(K1)) non-positive over "
+                           f"{MEASURE_PAIRS} repeats (dispatch jitter "
+                           "exceeded chain delta); no number recorded",
+                "t_k1_samples_s": [round(t, 4) for t in t1s],
+                "t_k2_samples_s": [round(t, 4) for t in t2s],
+            }
+            _log(f"[batch {batch}] SKIPPED: timing invalid t1={t1s} t2={t2s}")
+            continue
         slot_tput = batch * max_faces / mean_s
         tflops = flops / mean_s / 1e12 if np.isfinite(flops) else float("nan")
         mfu = tflops / V5E_BF16_PEAK_TFLOPS if np.isfinite(tflops) else float("nan")
@@ -241,9 +285,15 @@ def main():
             "analytic_gflop_per_batch": round(flops / 1e9, 3) if np.isfinite(flops) else None,
             "valid_slot_fraction": round(valid_frac, 4),
             "device_compute": {
-                "method": f"chained diff (K={CHAIN_K1} vs {CHAIN_K2}, one readback each)",
-                "chain_times_s": [round(t_k1, 4), round(t_k2, 4)],
-                "mean_ms_per_batch": round(mean_s * 1e3, 3),
+                "method": f"chained diff of per-length minima "
+                          f"(min of {MEASURE_PAIRS} T(K={CHAIN_K1}) chains "
+                          f"vs min of {MEASURE_PAIRS} T(K={k2_used}) "
+                          "chains, one readback each; K2 escalated until "
+                          f"delta >= {MIN_DELTA_S}s)",
+                "k2_used": k2_used,
+                "t_k1_samples_s": [round(t, 4) for t in t1s],
+                "t_k2_samples_s": [round(t, 4) for t in t2s],
+                "min_diff_ms_per_batch": round(mean_s * 1e3, 3),
                 "slot_throughput_per_s": round(slot_tput, 1),
                 "valid_face_throughput_per_s": round(valid_tput, 1),
                 "tflops_per_s": round(tflops, 2) if np.isfinite(tflops) else None,
@@ -266,10 +316,65 @@ def main():
         if batch == HEADLINE_BATCH:
             headline = valid_tput
 
+    # -- pass 3: large-gallery scaling — the fused pipeline at 262,144
+    # enrolled rows, pallas streaming matcher (the ShardedGallery auto
+    # fast path above 64k) vs the XLA materialize+top_k formulation. The
+    # headline stays the 16k/XLA configuration for round-over-round
+    # comparability; this section shows serving holds up as the gallery
+    # scales past HBM-comfortable score-matrix sizes.
+    from opencv_facerecognizer_tpu.ops.pallas_match import streaming_match_topk
+
+    big_n = 262_144
+    batch = HEADLINE_BATCH
+    g_big = jnp.asarray(
+        rng.normal(size=(big_n, embed_dim)).astype(np.float32)
+    )
+    lab_big = jnp.asarray(rng.integers(0, 512, size=big_n).astype(np.int32))
+    valid_big = jnp.ones((big_n,), bool)
+
+    def pallas_matcher(emb, gallery):
+        vals, idx = streaming_match_topk(emb, gallery, valid_big, k=1)
+        return vals, idx
+
+    frames_stack = jnp.stack(all_dev[batch])
+    detail["large_gallery"] = {"rows": big_n, "batch": batch}
+    for name, matcher in (("pallas_stream", pallas_matcher),
+                          ("xla_materialize", xla_matcher)):
+        chained = make_chained(batch, make_step(batch, matcher))
+
+        def timed_chain(k):
+            acc = chained(det_params, emb_params, g_big, lab_big, frames_stack, k)
+            _ = np.asarray(acc)
+            t0 = time.perf_counter()
+            acc = chained(det_params, emb_params, g_big, lab_big, frames_stack, k)
+            _ = np.asarray(acc)
+            return time.perf_counter() - t0
+
+        t1s, t2s, k2_used, mean_s = measure_chained(timed_chain)
+        if mean_s is None:
+            detail["large_gallery"][name] = {
+                "invalid": "min-diff non-positive (dispatch jitter)",
+                "t_k1_samples_s": [round(t, 4) for t in t1s],
+                "t_k2_samples_s": [round(t, 4) for t in t2s],
+            }
+            continue
+        detail["large_gallery"][name] = {
+            "min_diff_ms_per_batch": round(mean_s * 1e3, 3),
+            "k2_used": k2_used,
+            "t_k1_samples_s": [round(t, 4) for t in t1s],
+            "t_k2_samples_s": [round(t, 4) for t in t2s],
+            "slot_throughput_per_s": round(batch * max_faces / mean_s, 1),
+        }
+        _log(f"[gallery {big_n}] {name}: {mean_s * 1e3:.3f} ms/batch "
+             f"(diff of per-length minima over {MEASURE_PAIRS})")
+
     with open("BENCH_DETAIL.json", "w") as fh:
         json.dump(detail, fh, indent=2)
     _log("wrote BENCH_DETAIL.json")
 
+    if headline is None:
+        _log("FATAL: headline batch timing was invalid; no result")
+        sys.exit(1)
     hb = detail["sweep"][str(HEADLINE_BATCH)]
     print(json.dumps({
         "metric": (
