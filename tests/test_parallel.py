@@ -214,3 +214,31 @@ def test_initialize_multihost_single_process_noop(monkeypatch):
     assert initialize_multihost() is False
     # devices still visible, meshes still build
     assert make_mesh().devices.size == len(jax.devices())
+
+
+def test_initialize_multihost_env_and_args(monkeypatch):
+    from opencv_facerecognizer_tpu.parallel import mesh as mesh_mod
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    # env-var path
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    assert mesh_mod.initialize_multihost() is True
+    assert calls[-1] == {"coordinator_address": "10.0.0.1:1234",
+                         "num_processes": 4, "process_id": 2}
+    # explicit args trigger initialization even without env
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var)
+    assert mesh_mod.initialize_multihost(num_processes=8, process_id=3) is True
+    assert calls[-1] == {"coordinator_address": None,
+                         "num_processes": 8, "process_id": 3}
+    # already-initialized short circuit
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    n = len(calls)
+    assert mesh_mod.initialize_multihost() is True
+    assert len(calls) == n

@@ -1,0 +1,60 @@
+"""Visualization helpers (utils/visual.py) — file-rendering smoke + content
+checks for the reference's eigenface-grid / mean-face / overlay surface."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+
+from opencv_facerecognizer_tpu.models import PCA
+from opencv_facerecognizer_tpu.utils import visual
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+X, Y, NAMES = make_synthetic_faces(num_subjects=4, per_subject=5,
+                                   size=(24, 24), seed=9)
+
+
+def _is_png(path):
+    with open(path, "rb") as f:
+        return f.read(8) == b"\x89PNG\r\n\x1a\n"
+
+
+def test_subplot_grid_writes_png(tmp_path):
+    out = str(tmp_path / "grid.png")
+    path = visual.subplot_grid([X[0], X[1], X[2]], ["a", "b", "c"],
+                               suptitle="faces", filename=out)
+    assert path == out and _is_png(out)
+
+
+def test_plot_eigenfaces_and_mean_face(tmp_path):
+    feat = PCA(6)
+    feat.compute(X, Y)
+    e = visual.plot_eigenfaces(feat, (24, 24), num=4,
+                               filename=str(tmp_path / "eig.png"))
+    m = visual.plot_mean_face(feat, (24, 24),
+                              filename=str(tmp_path / "mean.png"))
+    assert _is_png(e) and _is_png(m)
+
+
+def test_plot_eigenfaces_clamps_num(tmp_path):
+    feat = PCA(3)
+    feat.compute(X, Y)
+    out = visual.plot_eigenfaces(feat, (24, 24), num=99,
+                                 filename=str(tmp_path / "few.png"))
+    assert _is_png(out)
+
+
+def test_draw_detections_overlay(tmp_path):
+    frame = np.zeros((64, 80), np.float32)
+    faces = [
+        {"box": (10, 12, 30, 40), "name": "alice", "similarity": 0.93},
+        {"box": (50, 5, 75, 35)},  # name/similarity optional
+    ]
+    out = visual.draw_detections(frame, faces,
+                                 filename=str(tmp_path / "det.png"))
+    assert _is_png(out)
+
+
+def test_normalize_for_display_constant_image():
+    flat = visual._normalize_for_display(np.full((8, 8), 3.0))
+    assert flat.min() == flat.max() == 0.0
