@@ -1,0 +1,195 @@
+"""Pipeline parallelism: detect/align and embed/match on disjoint device
+subsets (SURVEY.md §2.3 "PP" row — optional in the reference mapping, built
+here to complete the parallelism surface).
+
+When to use: the fused single-graph pipeline (``parallel.pipeline``) is the
+right default — one chip holds both nets comfortably and XLA fuses across
+stages. PP pays off when the stages *can't* share a chip (a much larger
+detector/embedder, or a gallery occupying most of HBM) or when stage
+specialization beats data parallelism for a fixed chip budget.
+
+TPU-first shape of the design:
+
+- Stage A (detector convs + static-shape decode + matmul-form crop-resize)
+  is one jitted graph pinned to ``mesh_a``; stage B (embedder + gallery
+  match) is another pinned to the gallery's mesh. Each mesh is an ordinary
+  (dp, tp) mesh, so stage B's gallery is still tp-sharded *within* its
+  subset — PP composes with the existing axes rather than replacing them.
+  Stage B's matcher comes from ``ShardedGallery.match_fn``, so the pallas
+  streaming fast path applies under the same conditions as everywhere else.
+- The inter-stage hop is one ``jax.device_put`` of the [B, K, fh, fw] crop
+  block to stage B's shardings — on hardware that is a device-to-device ICI
+  transfer, no host round-trip.
+- Pipelining needs no threads: JAX dispatch is async, and the two graphs
+  occupy disjoint devices, so issuing A(i+1) before draining B(i) overlaps
+  them; ``depth=2`` software pipelining falls out of call ordering. The
+  driver keeps at most one batch in each stage.
+- The gallery stays LIVE: every batch reads ``gallery.data`` (the same
+  atomic snapshot discipline as the fused pipeline), so enrolments and
+  double-buffered swaps land on the next batch; a capacity grow re-selects
+  the matcher and retraces stage B, exactly like
+  ``RecognitionPipeline._step_key``.
+
+Correctness contract: identical outputs to
+``RecognitionPipeline.recognize_batch`` for the same inputs (tested on the
+CPU mesh in tests/test_pp.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from opencv_facerecognizer_tpu.models import detector as detector_mod
+from opencv_facerecognizer_tpu.models import embedder as embedder_mod
+from opencv_facerecognizer_tpu.ops import image as image_ops
+from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
+from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionResult
+
+
+def split_mesh(mesh: Mesh) -> Tuple[Mesh, Mesh]:
+    """Split a (dp, tp) mesh into two equal stage meshes along dp.
+
+    dp is the split axis because stage A has no tp dimension (detector
+    params are replicated) while stage B may want every tp shard it can
+    get; equal halves keep one batch size valid on both stages. Odd dp is
+    rejected — unequal halves would need per-stage batch sizes (a 9-frame
+    batch cannot dp-shard 2 ways on one half and 1 way on the other).
+    """
+    devs = mesh.devices
+    dp = devs.shape[0]
+    if dp < 2 or dp % 2:
+        raise ValueError(
+            f"PP needs an even dp >= 2 to split equally (got dp={dp}); "
+            "build the mesh with make_mesh(dp=2*n) or use the fused "
+            "single-mesh pipeline"
+        )
+    half = dp // 2
+    return (Mesh(devs[:half], (DP_AXIS, TP_AXIS)),
+            Mesh(devs[half:], (DP_AXIS, TP_AXIS)))
+
+
+class TwoStagePipeline:
+    """Detect/align on ``mesh_a``; embed/match on ``gallery.mesh``."""
+
+    def __init__(
+        self,
+        detector: detector_mod.CNNFaceDetector,
+        embed_net: embedder_mod.FaceEmbedNet,
+        embed_params: Dict[str, Any],
+        gallery: ShardedGallery,
+        mesh_a: Mesh,
+        face_size: Tuple[int, int] = (112, 112),
+        top_k: int = 1,
+    ):
+        mesh_b = gallery.mesh
+        overlap = (set(d.id for d in mesh_a.devices.flat)
+                   & set(d.id for d in mesh_b.devices.flat))
+        if overlap:
+            raise ValueError(
+                f"stage meshes share devices {sorted(overlap)}; PP requires "
+                "disjoint subsets (use split_mesh, and build the gallery on "
+                "the second half)"
+            )
+        self.detector = detector
+        self.embed_net = embed_net
+        self.gallery = gallery
+        self.face_size = tuple(face_size)
+        self.top_k = int(top_k)
+        self.mesh_a = mesh_a
+        self.mesh_b = mesh_b
+        det = detector
+        max_faces = det.max_faces
+
+        def stage_a(det_params, frames):
+            outputs = det.net.apply({"params": det_params}, frames)
+            boxes, det_scores, valid = detector_mod.decode_detections(
+                outputs, max_faces, det.score_threshold, det.iou_threshold
+            )
+            crops = image_ops.batched_crop_resize(frames, boxes, face_size)
+            return boxes, det_scores, valid, crops
+
+        frames_in = NamedSharding(mesh_a, P(DP_AXIS, None, None))
+        self._stage_a = jax.jit(stage_a, in_shardings=(None, frames_in))
+        # Stage B input shardings for the inter-stage device_put hop.
+        self._b_crops = NamedSharding(mesh_b, P(DP_AXIS, None, None, None))
+        self._b_repl = NamedSharding(mesh_b, P())
+        # Params are static per pipeline: pin each stage's copy to its mesh
+        # once. The GALLERY is deliberately not snapshotted here — see
+        # _stage_b_fn/_submit_b.
+        self._emb_params = jax.device_put(embed_params, self._b_repl)
+        self._det_params = jax.device_put(
+            detector.params, NamedSharding(mesh_a, P())
+        )
+        self._b_cache: Dict[Any, Any] = {}
+
+    def _stage_b_fn(self):
+        """Compiled stage B for the gallery's CURRENT capacity/matcher —
+        auto-grow changes both, so key the cache like
+        ``RecognitionPipeline._step_key`` does."""
+        key = (self.gallery.capacity, self.gallery._pallas_enabled())
+        if key not in self._b_cache:
+            match = self.gallery.match_fn(self.top_k)
+            embed_net = self.embed_net
+            face_size = self.face_size
+            k = self.top_k
+
+            def stage_b(emb_params, g_emb, g_valid, g_labels, crops):
+                b, kf = crops.shape[0], crops.shape[1]
+                flat = crops.reshape((b * kf, *face_size))
+                emb = embed_net.apply(
+                    {"params": emb_params},
+                    embedder_mod.normalize_faces(flat, face_size),
+                )
+                labels, sims, _ = match(emb, g_emb, g_valid, g_labels)
+                return labels.reshape((b, kf, k)), sims.reshape((b, kf, k))
+
+            self._b_cache[key] = jax.jit(stage_b)
+        return self._b_cache[key]
+
+    def _submit_a(self, frames):
+        frames = jnp.asarray(frames, jnp.float32)
+        return self._stage_a(self._det_params, frames)
+
+    def _hop(self, a_out):
+        boxes, det_scores, valid, crops = a_out
+        # One D2D transfer of the stage boundary to mesh_b's shardings;
+        # the small per-slot arrays stay on mesh_a (the consumer reads
+        # them host-side either way).
+        crops_b = jax.device_put(crops, self._b_crops)
+        return boxes, det_scores, valid, crops_b
+
+    def _submit_b(self, hopped):
+        boxes, det_scores, valid, crops_b = hopped
+        data = self.gallery.data  # one atomic snapshot per batch (live)
+        labels, sims = self._stage_b_fn()(
+            self._emb_params, data.embeddings, data.valid, data.labels,
+            crops_b,
+        )
+        return RecognitionResult(
+            boxes=boxes, det_scores=det_scores, valid=valid,
+            labels=labels, similarities=sims,
+        )
+
+    def recognize_batch(self, frames) -> RecognitionResult:
+        """Single-batch convenience path (no overlap)."""
+        return self._submit_b(self._hop(self._submit_a(frames)))
+
+    def recognize_stream(
+        self, frame_batches: Iterable[Any]
+    ) -> Iterator[RecognitionResult]:
+        """Depth-2 pipelined stream: stage A works on batch i+1 while stage
+        B works on batch i — overlap comes from async dispatch onto
+        disjoint devices, not from host threads."""
+        in_flight = None
+        for frames in frame_batches:
+            hopped = self._hop(self._submit_a(frames))
+            if in_flight is not None:
+                yield in_flight
+            in_flight = self._submit_b(hopped)
+        if in_flight is not None:
+            yield in_flight

@@ -1,0 +1,127 @@
+"""Pipeline parallelism (parallel/pp.py): stage-split correctness vs the
+fused single-mesh pipeline, on the 8-virtual-device CPU mesh."""
+
+import jax
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+from opencv_facerecognizer_tpu.models.embedder import FaceEmbedNet, init_embedder
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+from opencv_facerecognizer_tpu.parallel.pp import TwoStagePipeline, split_mesh
+from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+
+@pytest.fixture(scope="module")
+def stack():
+    scenes, boxes, counts = make_synthetic_scenes(32, (96, 96), max_faces=2,
+                                                  seed=3)
+    det = CNNFaceDetector(features=(8, 16, 32), head_features=32, max_faces=4,
+                          score_threshold=0.25)
+    det.train(scenes, boxes, counts, steps=150, batch_size=16,
+              learning_rate=2e-3)
+    net = FaceEmbedNet(embed_dim=32, stem_features=8, stage_features=(8, 16),
+                       stage_blocks=(1, 1))
+    emb_params = init_embedder(net, num_classes=8, input_shape=(48, 48),
+                               seed=0)["net"]
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(64, 32)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    labels = rng.integers(0, 8, size=64)
+    return det, net, emb_params, emb, labels, scenes
+
+
+def test_split_mesh_halves_dp():
+    mesh = make_mesh(dp=4, tp=2)
+    a, b = split_mesh(mesh)
+    assert a.shape == {"dp": 2, "tp": 2} and b.shape == {"dp": 2, "tp": 2}
+    assert not set(d.id for d in a.devices.flat) & set(
+        d.id for d in b.devices.flat)
+    with pytest.raises(ValueError):
+        split_mesh(make_mesh(dp=1, tp=8))
+    with pytest.raises(ValueError):  # odd dp: unequal halves rejected
+        from jax.sharding import Mesh
+        from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+        devs = np.asarray(jax.devices()[:6]).reshape(3, 2)
+        split_mesh(Mesh(devs, (DP_AXIS, TP_AXIS)))
+
+
+def test_pp_matches_fused_pipeline(stack):
+    det, net, emb_params, emb, labels, scenes = stack
+    mesh = make_mesh(dp=4, tp=2)
+    gallery = ShardedGallery(capacity=64, dim=32, mesh=mesh)
+    gallery.add(emb, labels)
+    fused = RecognitionPipeline(det, net, emb_params, gallery,
+                                face_size=(48, 48), top_k=2)
+    frames = scenes[:8]
+    ref = fused.recognize_batch(frames)
+
+    mesh_a, mesh_b = split_mesh(mesh)
+    gal_b = ShardedGallery(capacity=64, dim=32, mesh=mesh_b)
+    gal_b.add(emb, labels)
+    pp = TwoStagePipeline(det, net, emb_params, gal_b, mesh_a,
+                          face_size=(48, 48), top_k=2)
+    out = pp.recognize_batch(frames)
+
+    np.testing.assert_allclose(np.asarray(out.boxes), np.asarray(ref.boxes),
+                               atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(out.valid), np.asarray(ref.valid))
+    np.testing.assert_array_equal(np.asarray(out.labels),
+                                  np.asarray(ref.labels))
+    np.testing.assert_allclose(np.asarray(out.similarities),
+                               np.asarray(ref.similarities), atol=2e-2)
+
+
+def test_pp_stream_order_and_completeness(stack):
+    det, net, emb_params, emb, labels, scenes = stack
+    mesh_a, mesh_b = split_mesh(make_mesh(dp=2, tp=4))
+    gal = ShardedGallery(capacity=64, dim=32, mesh=mesh_b)
+    gal.add(emb, labels)
+    pp = TwoStagePipeline(det, net, emb_params, gal, mesh_a,
+                          face_size=(48, 48), top_k=1)
+    batches = [scenes[i:i + 4] for i in range(0, 24, 4)]
+    outs = list(pp.recognize_stream(iter(batches)))
+    assert len(outs) == len(batches)
+    # stream results must match one-at-a-time processing, in order
+    for i, out in enumerate(outs):
+        solo = pp.recognize_batch(batches[i])
+        np.testing.assert_array_equal(np.asarray(out.labels),
+                                      np.asarray(solo.labels))
+        np.testing.assert_array_equal(np.asarray(out.valid),
+                                      np.asarray(solo.valid))
+
+
+def test_pp_sees_live_enrolment(stack):
+    """The gallery must stay live through PP: an enrolment after pipeline
+    construction lands on the next batch (same contract as the fused
+    pipeline), including through an auto-grow."""
+    det, net, emb_params, emb, labels, scenes = stack
+    mesh_a, mesh_b = split_mesh(make_mesh(dp=2, tp=4))
+    gal = ShardedGallery(capacity=64, dim=32, mesh=mesh_b)
+    gal.add(emb[:32], labels[:32])
+    pp = TwoStagePipeline(det, net, emb_params, gal, mesh_a,
+                          face_size=(48, 48), top_k=1)
+    frames = scenes[:4]
+    out0 = pp.recognize_batch(frames)
+    # enroll more rows, growing past capacity (64 -> auto-grow)
+    extra = np.tile(emb, (2, 1))
+    gal.add(extra, np.full(len(extra), 7, np.int64))
+    assert gal.capacity > 64  # grew
+    out1 = pp.recognize_batch(frames)
+    assert out1.labels.shape == out0.labels.shape
+    # old rows must still be matchable after the grow+swap
+    q = emb[:8]
+    lab, _, _ = gal.match(np.asarray(q), k=1)
+    assert (np.asarray(lab)[:, 0] == labels[:8]).mean() >= 0.9
+
+
+def test_pp_rejects_overlapping_meshes(stack):
+    det, net, emb_params, emb, labels, _ = stack
+    mesh = make_mesh(dp=2, tp=4)
+    gal = ShardedGallery(capacity=64, dim=32, mesh=mesh)
+    gal.add(emb, labels)
+    mesh_a, _ = split_mesh(mesh)
+    with pytest.raises(ValueError):
+        TwoStagePipeline(det, net, emb_params, gal, mesh_a,
+                         face_size=(48, 48))
