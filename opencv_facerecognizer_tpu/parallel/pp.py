@@ -17,9 +17,11 @@ TPU-first shape of the design:
   subset — PP composes with the existing axes rather than replacing them.
   Stage B's matcher comes from ``ShardedGallery.match_fn``, so the pallas
   streaming fast path applies under the same conditions as everywhere else.
-- The inter-stage hop is one ``jax.device_put`` of the [B, K, fh, fw] crop
-  block to stage B's shardings — on hardware that is a device-to-device ICI
-  transfer, no host round-trip.
+- The inter-stage hop is a ``jax.device_put`` of the [B, K, fh, fw] crop
+  block to stage B's shardings, plus the tiny box/score/valid arrays
+  (so every result leaf lands on stage B's mesh and the packed
+  single-readback path is one jit) — on hardware these are
+  device-to-device ICI transfers, no host round-trip.
 - Pipelining needs no threads: JAX dispatch is async, and the two graphs
   occupy disjoint devices, so issuing A(i+1) before draining B(i) overlaps
   them; ``depth=2`` software pipelining falls out of call ordering. The
@@ -48,7 +50,9 @@ from opencv_facerecognizer_tpu.models import embedder as embedder_mod
 from opencv_facerecognizer_tpu.ops import image as image_ops
 from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
-from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionResult
+from opencv_facerecognizer_tpu.parallel.pipeline import (
+    RecognitionResult, pack_result,
+)
 
 
 def split_mesh(mesh: Mesh) -> Tuple[Mesh, Mesh]:
@@ -97,6 +101,10 @@ class TwoStagePipeline:
             )
         self.detector = detector
         self.embed_net = embed_net
+        # Public, mesh-agnostic copy — RecognizerService's enrolment path
+        # runs the embedder host-side batches through embed_net/embed_params
+        # exactly as it does with RecognitionPipeline.
+        self.embed_params = embed_params
         self.gallery = gallery
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
@@ -126,6 +134,7 @@ class TwoStagePipeline:
             detector.params, NamedSharding(mesh_a, P())
         )
         self._b_cache: Dict[Any, Any] = {}
+        self._pack = jax.jit(pack_result)  # once: serving hot-loop path
 
     def _stage_b_fn(self):
         """Compiled stage B for the gallery's CURRENT capacity/matcher —
@@ -157,10 +166,14 @@ class TwoStagePipeline:
 
     def _hop(self, a_out):
         boxes, det_scores, valid, crops = a_out
-        # One D2D transfer of the stage boundary to mesh_b's shardings;
-        # the small per-slot arrays stay on mesh_a (the consumer reads
-        # them host-side either way).
+        # One D2D transfer of the stage boundary to mesh_b's shardings.
+        # The per-slot arrays are tiny ([B, K, 4] and smaller); moving them
+        # too keeps every result leaf on mesh_b, so the packed single-
+        # readback path can fuse them in one jit.
         crops_b = jax.device_put(crops, self._b_crops)
+        boxes, det_scores, valid = jax.device_put(
+            (boxes, det_scores, valid), self._b_repl
+        )
         return boxes, det_scores, valid, crops_b
 
     def _submit_b(self, hopped):
@@ -178,6 +191,14 @@ class TwoStagePipeline:
     def recognize_batch(self, frames) -> RecognitionResult:
         """Single-batch convenience path (no overlap)."""
         return self._submit_b(self._hop(self._submit_a(frames)))
+
+    def recognize_batch_packed(self, frames) -> jnp.ndarray:
+        """One packed [B, K, 6 + 2k] output array (see
+        ``pipeline.pack_result``) — makes PP a drop-in pipeline for
+        ``runtime.recognizer.RecognizerService``, whose serving loop does
+        exactly one device->host readback per batch."""
+        result = self.recognize_batch(frames)
+        return self._pack(result)
 
     def recognize_stream(
         self, frame_batches: Iterable[Any]

@@ -125,3 +125,42 @@ def test_pp_rejects_overlapping_meshes(stack):
     with pytest.raises(ValueError):
         TwoStagePipeline(det, net, emb_params, gal, mesh_a,
                          face_size=(48, 48))
+
+
+def test_pp_drop_in_for_recognizer_service(stack):
+    """TwoStagePipeline implements the pipeline surface RecognizerService
+    needs (recognize_batch_packed + gallery/top_k/face_size/embed_*), so
+    PP serves frames end-to-end through the same runtime."""
+    import time
+
+    from opencv_facerecognizer_tpu.runtime.connector import (
+        FakeConnector, encode_frame)
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC, RecognizerService)
+
+    det, net, emb_params, emb, labels, scenes = stack
+    mesh_a, mesh_b = split_mesh(make_mesh(dp=2, tp=4))
+    gal = ShardedGallery(capacity=64, dim=32, mesh=mesh_b)
+    gal.add(emb, labels)
+    pp = TwoStagePipeline(det, net, emb_params, gal, mesh_a,
+                          face_size=(48, 48), top_k=1)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pp, connector, batch_size=4, frame_shape=(96, 96),
+        flush_timeout=0.02, similarity_threshold=0.0,
+        subject_names=[f"p{i}" for i in range(8)],
+    )
+    service.start()
+    try:
+        for i, scene in enumerate(scenes[:8]):
+            connector.inject(FRAME_TOPIC,
+                             {**encode_frame(scene), "meta": {"frame_id": i}})
+        deadline = time.monotonic() + 30
+        while (len(connector.messages(RESULT_TOPIC)) < 8
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+    finally:
+        service.stop()
+    results = connector.messages(RESULT_TOPIC)
+    assert len(results) == 8
+    assert any(r["faces"] for r in results)
