@@ -42,6 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "(open with TensorBoard or xprof)")
     p.add_argument("--profile-batches", type=int, default=20)
     p.add_argument("--frame-size", type=int, nargs=2, default=(256, 256), metavar=("H", "W"))
+    p.add_argument("--parallel", choices=["fused", "pp"], default="fused",
+                   help="fused: one sharded graph over all devices (default); "
+                        "pp: two-stage pipeline parallelism — detector on "
+                        "half the devices, embedder+gallery on the other "
+                        "half (needs an even device count >= 2)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--flush-ms", type=float, default=30.0)
     p.add_argument("--similarity-threshold", type=float, default=0.3)
@@ -71,14 +76,44 @@ def _load_stack(args):
         args.gallery, image_size=feature.input_size
     )
     emb = np.array(feature.extract(images))
-    mesh = make_mesh()
+    mesh_a = None
+    if args.parallel == "pp":
+        # Two-stage pipeline parallelism: detector on the first mesh half,
+        # embedder + gallery on the second (parallel/pp.py).
+        import jax
+
+        from opencv_facerecognizer_tpu.parallel import split_mesh
+
+        n = len(jax.devices())
+        # Keep both axes useful after the split: 8 devices -> (dp=4, tp=2)
+        # halves into two (2, 2) stage meshes. Below 8, tp=2 would collapse
+        # the halves to dp=1 (replicated detector work), so stay tp=1.
+        tp = 2 if n % 4 == 0 and n >= 8 else 1
+        try:
+            mesh_a, gallery_mesh = split_mesh(make_mesh(dp=n // tp, tp=tp))
+        except ValueError as e:
+            raise SystemExit(
+                f"--parallel pp needs an even device count >= 2 (have {n}): "
+                f"{e}; use --parallel fused on this host"
+            )
+    else:
+        gallery_mesh = make_mesh()
+
     gallery = ShardedGallery(capacity=max(args.capacity, 2 * len(emb)),
-                             dim=emb.shape[1], mesh=mesh)
+                             dim=emb.shape[1], mesh=gallery_mesh)
     gallery.add(emb, labels)
-    pipeline = RecognitionPipeline(
-        detector, feature.net, feature._params["net"], gallery,
-        face_size=feature.input_size,
-    )
+    if mesh_a is not None:
+        from opencv_facerecognizer_tpu.parallel import TwoStagePipeline
+
+        pipeline = TwoStagePipeline(
+            detector, feature.net, feature._params["net"], gallery, mesh_a,
+            face_size=feature.input_size,
+        )
+    else:
+        pipeline = RecognitionPipeline(
+            detector, feature.net, feature._params["net"], gallery,
+            face_size=feature.input_size,
+        )
     return pipeline, names
 
 

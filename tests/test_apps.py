@@ -188,3 +188,25 @@ def test_detector_save_before_train_raises(tmp_path):
 
     with pytest.raises(RuntimeError):
         CNNFaceDetector().save(str(tmp_path / "x.ckpt"))
+
+
+@pytest.mark.slow
+def test_recognize_app_pp_mode(app_artifacts, capsys):
+    """--parallel pp serves through the two-stage pipeline executor; on the
+    8-virtual-device CPU mesh the devices split 4|4."""
+    a = app_artifacts
+    rc = recognize_app.main([
+        "--model", a["model_path"], "--detector", a["det_path"],
+        "--gallery", a["data_dir"],
+        "--source", "dir", "--dir", a["frames_dir"], "--frame-size", "96", "96",
+        "--batch-size", "4", "--similarity-threshold", "0.0",
+        "--parallel", "pp",
+    ])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert len(lines) == 4
+    results = [json.loads(l) for l in lines]
+    assert any(r["faces"] for r in results)
+    for r in results:
+        for face in r["faces"]:
+            assert face["name"] in a["names"] or face["name"] == "unknown"
