@@ -13,8 +13,12 @@ Prints one JSON line per offered rate and writes BENCH_SERVING.json.
 Caveat recorded in the artifact: on this box the chip sits behind a
 tunneled PJRT backend whose first device->host readback puts the process
 into ~100 ms sync-poll mode (see runtime/recognizer.py docstring) — an
-artifact of the tunnel, not the chip; the service's async-readback design
-exists precisely to amortize it (latency stays flat as offered load grows).
+artifact of the tunnel, not the chip. The async-readback design keeps
+throughput sustained with zero drops as offered load grows; end-to-end
+latency still rises with queueing on top of the tunnel's readback floor
+(the recorded artifact shows exactly that), which is why the artifact also
+records a per-frame decomposition separating queue-wait, device dispatch,
+readback, and publish.
 
 Run:  PYTHONPATH=. python bench_serving.py [--rates 50 200 500]
 """

@@ -73,6 +73,13 @@ def _match_kernel(q_ref, g_ref, valid_ref, vals_ref, idx_ref, *, k: int,
         hit = pos == am[:, None]  # first-max one-hot
         best_idx = jnp.sum(jnp.where(hit, cand_idx, 0), axis=1,
                            keepdims=True)  # [BQ, 1]
+        # Sentinel from the VALUE, never from argmax tie-breaking: when all
+        # remaining candidates are masked (-1e30), the compiled TPU argmax
+        # picks an unspecified position (measured: a real column index,
+        # where interpret mode picked 0) — so a slot whose best is the mask
+        # value must emit index -1 explicitly. Real sims are cosine-scale;
+        # half the mask magnitude separates them unambiguously.
+        best_idx = jnp.where(best > NEG_INF * 0.5, best_idx, -1)
         new_vals.append(best)
         new_idx.append(best_idx)
         cand_vals = jnp.where(hit, NEG_INF, cand_vals)
@@ -89,9 +96,12 @@ def streaming_match_topk(q, g, valid, *, k: int = 1, block_q: int = 128,
 
     q [Q, D] float; g [N, D] float; valid [N] bool/0-1 mask.
     Returns (sims [Q, k] f32, indices [Q, k] int32); invalid rows never
-    surface (masked to -1e30 / index of a masked row only when fewer than
-    k valid rows exist). Q and N are padded up to block multiples here,
-    so any sizes work; D should be modest (fits VMEM with the tiles).
+    surface. When fewer than k valid rows exist, the empty slots carry
+    sim -1e30 and the explicit sentinel index **-1** (derived from the
+    value in-kernel, so it holds in compiled mode too) — callers gathering
+    labels must mask ``idx < 0`` (see ``parallel.gallery``). Q and N are
+    padded up to block multiples here, so any sizes work; D should be
+    modest (fits VMEM with the tiles).
     """
     q = jnp.asarray(q, jnp.float32)
     g = jnp.asarray(g, jnp.float32)

@@ -35,6 +35,18 @@ from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
 NEG_INF = jnp.float32(-1e30)
 
 
+def take_labels_with_sentinel(labels, idx, labels_pad: int):
+    """Gather labels for top-k indices, mapping sentinel ``idx == -1`` slots
+    (a shard/gallery with fewer than k valid rows) to the pad label — a
+    clamped/wrapped gather would pair a real subject's label with the
+    -1e30 sentinel sim."""
+    return jnp.where(
+        idx < 0,
+        jnp.int32(labels_pad),
+        jnp.take(labels, jnp.maximum(idx, 0)),
+    )
+
+
 def match_global(q, g, valid, labels, *, k: int, mesh: Mesh):
     """Global-view sharded match: the GSPMD formulation.
 
@@ -85,7 +97,7 @@ def match_global(q, g, valid, labels, *, k: int, mesh: Mesh):
 
 
 def match_pod_pallas(q, g, valid, labels, *, k: int, mesh: Mesh,
-                     interpret: bool = False):
+                     interpret: bool = False, labels_pad: int = -1):
     """Pod-scale matcher: ``shard_map`` over tp, pallas streaming kernel
     per shard, collective merge of the tiny candidate sets.
 
@@ -126,7 +138,7 @@ def match_pod_pallas(q, g, valid, labels, *, k: int, mesh: Mesh,
         out_k = min(k, cand_v.shape[1])
         top_v, pos = jax.lax.top_k(cand_v, out_k)
         top_i = jnp.take_along_axis(cand_i, pos, axis=1)
-        return jnp.take(labels_l, top_i), top_v, top_i
+        return take_labels_with_sentinel(labels_l, top_i, labels_pad), top_v, top_i
 
     return jax.shard_map(
         shard_body,
@@ -343,12 +355,13 @@ class ShardedGallery:
             )
 
             interpret = self.mesh.devices.flat[0].platform != "tpu"
+            labels_pad = self.labels_pad
 
             def fn(q, g, valid, labels):
                 vals, idx = streaming_match_topk(
                     q, g, valid, k=k, interpret=interpret
                 )
-                return jnp.take(labels, idx), vals, idx
+                return take_labels_with_sentinel(labels, idx, labels_pad), vals, idx
 
             return fn
         return functools.partial(match_global, k=k, mesh=self.mesh)

@@ -35,8 +35,12 @@ def initialize_multihost(
 
     The TPU-native analog of the reference's process-level transport
     bootstrap: after this, ``jax.devices()`` lists every host's chips and
-    the same ``make_mesh``/GSPMD graphs scale across DCN with no further
-    code changes. Arguments default from the standard env vars
+    the same ``make_mesh``/GSPMD graphs are *intended* to scale across DCN
+    with no further code changes. Honesty note: this machine has one host,
+    so the multi-host path is exercised only with a mocked
+    ``jax.distributed`` (tests/test_parallel.py) — the DCN-scaling claim is
+    the documented design, not a measured result here.
+    Arguments default from the standard env vars
     (``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``);
     passing any argument explicitly also triggers initialization (jax then
     autodetects whatever was left out, e.g. the coordinator on a TPU pod).

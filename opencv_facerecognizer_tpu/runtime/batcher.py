@@ -20,9 +20,20 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, List, Optional, Tuple
+from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
+
+
+class Batch(NamedTuple):
+    """One device-ready batch plus the provenance the latency decomposition
+    needs: ``enqueue_ts`` are the ``time.monotonic()`` stamps from ``put``
+    for the ``count`` real frames (queue-wait = pop time - enqueue time)."""
+
+    frames: np.ndarray  # [B, H, W] float32, zero-padded
+    metas: List[Any]
+    count: int
+    enqueue_ts: List[float]
 
 
 class FrameBatcher:
@@ -42,6 +53,7 @@ class FrameBatcher:
         self._frames: deque = deque()
         self._dropped_malformed = 0
         self._dropped_overflow = 0
+        self._delivered = 0
         self._closed = False
 
     # ---- producer side ----
@@ -70,11 +82,9 @@ class FrameBatcher:
 
     # ---- consumer side ----
 
-    def get_batch(
-        self, block: bool = True
-    ) -> Optional[Tuple[np.ndarray, List[Any], int]]:
-        """Next (frames [B, H, W], metas [B], real_count) or None when closed
-        and drained (or when non-blocking and nothing is flushable)."""
+    def get_batch(self, block: bool = True) -> Optional[Batch]:
+        """Next ``Batch`` or None when closed and drained (or when
+        non-blocking and nothing is flushable)."""
         with self._not_empty:
             while True:
                 n = len(self._frames)
@@ -99,17 +109,31 @@ class FrameBatcher:
                     return None
             count = min(len(self._frames), self.batch_size)
             items = [self._frames.popleft() for _ in range(count)]
+            # Counted under the lock, atomically with the pop: consumers
+            # (RecognizerService.drain) compare this against their own
+            # completion count, so a popped-but-not-yet-dispatched batch is
+            # never invisible to both ``pending`` and the in-flight queue.
+            self._delivered += 1
         frames = np.zeros((self.batch_size, *self.frame_shape), dtype=np.float32)
         metas: List[Any] = [None] * self.batch_size
-        for i, (frame, meta, _) in enumerate(items):
+        enqueue_ts: List[float] = []
+        for i, (frame, meta, ts) in enumerate(items):
             frames[i] = frame
             metas[i] = meta
-        return frames, metas, count
+            enqueue_ts.append(ts)
+        return Batch(frames, metas, count, enqueue_ts)
 
     @property
     def pending(self) -> int:
         with self._lock:
             return len(self._frames)
+
+    @property
+    def delivered_batches(self) -> int:
+        """Batches handed out by ``get_batch`` (incremented under the lock,
+        atomically with the pop)."""
+        with self._lock:
+            return self._delivered
 
     @property
     def stats(self):

@@ -33,6 +33,7 @@ import os
 import select
 import socket
 import threading
+import time
 from typing import Any, Callable, Dict, IO, List, Optional
 
 import numpy as np
@@ -272,10 +273,14 @@ class SocketConnector(_TopicDispatchConnector):
         self.host = host
         self.port = port
         self.listen = listen
-        # Serializes sendall across publisher threads: interleaved partial
-        # writes from concurrent publishes would splice two JSON lines into
-        # one corrupt frame on the wire.
-        self._send_lock = threading.Lock()
+        # Per-SOCKET send locks: interleaved partial writes from concurrent
+        # publishes would splice two JSON lines into one corrupt frame, but
+        # one stalled client (full TCP buffer) must not wedge publishes to
+        # the healthy ones — so serialization is per socket, and each send
+        # is deadline-bounded (see ``_send_deadline_s``); a client that
+        # can't accept a payload in time is dropped like a dead one.
+        self._send_locks: Dict[socket.socket, threading.Lock] = {}
+        self._send_deadline_s = 2.0
         self._threads: List[threading.Thread] = []
         self._server_sock: Optional[socket.socket] = None
         self._client_socks: List[socket.socket] = []
@@ -309,6 +314,7 @@ class SocketConnector(_TopicDispatchConnector):
     def _attach(self, sock: socket.socket) -> None:
         with self._lock:
             self._client_socks.append(sock)
+            self._send_locks[sock] = threading.Lock()
         thread = threading.Thread(target=self._read_loop, args=(sock,), daemon=True)
         thread.start()
         self._threads.append(thread)
@@ -326,26 +332,63 @@ class SocketConnector(_TopicDispatchConnector):
             with self._lock:
                 if sock in self._client_socks:
                     self._client_socks.remove(sock)
+                self._send_locks.pop(sock, None)
                 remaining = len(self._client_socks)
             if not self.listen or (not self._running and remaining == 0):
                 self.eof.set()
 
+    def _send_bounded(self, sock: socket.socket, payload: bytes) -> bool:
+        """Deadline-bounded send without touching the socket's blocking
+        state (the read loop shares the socket): ``MSG_DONTWAIT`` makes each
+        individual send non-blocking — a blocking-mode TCP ``send`` would
+        otherwise park until the ENTIRE buffer is queued, which is exactly
+        the wedge this guards against — and ``select`` bounds the wait for
+        buffer space. Returns False when the deadline passes."""
+        deadline = time.monotonic() + self._send_deadline_s
+        view = memoryview(payload)
+        while view:
+            try:
+                view = view[sock.send(view, socket.MSG_DONTWAIT):]
+                continue
+            except (BlockingIOError, InterruptedError):
+                pass  # buffer full: wait (bounded) for space below
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            _, writable, _ = select.select((), (sock,), (), remaining)
+            if not writable:
+                return False
+        return True
+
     def publish(self, topic: str, message: Dict[str, Any]) -> None:
         payload = (json.dumps({"topic": topic, "data": message}) + "\n").encode()
         with self._lock:
-            socks = list(self._client_socks)
+            socks = [(s, self._send_locks[s]) for s in self._client_socks]
         dead = []
-        with self._send_lock:
-            for sock in socks:
+        for sock, lock in socks:
+            with lock:
                 try:
-                    sock.sendall(payload)
+                    ok = self._send_bounded(sock, payload)
                 except OSError:
-                    dead.append(sock)
+                    ok = False
+                if not ok:
+                    # Close while STILL holding the send lock: a concurrent
+                    # publisher that already snapshotted this sock must get
+                    # an immediate OSError, not append its line after our
+                    # truncated one (spliced JSON frames on the wire).
+                    # Closing also unblocks the socket's read loop.
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            if not ok:
+                dead.append(sock)
         if dead:
             with self._lock:
                 for sock in dead:
                     if sock in self._client_socks:
                         self._client_socks.remove(sock)
+                    self._send_locks.pop(sock, None)
 
     def stop(self) -> None:
         self._running = False
@@ -357,6 +400,7 @@ class SocketConnector(_TopicDispatchConnector):
         with self._lock:
             socks = list(self._client_socks)
             self._client_socks.clear()
+            self._send_locks.clear()
         for sock in socks:
             try:
                 sock.shutdown(socket.SHUT_RDWR)

@@ -85,9 +85,13 @@ class RecognizerService:
         self._inflight: deque = deque()
         self._thread: Optional[threading.Thread] = None
         self._running = False
-        # True while a popped batch is between get_batch() and the
-        # in-flight queue — drain() must not declare victory in that window.
-        self._dispatching = False
+        # Completion counter paired with batcher.delivered_batches: a batch
+        # counts as completed only once PUBLISHED (or abandoned on dispatch
+        # failure), so drain() sees every popped batch through its whole
+        # lifetime — there is no window where a batch in hand is invisible
+        # (round-2 advisor #3: a bare _dispatching flag had one between
+        # get_batch() and the flag write).
+        self._completed_batches = 0
         self._enrolment: Optional[_Enrolment] = None
         self._enrol_lock = threading.Lock()
 
@@ -173,8 +177,11 @@ class RecognizerService:
         queued, which is right for Ctrl-C but wrong for a finite stream."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if (self.batcher.pending == 0 and not self._dispatching
-                    and not self._inflight):
+            # delivered == completed covers popped-but-undispatched batches,
+            # the in-flight queue, AND publish-in-progress (completed is
+            # bumped only after _publish returns).
+            if (self.batcher.pending == 0
+                    and self.batcher.delivered_batches == self._completed_batches):
                 return True
             time.sleep(0.05)
         return False
@@ -198,9 +205,14 @@ class RecognizerService:
                     break
                 self._drain()
                 continue
-            frames, metas, count = batch
-            self._dispatching = True
+            frames, metas, count = batch.frames, batch.metas, batch.count
             t0 = time.perf_counter()
+            # Queue-wait: frame enqueue -> batch pop. The batching-delay
+            # term of the end-to-end latency decomposition (flush window +
+            # waiting for batch_size peers), measured per frame.
+            now_mono = time.monotonic()
+            for ts in batch.enqueue_ts:
+                self.metrics.observe("queue_wait", now_mono - ts)
             try:
                 # Packed path: ONE output array -> one D2H readback per
                 # batch (a tunneled backend charges ~100 ms per blocking
@@ -210,10 +222,12 @@ class RecognizerService:
             except Exception:  # noqa: BLE001 — a bad batch must not kill serving
                 logging.getLogger(__name__).exception("recognition batch failed")
                 self.metrics.incr("batches_failed")
-                self._dispatching = False
+                self._completed_batches += 1  # abandoned, not published
                 continue
+            # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
+            # — never device compute, which is async from here).
+            self.metrics.observe("dispatch", time.perf_counter() - t0)
             self._inflight.append((packed, frames, metas, count, t0))
-            self._dispatching = False
             self.metrics.incr("batches_dispatched")
             self.metrics.incr("frames_processed", count)
             self._drain()
@@ -227,13 +241,26 @@ class RecognizerService:
                     or len(self._inflight) > self.inflight_depth):
                 break
             self._inflight.popleft()
-            self._publish(packed, frames, metas, count)
+            # Materialize BEFORE stamping ready_wait: on the blocking
+            # (over-depth/forced) path np.asarray is the readback itself and
+            # must land in ready_wait, not in publish.
+            arr = np.asarray(packed)
+            # dispatch -> readback-complete: device compute + D2H readback +
+            # the drain loop's polling slack (on the tunneled backend the
+            # ~100 ms sync-poll readback floor lands in THIS term — compare
+            # against bench.py's chained-diff device ms/batch to see how
+            # much is tunnel vs chip).
+            self.metrics.observe("ready_wait", time.perf_counter() - t0)
+            t_pub = time.perf_counter()
+            self._publish(arr, frames, metas, count)
+            self._completed_batches += 1
+            self.metrics.observe("publish", time.perf_counter() - t_pub)
             self.metrics.observe("batch_latency", time.perf_counter() - t0)
 
     def _publish(self, packed, frames, metas, count) -> None:
         from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
 
-        result = unpack_result(np.asarray(packed), self.pipeline.top_k)
+        result = unpack_result(np.asarray(packed), self.pipeline.top_k)  # no-op if already host
         boxes = result.boxes
         det_scores = result.det_scores
         valid = result.valid

@@ -83,6 +83,59 @@ def test_socket_connector_roundtrip_in_process():
     server.stop()
 
 
+def test_socket_connector_stalled_client_dropped_not_wedging():
+    """One client that never reads (full TCP buffer) must neither wedge
+    publishes to healthy clients nor block the publishing thread forever:
+    the bounded send drops it like a dead client (round-2 advisor #1)."""
+    import socket as socket_mod
+
+    server = SocketConnector(listen=True)
+    server._send_deadline_s = 0.25  # keep the test fast
+    server.start()
+
+    # Healthy client: a real SocketConnector that reads.
+    healthy = SocketConnector(port=server.port)
+    got = []
+    healthy.subscribe("results", lambda t, m: got.append(m))
+    healthy.start()
+
+    # Stalled client: raw socket with a tiny receive buffer that never reads.
+    stalled = socket_mod.create_connection(("127.0.0.1", server.port))
+    stalled.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 1024)
+
+    deadline = time.monotonic() + 5
+    while len(server._client_socks) < 2 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(server._client_socks) == 2
+    # Shrink the server-side send buffers so the stalled client's pipe
+    # actually fills (default buffers could swallow the whole test load).
+    with server._lock:
+        for sock in server._client_socks:
+            sock.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_SNDBUF, 4096)
+
+    # Publish payloads big enough to overrun the stalled client's buffers.
+    blob = "x" * 65536
+    t0 = time.monotonic()
+    for i in range(8):
+        server.publish("results", {"seq": i, "blob": blob})
+    elapsed = time.monotonic() - t0
+    # Bounded: the stalled client costs at most ~one deadline before it is
+    # dropped; an unbounded sendall would hang here forever.
+    assert elapsed < 5.0, f"publish loop took {elapsed:.1f}s — send not bounded"
+
+    deadline = time.monotonic() + 5
+    while len(got) < 8 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 8, f"healthy client got {len(got)}/8 messages"
+    # The stalled client was evicted; the healthy one remains.
+    with server._lock:
+        assert len(server._client_socks) == 1
+
+    stalled.close()
+    healthy.stop()
+    server.stop()
+
+
 _CHILD_ECHO = """
 import sys
 sys.path.insert(0, {root!r})

@@ -204,6 +204,51 @@ def test_pod_pallas_matcher_sparse_shards():
     assert (sims[:, 1:] < -1e29).all()
 
 
+def test_sentinel_slots_carry_pad_label():
+    """Sentinel -1 indices must surface the PAD label even when rows 0 and
+    capacity-1 hold real subjects — a clamped/wrapped gather would pair a
+    real subject's label with the -1e30 sentinel sim (round-2 advisor
+    finding: direct gallery.match() callers got a plausible wrong label)."""
+    from opencv_facerecognizer_tpu.parallel.gallery import match_pod_pallas
+
+    rng = np.random.default_rng(5)
+    cap = 64
+    emb = np.zeros((cap, 8), np.float32)
+    valid = np.zeros(cap, bool)
+    labels = np.full(cap, -1, np.int32)
+    # real subjects at the exact rows a clamp (0) or wrap (-1 -> last row)
+    # would alias onto
+    for row, lab in ((0, 3), (cap - 1, 9)):
+        v = rng.normal(size=8).astype(np.float32)
+        emb[row] = v / np.linalg.norm(v)
+        valid[row] = True
+        labels[row] = lab
+    q = np.tile(emb[0], (8, 1))
+
+    # pod shard_map form (interpret mode on the CPU mesh)
+    mesh = make_mesh(dp=1, tp=8)
+    with mesh:
+        lab, sims, idx = (np.asarray(v) for v in match_pod_pallas(
+            jnp.asarray(q), jnp.asarray(emb), jnp.asarray(valid),
+            jnp.asarray(labels), k=4, mesh=mesh, interpret=True))
+    sentinel = idx == -1
+    assert sentinel.any()
+    assert (lab[sentinel] == -1).all(), lab
+    assert set(lab[~sentinel].ravel()) <= {3, 9}
+
+    # single-device pallas fast path via gallery.match_fn
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                 (DP_AXIS, TP_AXIS))
+    g = ShardedGallery(capacity=cap, dim=8, mesh=mesh1, use_pallas=True)
+    g.add(emb[valid], labels[valid])
+    lab, sims, idx = (np.asarray(v) for v in g.match(np.asarray(q), k=4))
+    sentinel = idx == -1
+    assert sentinel.any()
+    assert (lab[sentinel] == g.labels_pad).all(), lab
+
+
 def test_initialize_multihost_single_process_noop(monkeypatch):
     from opencv_facerecognizer_tpu.parallel.mesh import initialize_multihost
 
