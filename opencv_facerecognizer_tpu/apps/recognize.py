@@ -22,6 +22,8 @@ import os
 import sys
 import time
 
+import numpy as np
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ocvf-recognize",
@@ -49,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "half (needs an even device count >= 2)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--flush-ms", type=float, default=30.0)
+    p.add_argument("--transfer-uint8", action="store_true",
+                   help="buffer and ship frames host->device as uint8 "
+                        "(4x less transfer; cast to f32 happens on device "
+                        "— right for 8-bit camera sources)")
     p.add_argument("--similarity-threshold", type=float, default=0.3)
     p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
     p.add_argument("--metrics-jsonl", help="append per-batch metrics to this file")
@@ -146,6 +152,7 @@ def main(argv=None) -> int:
         similarity_threshold=args.similarity_threshold,
         subject_names=names,
         metrics=metrics,
+        transfer_dtype=np.uint8 if args.transfer_uint8 else np.float32,
     )
     service.start()
 
@@ -171,8 +178,6 @@ def main(argv=None) -> int:
     try:
         if args.source == "dir":
             import json
-
-            import numpy as np
 
             from opencv_facerecognizer_tpu.ops import image as image_ops
             from opencv_facerecognizer_tpu.utils.dataset import _imread_gray

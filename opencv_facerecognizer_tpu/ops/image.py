@@ -29,9 +29,15 @@ def to_grayscale(x: jnp.ndarray, channel_order: str = "rgb") -> jnp.ndarray:
 
 
 def resize(x: jnp.ndarray, size: Tuple[int, int], method: str = "bilinear") -> jnp.ndarray:
-    """Resize trailing [H, W] dims to ``size=(h, w)``; batch dims untouched."""
+    """Resize trailing [H, W] dims to ``size=(h, w)``; batch dims untouched.
+
+    Identity sizes return the input unchanged — the serving graph calls
+    this on crops that are already at ``face_size``, and an identity
+    ``jax.image.resize`` is NOT free (it still emits the resample)."""
     x = jnp.asarray(x, dtype=jnp.float32)
     out_shape = x.shape[:-2] + tuple(size)
+    if out_shape == x.shape:
+        return x
     return jax.image.resize(x, out_shape, method=method)
 
 

@@ -100,6 +100,11 @@ class RecognitionPipeline:
         match = self.gallery.match_fn(k)
 
         def step(det_params, emb_params, gallery_emb, gallery_valid, gallery_labels, frames):
+            # Camera frames ride host->device as uint8 when the caller has
+            # them that way (4x less PCIe/tunnel traffic than f32 — H2D,
+            # not compute, dominates the serving e2e estimate); the cast
+            # to f32 happens here, on device.
+            frames = frames.astype(jnp.float32)
             # 1) detect (dense convs; dp-sharded batch)
             outputs = det.net.apply({"params": det_params}, frames)
             boxes, det_scores, valid = detector_mod.decode_detections(
@@ -132,14 +137,25 @@ class RecognitionPipeline:
         # Gallery capacity (and with it the pallas/GSPMD selection) can
         # change at runtime via auto-grow — bake both into the cache key so
         # a grown gallery re-selects its matcher instead of re-tracing the
-        # old closure at the new shapes.
-        return (*frames.shape, self.gallery.capacity,
+        # old closure at the new shapes. Input dtype is a trace shape too
+        # (uint8 fast transfer vs f32).
+        return (*frames.shape, str(frames.dtype), self.gallery.capacity,
                 self.gallery._pallas_enabled())
 
+    @staticmethod
+    def _as_device_frames(frames) -> jnp.ndarray:
+        """uint8 stays uint8 (fast H2D path — cast happens in-graph);
+        everything else normalizes to f32."""
+        frames = jnp.asarray(frames)
+        if frames.dtype != jnp.uint8:
+            frames = frames.astype(jnp.float32)
+        return frames
+
     def recognize_batch(self, frames: jnp.ndarray) -> RecognitionResult:
-        """[B, H, W] frames -> RecognitionResult; B must divide by dp size,
-        and B * max_faces must too (it does when B does)."""
-        frames = jnp.asarray(frames, jnp.float32)
+        """[B, H, W] frames (f32 or uint8) -> RecognitionResult; B must
+        divide by dp size, and B * max_faces must too (it does when B
+        does)."""
+        frames = self._as_device_frames(frames)
         key = self._step_key(frames)
         if key not in self._step_cache:
             self._step_cache[key] = self._build_step(*frames.shape)
@@ -157,7 +173,7 @@ class RecognitionPipeline:
         """Same fused step, but the outputs leave the device as ONE packed
         [B, K, 6 + 2k] f32 array (see ``pack_result``) — the serving loop's
         single-readback path. Decode host-side with ``unpack_result``."""
-        frames = jnp.asarray(frames, jnp.float32)
+        frames = self._as_device_frames(frames)
         key = self._step_key(frames)
         if key not in self._packed_cache:
             step = self._step_cache.get(key)

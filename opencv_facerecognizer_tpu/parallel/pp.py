@@ -114,6 +114,7 @@ class TwoStagePipeline:
         max_faces = det.max_faces
 
         def stage_a(det_params, frames):
+            frames = frames.astype(jnp.float32)  # uint8 fast-transfer path
             outputs = det.net.apply({"params": det_params}, frames)
             boxes, det_scores, valid = detector_mod.decode_detections(
                 outputs, max_faces, det.score_threshold, det.iou_threshold
@@ -161,7 +162,9 @@ class TwoStagePipeline:
         return self._b_cache[key]
 
     def _submit_a(self, frames):
-        frames = jnp.asarray(frames, jnp.float32)
+        frames = jnp.asarray(frames)
+        if frames.dtype != jnp.uint8:  # uint8 rides H2D as-is, cast in-graph
+            frames = frames.astype(jnp.float32)
         return self._stage_a(self._det_params, frames)
 
     def _hop(self, a_out):

@@ -369,7 +369,9 @@ class SocketConnector(_TopicDispatchConnector):
             with lock:
                 try:
                     ok = self._send_bounded(sock, payload)
-                except OSError:
+                except (OSError, ValueError):
+                    # ValueError: select on a socket another thread closed
+                    # mid-publish (fileno() == -1) — same as a dead client.
                     ok = False
                 if not ok:
                     # Close while STILL holding the send lock: a concurrent

@@ -1,0 +1,93 @@
+"""The chained-differencing timing instrument, shared by every benchmark
+(bench.py, scripts/bench_lifecycle.py, scripts/explore_perf.py) so the
+artifacts cannot silently diverge in methodology.
+
+Why this exists (measured on the axon tunneled PJRT backend, see bench.py's
+module docstring for the full analysis): ``block_until_ready`` does not
+await execution there, and any blocking readback quantizes at a ~100 ms
+sync-poll interval — so per-iteration wall timing is fiction. Instead, K
+iterations are serialized INSIDE one jit via a 1e-30-scaled data dependency
+and the whole chain is timed with a single readback; the per-iteration cost
+is the difference of two chain lengths' minima:
+
+    (min T(K2) - min T(K1)) / (K2 - K1)
+
+Jitter only ever ADDS to a single chain's wall time, so min-of-repeats per
+length is taken BEFORE differencing (min-ing individual pair diffs is
+biased low). K2 escalates up a ladder until the delta clears the readback
+quantization. The method reproduces 218 TFLOP/s on a bare 4096^3 bf16
+matmul (nominal peak 197) — calibration within instrument error.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+CHAIN_K1 = 4
+#: Escalation ladder: the chain delta must dwarf the backend's ~100 ms
+#: readback quantization; fast configs need the long chains.
+CHAIN_K2_LADDER = (34, 154, 1024)
+MIN_DELTA_S = 0.25
+MEASURE_PAIRS = 3
+
+
+def measure_chained(
+    run_chain: Callable[[int], float],
+    *,
+    k1: int = CHAIN_K1,
+    k2_ladder: Sequence[int] = CHAIN_K2_LADDER,
+    min_delta_s: float = MIN_DELTA_S,
+    pairs: int = MEASURE_PAIRS,
+) -> Tuple[list, list, int, Optional[float]]:
+    """min-of-chains differencing with K2 escalation.
+
+    ``run_chain(k)`` must execute the k-length chain end-to-end (warm
+    compile included on its first call per k) and return the wall seconds
+    of ONE timed run. Returns (t_k1_samples, t_k2_samples, k2_used,
+    seconds_per_iteration_or_None).
+    """
+    t1s = [run_chain(k1) for _ in range(pairs)]
+    t2s, k2, delta = [], k2_ladder[0], 0.0
+    for k2 in k2_ladder:
+        t2s = [run_chain(k2) for _ in range(pairs)]
+        delta = min(t2s) - min(t1s)
+        if delta >= min_delta_s:
+            break
+    per_iter = delta / (k2 - k1)
+    return t1s, t2s, k2, (per_iter if per_iter > 1e-6 else None)
+
+
+def scalar_chain_ms(
+    scalar_fn: Callable[..., "object"],
+    args: tuple,
+    **kwargs,
+) -> Optional[float]:
+    """ms/iteration of ``scalar_fn(*args) -> f32 scalar`` via the chained
+    instrument. The LAST element of ``args`` must be the array the
+    dependency threads through (iteration i sees ``args[-1] + dep``)."""
+    import jax
+    import jax.numpy as jnp
+
+    def chained(k, *a):
+        def body(i, carry):
+            dep, acc = carry
+            out = scalar_fn(*a[:-1], a[-1] + dep)
+            dep = out * 1e-30
+            return dep, acc + out
+
+        return jax.lax.fori_loop(0, k, body,
+                                 (jnp.float32(0.0), jnp.float32(0.0)))[1]
+
+    jc = jax.jit(chained, static_argnums=0)
+
+    def run_chain(k):
+        _ = np.asarray(jc(k, *args))  # warm: compile this k
+        t0 = time.perf_counter()
+        _ = np.asarray(jc(k, *args))  # one readback forces the whole chain
+        return time.perf_counter() - t0
+
+    *_rest, per_iter = measure_chained(run_chain, **kwargs)
+    return None if per_iter is None else per_iter * 1e3

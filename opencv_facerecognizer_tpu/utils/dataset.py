@@ -131,6 +131,39 @@ def shuffle(X: np.ndarray, y: np.ndarray, seed: int = 0):
     return np.asarray(X)[perm], np.asarray(y)[perm]
 
 
+def _bilinear_sample(img: np.ndarray, ys: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Edge-clamped bilinear sampling of ``img`` at float coords (ys, xs)."""
+    h, w = img.shape
+    ys = np.clip(ys, 0.0, h - 1.0)
+    xs = np.clip(xs, 0.0, w - 1.0)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    fy = (ys - y0).astype(np.float32)
+    fx = (xs - x0).astype(np.float32)
+    return (img[y0, x0] * (1 - fy) * (1 - fx)
+            + img[y1, x0] * fy * (1 - fx)
+            + img[y0, x1] * (1 - fy) * fx
+            + img[y1, x1] * fy * fx)
+
+
+def _smooth_field(rng: np.random.Generator, shape: Tuple[int, int],
+                  amplitude: float, cells: int = 8) -> np.ndarray:
+    """Low-frequency random displacement field: coarse noise, kron-upsampled
+    and box-blurred twice — smooth enough to read as pose/expression
+    deformation rather than pixel noise."""
+    h, w = shape
+    coarse = rng.normal(scale=amplitude, size=(-(-h // cells), -(-w // cells)))
+    field = np.kron(coarse, np.ones((cells, cells)))[:h, :w]
+    for _ in range(2):  # separable 3x3 box blur, edge-padded
+        field = (np.pad(field, 1, mode="edge")[:-2, 1:-1]
+                 + field + np.pad(field, 1, mode="edge")[2:, 1:-1]) / 3.0
+        field = (np.pad(field, 1, mode="edge")[1:-1, :-2]
+                 + field + np.pad(field, 1, mode="edge")[1:-1, 2:]) / 3.0
+    return field.astype(np.float32)
+
+
 def make_synthetic_faces(
     num_subjects: int = 10,
     per_subject: int = 10,
@@ -138,14 +171,34 @@ def make_synthetic_faces(
     seed: int = 0,
     noise: float = 12.0,
     illumination: float = 0.35,
+    rotation: float = 0.0,
+    scale_jitter: float = 0.0,
+    elastic: float = 0.0,
+    occlusion: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
     """Deterministic face-like dataset: per-subject smooth base pattern +
     per-sample noise, global illumination scaling, and small translations —
     the variation axes the classic pipeline (TanTriggs/PCA/LDA/LBP) exists
-    to handle. Returns (images [N,H,W] in [0,255], labels, names)."""
+    to handle. Returns (images [N,H,W] in [0,255], labels, names).
+
+    The hard-protocol axes (all default-off so existing distributions stay
+    bit-identical; the round-2 verdict asked for a protocol "worth 99%"):
+
+    - ``rotation``: per-sample in-plane pose rotation, uniform in
+      [-rotation, +rotation] degrees, bilinear resample around the center.
+    - ``scale_jitter``: per-sample scale factor uniform in [1-s, 1+s]
+      (composed into the same affine warp).
+    - ``elastic``: per-sample smooth elastic deformation, displacement
+      amplitude in pixels (low-frequency field — expression/3-D pose
+      analog, the deformation PCA/LDA templates cannot model linearly).
+    - ``occlusion``: probability of one random occluding rectangle
+      (20-45% of each side, filled with flat gray + noise — sunglasses/
+      scarf analog).
+    """
     rng = np.random.default_rng(seed)
     h, w = size
     yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    cy0, cx0 = (h - 1) / 2.0, (w - 1) / 2.0
     images, labels = [], []
     for s in range(num_subjects):
         # Smooth "identity" structure: sum of a few random low-freq gaussians.
@@ -160,7 +213,30 @@ def make_synthetic_faces(
             img = base.copy()
             # small translation (integer, wraps cropped)
             ty, tx = rng.integers(-2, 3, size=2)
-            img = np.roll(img, (ty, tx), axis=(0, 1))
+            if rotation or scale_jitter or elastic:
+                # One composed inverse-map warp: rotate + scale about the
+                # center, translate, plus the elastic displacement field.
+                ang = np.deg2rad(rng.uniform(-rotation, rotation)) if rotation else 0.0
+                sc = rng.uniform(1 - scale_jitter, 1 + scale_jitter) if scale_jitter else 1.0
+                cos_a, sin_a = np.cos(ang), np.sin(ang)
+                y0 = yy - cy0 - ty
+                x0 = xx - cx0 - tx
+                ys = (cos_a * y0 + sin_a * x0) / sc + cy0
+                xs = (-sin_a * y0 + cos_a * x0) / sc + cx0
+                if elastic:
+                    ys = ys + _smooth_field(rng, (h, w), elastic)
+                    xs = xs + _smooth_field(rng, (h, w), elastic)
+                img = _bilinear_sample(img, ys, xs)
+            else:
+                img = np.roll(img, (ty, tx), axis=(0, 1))
+            if occlusion and rng.uniform() < occlusion:
+                oh = int(rng.uniform(0.20, 0.45) * h)
+                ow = int(rng.uniform(0.20, 0.45) * w)
+                oy = int(rng.integers(0, h - oh + 1))
+                ox = int(rng.integers(0, w - ow + 1))
+                patch = rng.uniform(40, 200) + rng.normal(
+                    scale=8.0, size=(oh, ow)).astype(np.float32)
+                img[oy : oy + oh, ox : ox + ow] = patch
             # illumination scale + offset
             img = img * rng.uniform(1 - illumination, 1 + illumination) + rng.uniform(-20, 20)
             img = img + rng.normal(scale=noise, size=(h, w))

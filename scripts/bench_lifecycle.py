@@ -1,0 +1,177 @@
+"""Gallery lifecycle at scale, mid-serving, on the real chip (VERDICT
+round-2 item #5): serve at 16k enrolled rows -> enroll past
+``PALLAS_MIN_CAPACITY`` (auto-grow doubles capacity AND switches the
+matcher from the XLA materialize form to the pallas streaming kernel) ->
+keep growing to 1M rows -> measure the steady in-pipeline cost at each
+stage and the one-off stall each growth causes.
+
+What the artifact records (merged into BENCH_DETAIL.json under
+"lifecycle"; bench.py preserves the section):
+
+- ``steady_ms_per_batch`` at 16k / 128k / 1M rows, timed by the same
+  chained-differencing instrument bench.py uses (the tunneled backend's
+  ~100 ms readback floor would otherwise swamp per-batch numbers);
+- ``grow_stall_ms`` per growth event: wall time of the FIRST
+  ``recognize_batch_packed`` call after ``gallery.add`` crossed capacity —
+  the XLA recompile + (at 64k->128k) the matcher switch the serving thread
+  actually eats; subsequent-call time recorded alongside to show recovery;
+- ``install_ms``: host->device install cost of the grown snapshot
+  (``ShardedGallery._install`` device_put of the doubled arrays).
+
+Run:  PYTHONPATH=. python scripts/bench_lifecycle.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def chained_ms_per_batch(pipeline, frames_stack):
+    """Shared chained-differencing instrument (utils.benchtime) over the
+    fused recognize step, folding every output into the chain scalar."""
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.utils.benchtime import scalar_chain_ms
+
+    data = pipeline.gallery.data
+    key = pipeline._step_key(frames_stack[0])
+    if key not in pipeline._step_cache:
+        pipeline._step_cache[key] = pipeline._build_step(*frames_stack[0].shape)
+    step = pipeline._step_cache[key]
+
+    def scalar(det_p, emb_p, g_emb, g_valid, g_lab, frames):
+        res = step(det_p, emb_p, g_emb, g_valid, g_lab, frames)
+        return (jnp.sum(res.similarities) + jnp.sum(res.boxes) * 1e-6
+                + jnp.sum(res.valid))
+
+    return scalar_chain_ms(scalar, (
+        pipeline.detector.params, pipeline.embed_params, data.embeddings,
+        data.valid, data.labels, frames_stack[0],
+    ))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder,
+    )
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+    dev = jax.devices()[0]
+    _log(f"device: {dev}")
+    batch, h, w, max_faces, dim = 32, 256, 256, 8, 128
+
+    det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3)
+    scenes, boxes, counts = make_synthetic_scenes(
+        num_scenes=48, scene_size=(h, w), max_faces=max_faces,
+        face_size_range=(24, 56), seed=7)
+    det.train(scenes, boxes, counts, steps=150, batch_size=16)
+    net = FaceEmbedNet(embed_dim=dim)
+    emb_params = init_embedder(net, num_classes=16, input_shape=(112, 112),
+                               seed=0)["net"]
+
+    rng = np.random.default_rng(0)
+    mesh = make_mesh()
+    gallery = ShardedGallery(capacity=16384, dim=dim, mesh=mesh)
+    gallery.add(rng.normal(size=(16384, dim)).astype(np.float32),
+                rng.integers(0, 512, 16384).astype(np.int32))
+    pipeline = RecognitionPipeline(det, net, emb_params, gallery,
+                                   face_size=(112, 112))
+
+    frames_stack = jnp.stack([
+        jnp.asarray(make_synthetic_scenes(
+            num_scenes=batch, scene_size=(h, w), max_faces=max_faces,
+            face_size_range=(24, 56), seed=100 + i)[0], jnp.float32)
+        for i in range(4)
+    ])
+    one_batch = np.asarray(frames_stack[0])
+
+    result = {"batch": batch, "stages": [], "grow_events": []}
+
+    def steady(tag):
+        ms = chained_ms_per_batch(pipeline, frames_stack)
+        result["stages"].append({
+            "rows": gallery.size, "capacity": gallery.capacity,
+            "pallas": gallery._pallas_enabled(),
+            "steady_ms_per_batch": round(ms, 3),
+        })
+        _log(f"[{tag}] rows={gallery.size} cap={gallery.capacity} "
+             f"pallas={gallery._pallas_enabled()} steady {ms:.3f} ms/batch")
+
+    # serve at 16k (XLA matcher), establish steady state
+    _ = np.asarray(pipeline.recognize_batch_packed(one_batch))  # warm
+    steady("16k")
+
+    def grow_to(total_rows, tag):
+        """Enroll up to total_rows; time install, then the first and second
+        serving calls after the growth (stall + recovery)."""
+        need = total_rows - gallery.size
+        t0 = time.perf_counter()
+        gallery.add(rng.normal(size=(need, dim)).astype(np.float32),
+                    rng.integers(0, 512, need).astype(np.int32))
+        install_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        _ = np.asarray(pipeline.recognize_batch_packed(one_batch))
+        first_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        _ = np.asarray(pipeline.recognize_batch_packed(one_batch))
+        second_ms = (time.perf_counter() - t0) * 1e3
+        result["grow_events"].append({
+            "to_rows": gallery.size, "to_capacity": gallery.capacity,
+            "pallas_after": gallery._pallas_enabled(),
+            "install_ms": round(install_ms, 1),
+            "grow_stall_ms": round(first_ms, 1),
+            "next_call_ms": round(second_ms, 1),
+        })
+        _log(f"[{tag}] grew to {gallery.size} rows (cap {gallery.capacity}, "
+             f"pallas={gallery._pallas_enabled()}): install {install_ms:.0f} ms, "
+             f"first call (stall) {first_ms:.0f} ms, next {second_ms:.0f} ms")
+
+    # cross PALLAS_MIN_CAPACITY: 16k -> 80k rows => capacity doubles past
+    # 64k and the matcher switches to the streaming kernel
+    grow_to(80_000, "grow->128k")
+    steady("128k")
+    # then to 1M rows (capacity 1,048,576)
+    grow_to(1_000_000, "grow->1M")
+    steady("1M")
+
+    detail_path = os.path.join(REPO, "BENCH_DETAIL.json")
+    try:
+        detail = json.load(open(detail_path))
+    except (OSError, json.JSONDecodeError):
+        detail = {}
+    detail["lifecycle"] = {
+        "device": str(dev),
+        "date": time.strftime("%Y-%m-%d"),
+        "note": ("serve@16k -> enroll past PALLAS_MIN_CAPACITY (matcher "
+                 "switch) -> 1M rows, all mid-serving on one pipeline "
+                 "object; grow_stall_ms is the first recognize call after "
+                 "each growth (XLA recompile at the new static shape), "
+                 "measured wall-clock including the tunneled readback"),
+        **result,
+    }
+    with open(detail_path, "w") as fh:
+        json.dump(detail, fh, indent=2)
+    _log("merged lifecycle section into BENCH_DETAIL.json")
+    print(json.dumps(detail["lifecycle"], indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Measurement-driven selection of the round-3 MFU attack (VERDICT item
+#1): candidate detector stems (space_to_depth x features) and embedder
+block types, each briefly trained on the bench workload's synthetic scenes,
+quality-checked (detector recall/precision@IoU .5; embedder verification
+canary), and timed at batch 32 with the chained-differencing instrument.
+
+This is an operator/dev tool, not part of bench.py: it exists so the
+serving default is chosen by numbers on this chip, not by vibes. Output is
+a JSON table on stdout; the chosen config gets wired as the bench/serving
+default and re-measured by bench.py.
+
+Run:  PYTHONPATH=. python scripts/explore_perf.py [--skip-embedder]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def chained_ms(fn, args):
+    """Shared chained-differencing instrument (utils.benchtime)."""
+    from opencv_facerecognizer_tpu.utils.benchtime import scalar_chain_ms
+
+    return scalar_chain_ms(fn, args)
+
+
+def detector_variants():
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.detector import (
+        CNNFaceDetector, evaluate_detector,
+    )
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+    h = w = 256
+    max_faces = 8
+    batch = 32
+    train = make_synthetic_scenes(num_scenes=64, scene_size=(h, w),
+                                  max_faces=max_faces,
+                                  face_size_range=(24, 56), seed=7)
+    test = make_synthetic_scenes(num_scenes=48, scene_size=(h, w),
+                                 max_faces=max_faces,
+                                 face_size_range=(24, 56), seed=1234)
+    frames = jnp.asarray(test[0][:batch], jnp.float32)
+
+    variants = {
+        "baseline_s1_16-32-64": dict(features=(16, 32, 64), space_to_depth=1),
+        "s2d4_64-64": dict(features=(64, 64), space_to_depth=4),
+        "s2d4_64-96": dict(features=(64, 96), space_to_depth=4),
+        "s2d4_96-96": dict(features=(96, 96), space_to_depth=4),
+        "s2d8_96": dict(features=(96,), space_to_depth=8),
+        "s2d2_32-64-64": dict(features=(32, 64, 64), space_to_depth=2),
+    }
+    rows = {}
+    for name, cfg in variants.items():
+        det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3, **cfg)
+        t0 = time.perf_counter()
+        det.train(*train, steps=200, batch_size=16)
+        train_s = time.perf_counter() - t0
+        quality = evaluate_detector(det, *test)
+
+        def fwd(params, frames, _det=det):
+            out = _det.net.apply({"params": params}, frames)
+            return (jnp.sum(out["heatmap"]) + jnp.sum(out["size"])
+                    + jnp.sum(out["offset"]))
+
+        ms = chained_ms(fwd, (det.params, frames))
+        n_params = sum(int(np.prod(p.shape)) for p in
+                       __import__("jax").tree_util.tree_leaves(det.params))
+        rows[name] = {
+            "ms_per_batch32_fwd": round(ms, 3),
+            "recall": round(quality["recall"], 4),
+            "precision": round(quality["precision"], 4),
+            "mean_iou": round(quality["mean_matched_iou"], 3),
+            "params": n_params,
+            "train_s": round(train_s, 1),
+        }
+        _log(f"[det {name}] {ms:.3f} ms/b32, recall {quality['recall']:.3f} "
+             f"precision {quality['precision']:.3f} iou "
+             f"{quality['mean_matched_iou']:.3f} ({n_params} params)")
+    return rows
+
+
+def embedder_variants():
+    import jax
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder, normalize_faces,
+    )
+
+    batch = 256  # 32 frames x 8 slots, the fused graph's embed batch
+    size = (112, 112)
+    frames = jnp.asarray(
+        np.random.default_rng(0).normal(120, 40, (batch, *size)), jnp.float32)
+
+    variants = {
+        "separable_64-128-128x2": dict(stage_features=(64, 128, 128),
+                                       stage_blocks=(2, 2, 2),
+                                       block="separable"),
+        "dense_64-128-128x2": dict(stage_features=(64, 128, 128),
+                                   stage_blocks=(2, 2, 2), block="dense"),
+        "dense_64-128-128x1": dict(stage_features=(64, 128, 128),
+                                   stage_blocks=(1, 1, 1), block="dense"),
+        "dense_128-128-256x2": dict(stage_features=(128, 128, 256),
+                                    stage_blocks=(2, 2, 2), block="dense"),
+    }
+    rows = {}
+    for name, cfg in variants.items():
+        net = FaceEmbedNet(embed_dim=128, stem_features=32, **cfg)
+        params = init_embedder(net, num_classes=8, input_shape=size,
+                               seed=0)["net"]
+
+        def fwd(p, x, _net=net):
+            return jnp.sum(_net.apply({"params": p}, normalize_faces(x, size)))
+
+        ms = chained_ms(fwd, (params, frames))
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree_util.tree_leaves(params))
+        rows[name] = {"ms_per_256crops_fwd": round(ms, 3), "params": n_params}
+        _log(f"[emb {name}] {ms:.3f} ms/256 crops ({n_params} params)")
+    return rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-embedder", action="store_true")
+    ap.add_argument("--skip-detector", action="store_true")
+    args = ap.parse_args(argv)
+    import jax
+
+    out = {"device": str(jax.devices()[0]), "date": time.strftime("%Y-%m-%d")}
+    if not args.skip_detector:
+        out["detector"] = detector_variants()
+    if not args.skip_embedder:
+        out["embedder"] = embedder_variants()
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,8 +52,20 @@ def classic_kfold(model_kind: str, num_subjects: int, per_subject: int,
     }
 
 
+#: The round-3 hard protocol (VERDICT round-2 missing #1: the previous
+#: smooth-gaussian + noise/illumination/±2px distribution was "a recipe-
+#: works signal, not a north-star proof"): every config now adds in-plane
+#: pose rotation, scale jitter, smooth elastic deformation (expression/3-D
+#: pose analog), and random occluding rectangles (sunglasses/scarf analog).
+#: LFW-analog configs get the strongest settings.
+HARD_POSE = dict(rotation=8.0, scale_jitter=0.08, elastic=1.2, occlusion=0.25)
+HARD_WILD = dict(rotation=12.0, scale_jitter=0.12, elastic=1.8, occlusion=0.3)
+
+
 def cnn_verification():
-    """ArcFace CNN on disjoint identities, 6000-pair 10-fold protocol."""
+    """ArcFace CNN on disjoint identities, 6000-pair 10-fold protocol, on
+    the hard (pose/elastic/occlusion) distribution with hundreds of
+    training identities."""
     from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
     from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
     from opencv_facerecognizer_tpu.utils.verification import (
@@ -62,20 +74,21 @@ def cnn_verification():
 
     size = (64, 64)
     X_tr, y_tr, _ = make_synthetic_faces(
-        num_subjects=60, per_subject=12, size=size, seed=11, noise=10.0
+        num_subjects=200, per_subject=10, size=size, seed=11, noise=10.0,
+        **HARD_WILD,
     )
     # Held-out identities: disjoint seed -> disjoint subject structures.
     X_te, y_te, _ = make_synthetic_faces(
-        num_subjects=24, per_subject=12, size=size, seed=77, noise=10.0
+        num_subjects=48, per_subject=12, size=size, seed=77, noise=10.0,
+        **HARD_WILD,
     )
-    # Config selected by measurement (2026-07-30, real chip): the wider
-    # net reaches 0.9990 +/- 0.0015 vs 0.9890 at embed_dim=64/stages 32-64
-    # (and 1200 steps of the narrow net did NOT help: 0.9883) — capacity,
-    # not optimization length, was the binding constraint.
+    # Round-2 config (wider net, selected by measurement) rescaled for the
+    # hard protocol: 200 train identities and pose/occlusion augmentation
+    # inherent in the training set need more optimization steps.
     emb = CNNEmbedding(
         embed_dim=128, input_size=size, stem_features=24,
         stage_features=(48, 96), stage_blocks=(2, 2),
-        train_steps=900, batch_size=64, learning_rate=2e-3, seed=3,
+        train_steps=2000, batch_size=64, learning_rate=2e-3, seed=3,
     )
     t0 = time.perf_counter()
     emb.compute(X_tr, y_tr)
@@ -86,10 +99,11 @@ def cnn_verification():
     return {
         "accuracy": round(acc, 4), "std": round(std, 4),
         "threshold": round(thr, 3),
-        "dataset": "synthetic verification: train 60x12, eval 24 disjoint "
-                   "identities x12, 6000 pairs, 10-fold protocol; "
-                   "embed_dim=128, stages 48/96, 900 steps — exceeds the "
-                   ">=0.99 north star (BASELINE.json:5)",
+        "dataset": "synthetic verification, HARD protocol (rot 12deg, "
+                   "scale 0.12, elastic 1.8px, occlusion p=0.3): train 200 "
+                   "identities x10, eval 48 disjoint x12, 6000 pairs, "
+                   "10-fold; embed_dim=128, stages 48/96, 2000 steps — "
+                   "vs the >=0.99 north star (BASELINE.json:5)",
         "seconds": round(train_s, 1),
     }
 
@@ -99,12 +113,15 @@ def cnn_verification():
 #: merge with the cache at scripts/.accuracy_cache.json).
 CONFIGS = {
     "eigenfaces": ("eigenfaces_orl",
-                   lambda: classic_kfold("eigenfaces", 40, 10, 10, seed=1)),
+                   lambda: classic_kfold("eigenfaces", 40, 10, 10, seed=1,
+                                         **HARD_POSE)),
     "fisherfaces": ("fisherfaces_yaleb",
                     lambda: classic_kfold("fisherfaces", 30, 12, 10, seed=2,
-                                          illumination=0.7, noise=14.0)),
+                                          illumination=0.7, noise=14.0,
+                                          **HARD_POSE)),
     "lbph": ("lbph_lfw",
-             lambda: classic_kfold("lbph", 40, 8, 10, seed=3, noise=18.0)),
+             lambda: classic_kfold("lbph", 40, 8, 10, seed=3, noise=18.0,
+                                   **HARD_WILD)),
     "cnn": ("cnn_verification", cnn_verification),
 }
 

@@ -76,6 +76,33 @@ def test_fused_pipeline_runs_sharded(pipeline_setup, dp, tp):
     assert np.all(sims <= 1.0 + 1e-3)
 
 
+def test_pipeline_uint8_transfer_matches_f32(pipeline_setup):
+    """The uint8 fast-transfer path (frames ride H2D as uint8, cast to f32
+    in-graph) must produce the same result as sending the same pixel
+    values as f32 — it is a transfer-format choice, not a model change."""
+    det, net, params, scenes, boxes, counts, crops, labels = pipeline_setup
+    mesh = make_mesh(tp=8)
+    gallery = ShardedGallery(capacity=64, dim=32, mesh=mesh)
+    emb = np.asarray(net.apply({"params": params["net"]},
+                               normalize_faces(crops, FACE)))
+    gallery.add(emb, labels)
+    pipe = RecognitionPipeline(det, net, params["net"], gallery,
+                               face_size=FACE, top_k=1)
+    u8 = np.clip(scenes[:8], 0, 255).astype(np.uint8)
+    r_u8 = pipe.recognize_batch(u8)
+    r_f32 = pipe.recognize_batch(u8.astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(r_u8.valid),
+                                  np.asarray(r_f32.valid))
+    np.testing.assert_array_equal(np.asarray(r_u8.labels),
+                                  np.asarray(r_f32.labels))
+    np.testing.assert_allclose(np.asarray(r_u8.boxes),
+                               np.asarray(r_f32.boxes), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(r_u8.similarities),
+                               np.asarray(r_f32.similarities), atol=1e-5)
+    # distinct trace per dtype, cached independently
+    assert len(pipe._step_cache) == 2
+
+
 def test_pipeline_batch_caching(pipeline_setup):
     det, net, params, scenes, *_ = pipeline_setup
     mesh = make_mesh(tp=8)

@@ -92,6 +92,53 @@ def test_pp_stream_order_and_completeness(stack):
                                       np.asarray(solo.valid))
 
 
+def test_pp_stream_dispatches_next_stage_a_before_yield(stack):
+    """The depth-2 overlap contract: stage A for batch i+1 must be
+    DISPATCHED before batch i's result is handed to the consumer —
+    otherwise the disjoint stage meshes serialize and PP degenerates to
+    the fused pipeline's latency with an extra hop (VERDICT round-2
+    item #6: assert the scheduling, since a one-chip box can't show the
+    hardware win)."""
+    det, net, emb_params, emb, labels, scenes = stack
+    mesh_a, mesh_b = split_mesh(make_mesh(dp=2, tp=4))
+    gal = ShardedGallery(capacity=64, dim=32, mesh=mesh_b)
+    gal.add(emb, labels)
+    pp = TwoStagePipeline(det, net, emb_params, gal, mesh_a,
+                          face_size=(48, 48), top_k=1)
+
+    events = []
+    orig_a, orig_b = pp._submit_a, pp._submit_b
+    counts = {"a": 0, "b": 0}
+
+    def wrapped_a(frames):
+        events.append(("A", counts["a"]))
+        counts["a"] += 1
+        return orig_a(frames)
+
+    def wrapped_b(hopped):
+        events.append(("B", counts["b"]))
+        counts["b"] += 1
+        return orig_b(hopped)
+
+    pp._submit_a, pp._submit_b = wrapped_a, wrapped_b
+    batches = [scenes[i:i + 4] for i in range(0, 16, 4)]
+    for i, _out in enumerate(pp.recognize_stream(iter(batches))):
+        events.append(("got", i))
+
+    def pos(ev):
+        return events.index(ev)
+
+    assert counts["a"] == counts["b"] == 4
+    for i in range(len(batches) - 1):
+        # A(i+1) dispatched before result i reaches the consumer...
+        assert pos(("A", i + 1)) < pos(("got", i)), events
+        # ...and before B(i+1) (A feeds B, trivially, but pin the order).
+        assert pos(("A", i + 1)) < pos(("B", i + 1)), events
+    # depth 2, not unbounded: B(i) is submitted before A(i+2) is dispatched
+    for i in range(len(batches) - 2):
+        assert pos(("B", i)) < pos(("A", i + 2)), events
+
+
 def test_pp_sees_live_enrolment(stack):
     """The gallery must stay live through PP: an enrolment after pipeline
     construction lands on the next batch (same contract as the fused
