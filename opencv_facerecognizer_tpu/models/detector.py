@@ -409,10 +409,20 @@ class CNNFaceDetector:
         return det
 
     def detect_batch(self, images: jnp.ndarray):
-        """[N, H, W] -> (boxes [N,K,4] yxyx, scores [N,K], valid [N,K]) on device."""
+        """[N, H, W] -> (boxes [N,K,4] yxyx, scores [N,K], valid [N,K]) on device.
+
+        Arbitrary H/W are accepted (the CascadedDetector-shaped contract):
+        inputs are edge-padded up to the next multiple of the decode stride
+        (which every space_to_depth setting divides), and box coordinates
+        are unaffected since padding grows only the bottom/right."""
         if self._params is None:
             raise RuntimeError("CNNFaceDetector.detect called before train()/load_params()")
-        return self._detect_jit(self._params, jnp.asarray(images, jnp.float32))
+        images = jnp.asarray(images, jnp.float32)
+        h, w = images.shape[1], images.shape[2]
+        ph, pw = (-h) % STRIDE, (-w) % STRIDE
+        if ph or pw:
+            images = jnp.pad(images, ((0, 0), (0, ph), (0, pw)), mode="edge")
+        return self._detect_jit(self._params, images)
 
     def detect(self, img: np.ndarray):
         """Single grayscale image -> [(x0, y0, x1, y1)] like the reference's

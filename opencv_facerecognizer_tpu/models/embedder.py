@@ -143,14 +143,75 @@ def arcface_loss(
     return optax.softmax_cross_entropy(logits, onehot).mean()
 
 
-def make_train_step(model: FaceEmbedNet, optimizer, margin: float = 0.5, scale: float = 32.0):
-    """Returns a jitted (params, opt_state, batch_x, batch_y) -> updated step."""
+def augment_batch(key: jax.Array, x: jnp.ndarray, *, occlusion_p: float = 0.5,
+                  max_shift: int = 3, max_rotate_deg: float = 14.0,
+                  scale_jitter: float = 0.1) -> jnp.ndarray:
+    """On-device train-time augmentation for STANDARDIZED [N, H, W] faces:
+    per-sample horizontal flip, rotation/scale resample, +/-max_shift
+    translation (edge-padded dynamic slice), and a mean-fill cutout
+    rectangle with probability ``occlusion_p`` — the invariances (pose,
+    partial occlusion) a robust verifier needs but a 10-views-per-identity
+    enrolment set cannot teach on its own. Pure jnp: runs inside the
+    jitted train step."""
+    from jax.scipy.ndimage import map_coordinates
+
+    n, h, w = x.shape
+    (k_flip, k_oy, k_ox, k_app, k_oh, k_ow, k_cy, k_cx,
+     k_rot, k_sc) = jax.random.split(key, 10)
+    flip = jax.random.bernoulli(k_flip, 0.5, (n,))
+    x = jnp.where(flip[:, None, None], x[:, :, ::-1], x)
+    if max_rotate_deg or scale_jitter:
+        ang = jax.random.uniform(k_rot, (n,), minval=-max_rotate_deg,
+                                 maxval=max_rotate_deg) * (jnp.pi / 180.0)
+        sc = jax.random.uniform(k_sc, (n,), minval=1.0 - scale_jitter,
+                                maxval=1.0 + scale_jitter)
+        cy0, cx0 = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = jnp.mgrid[0:h, 0:w]
+
+        def _warp(img, a, s):
+            cos_a, sin_a = jnp.cos(a), jnp.sin(a)
+            y0 = yy - cy0
+            x0 = xx - cx0
+            ys = (cos_a * y0 + sin_a * x0) / s + cy0
+            xs = (-sin_a * y0 + cos_a * x0) / s + cx0
+            return map_coordinates(img, [ys, xs], order=1, mode="nearest")
+
+        x = jax.vmap(_warp)(x, ang, sc)
+    pad = max_shift
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad)), mode="edge")
+    oy = jax.random.randint(k_oy, (n,), 0, 2 * pad + 1)
+    ox = jax.random.randint(k_ox, (n,), 0, 2 * pad + 1)
+    x = jax.vmap(
+        lambda img, a, b: jax.lax.dynamic_slice(img, (a, b), (h, w))
+    )(xp, oy, ox)
+    apply = jax.random.bernoulli(k_app, occlusion_p, (n,))
+    oh = jax.random.randint(k_oh, (n,), h // 5, h // 2)
+    ow = jax.random.randint(k_ow, (n,), w // 5, w // 2)
+    cy = jax.random.randint(k_cy, (n,), 0, h)
+    cx = jax.random.randint(k_cx, (n,), 0, w)
+    yy = jnp.arange(h)[None, :, None]
+    xx = jnp.arange(w)[None, None, :]
+    box = ((yy >= cy[:, None, None]) & (yy < (cy + oh)[:, None, None])
+           & (xx >= cx[:, None, None]) & (xx < (cx + ow)[:, None, None]))
+    # mean fill (inputs are per-image standardized, so 0 == the mean)
+    return jnp.where(box & apply[:, None, None], 0.0, x)
+
+
+def make_train_step(model: FaceEmbedNet, optimizer, margin: float = 0.5,
+                    scale: float = 32.0, augment: bool = False):
+    """Returns a jitted (params, opt_state, batch_x, batch_y, key,
+    margin_scale) -> updated step; ``augment`` applies ``augment_batch``
+    in-graph; ``margin_scale`` (traced f32 in [0, 1]) ramps the angular
+    margin so hard distributions don't collapse at cold start."""
 
     @jax.jit
-    def step(params, opt_state, x, y):
+    def step(params, opt_state, x, y, key, margin_scale):
+        if augment:
+            x = augment_batch(key, x)
+
         def loss_fn(p):
             emb = model.apply({"params": p["net"]}, x)
-            return arcface_loss(emb, y, p["head"], margin, scale)
+            return arcface_loss(emb, y, p["head"], margin * margin_scale, scale)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -185,20 +246,34 @@ def train_embedder(
     margin: float = 0.5,
     scale: float = 32.0,
     seed: int = 0,
+    augment: bool = False,
+    lr_schedule: str = "constant",
     log_every: int = 0,
 ) -> Dict[str, Any]:
-    """Host loop of jitted ArcFace steps over shuffled fixed-size batches."""
-    optimizer = optax.adam(learning_rate)
+    """Host loop of jitted ArcFace steps over shuffled fixed-size batches.
+
+    ``lr_schedule="cosine"`` decays to lr/100 over ``steps`` — the standard
+    recipe once augmentation makes long runs productive."""
+    if lr_schedule == "cosine":
+        sched = optax.cosine_decay_schedule(learning_rate, steps, alpha=0.01)
+        optimizer = optax.adam(sched)
+    else:
+        optimizer = optax.adam(learning_rate)
     opt_state = optimizer.init(params)
-    step = make_train_step(model, optimizer, margin, scale)
+    step = make_train_step(model, optimizer, margin, scale, augment=augment)
     x = jnp.asarray(images, dtype=jnp.float32)
     y = jnp.asarray(labels, dtype=jnp.int32)
     n = x.shape[0]
     batch_size = min(batch_size, n)
     rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    warmup = max(1, int(0.1 * steps))  # margin ramp: 0 -> full over 10%
     for i in range(steps):
         idx = jnp.asarray(rng.choice(n, size=batch_size, replace=n < batch_size))
-        params, opt_state, loss = step(params, opt_state, x[idx], y[idx])
+        key, sub = jax.random.split(key)
+        mscale = jnp.float32(min(1.0, i / warmup))
+        params, opt_state, loss = step(params, opt_state, x[idx], y[idx],
+                                       sub, mscale)
         if log_every and (i + 1) % log_every == 0:
             print(f"  arcface step {i + 1}/{steps}: loss {float(loss):.4f}")
     return params
@@ -236,6 +311,9 @@ class CNNEmbedding(AbstractFeature):
         batch_size: int = 64,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        augment: bool = False,
+        lr_schedule: str = "constant",
+        tta: bool = False,
     ):
         self.embed_dim = int(embed_dim)
         self.input_size = tuple(int(v) for v in input_size)
@@ -247,6 +325,9 @@ class CNNEmbedding(AbstractFeature):
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
         self.seed = int(seed)
+        self.augment = bool(augment)
+        self.lr_schedule = str(lr_schedule)
+        self.tta = bool(tta)
         self.net = FaceEmbedNet(
             embed_dim=self.embed_dim,
             stem_features=self.stem_features,
@@ -286,6 +367,7 @@ class CNNEmbedding(AbstractFeature):
                 self.net, params, x, y,
                 steps=self.train_steps, batch_size=self.batch_size,
                 learning_rate=self.learning_rate, seed=self.seed,
+                augment=self.augment, lr_schedule=self.lr_schedule,
             )
         self._params = params
         return self._extract_batch(jnp.asarray(X, jnp.float32))
@@ -294,7 +376,15 @@ class CNNEmbedding(AbstractFeature):
         if self._params is None:
             raise RuntimeError("CNNEmbedding.extract called before compute()")
         x = normalize_faces(X, self.input_size)
-        return self._apply(self._params["net"], x)
+        emb = self._apply(self._params["net"], x)
+        if self.tta:
+            # Flip test-time augmentation (standard verification practice):
+            # average the embedding with the mirrored view's, re-normalize.
+            emb_f = self._apply(self._params["net"], x[:, :, ::-1])
+            emb = emb + emb_f
+            emb = emb / jnp.maximum(
+                jnp.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+        return emb
 
     def load_params(self, params: Dict[str, Any]) -> None:
         """Install pretrained {net, head} params (skips/limits training)."""
@@ -313,6 +403,9 @@ class CNNEmbedding(AbstractFeature):
             "batch_size": self.batch_size,
             "learning_rate": self.learning_rate,
             "seed": self.seed,
+            "augment": self.augment,
+            "lr_schedule": self.lr_schedule,
+            "tta": self.tta,
         }
 
     @classmethod
@@ -322,6 +415,9 @@ class CNNEmbedding(AbstractFeature):
         config["stage_features"] = tuple(config.get("stage_features", (64, 128, 128)))
         config["stage_blocks"] = tuple(config.get("stage_blocks", (2, 2, 2)))
         config.setdefault("block", "separable")  # pre-r3 checkpoints
+        config.setdefault("augment", False)
+        config.setdefault("lr_schedule", "constant")
+        config.setdefault("tta", False)
         return cls(**config)
 
     def get_state(self):

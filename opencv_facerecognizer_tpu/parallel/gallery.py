@@ -77,6 +77,13 @@ def match_global(q, g, valid, labels, *, k: int, mesh: Mesh):
     )  # [Q, C]
     sims = jnp.where(valid[None, :], sims, NEG_INF)
     qn = sims.shape[0]
+    if tp == 1:
+        # Singleton tp: the two-phase split is identical math but the
+        # reshape + sharding constraint break XLA's matmul->top_k fusion
+        # (measured on v5e: 2.40 vs 1.00 ms/batch for the whole fused
+        # serving step at 16k rows) — take the direct top_k.
+        top_vals, top_gidx = jax.lax.top_k(sims, min(k, cap))
+        return jnp.take(labels, top_gidx), top_vals, top_gidx
     # Phase 1: per-chunk top-k, chunk == tp shard (the constraint pins the
     # reshape to be shard-local).
     s3 = sims.reshape(qn, tp, chunk)

@@ -74,7 +74,7 @@ def cnn_verification():
 
     size = (64, 64)
     X_tr, y_tr, _ = make_synthetic_faces(
-        num_subjects=200, per_subject=10, size=size, seed=11, noise=10.0,
+        num_subjects=300, per_subject=12, size=size, seed=11, noise=10.0,
         **HARD_WILD,
     )
     # Held-out identities: disjoint seed -> disjoint subject structures.
@@ -82,13 +82,16 @@ def cnn_verification():
         num_subjects=48, per_subject=12, size=size, seed=77, noise=10.0,
         **HARD_WILD,
     )
-    # Round-2 config (wider net, selected by measurement) rescaled for the
-    # hard protocol: 200 train identities and pose/occlusion augmentation
-    # inherent in the training set need more optimization steps.
+    # Hard-protocol config: without train-time augmentation the round-2 net
+    # measured 0.9342 here (2000 steps) — the 10 fixed views per identity
+    # cannot teach occlusion/pose invariance. augment=True turns on the
+    # in-graph flip/shift/cutout pipe (models.embedder.augment_batch), with
+    # a cosine decay over a longer run and a wider trunk.
     emb = CNNEmbedding(
-        embed_dim=128, input_size=size, stem_features=24,
-        stage_features=(48, 96), stage_blocks=(2, 2),
-        train_steps=2000, batch_size=64, learning_rate=2e-3, seed=3,
+        embed_dim=256, input_size=size, stem_features=32,
+        stage_features=(64, 128, 256), stage_blocks=(2, 2, 2),
+        train_steps=9000, batch_size=128, learning_rate=2e-3, seed=3,
+        augment=True, lr_schedule="cosine", tta=True,
     )
     t0 = time.perf_counter()
     emb.compute(X_tr, y_tr)
@@ -100,10 +103,12 @@ def cnn_verification():
         "accuracy": round(acc, 4), "std": round(std, 4),
         "threshold": round(thr, 3),
         "dataset": "synthetic verification, HARD protocol (rot 12deg, "
-                   "scale 0.12, elastic 1.8px, occlusion p=0.3): train 200 "
-                   "identities x10, eval 48 disjoint x12, 6000 pairs, "
-                   "10-fold; embed_dim=128, stages 48/96, 2000 steps — "
-                   "vs the >=0.99 north star (BASELINE.json:5)",
+                   "scale 0.12, elastic 1.8px, occlusion p=0.3): train 300 "
+                   "identities x12, eval 48 disjoint x12, 6000 pairs, "
+                   "10-fold; embed_dim=256, stages 64/128/256, 9000 steps "
+                   "batch 128, in-graph flip/rot/scale/shift/cutout "
+                   "augmentation, cosine lr, flip-TTA — vs the >=0.99 "
+                   "north star (BASELINE.json:5)",
         "seconds": round(train_s, 1),
     }
 

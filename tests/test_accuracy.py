@@ -11,10 +11,13 @@ Two layers of guard:
    the CPU suite so an algorithmic regression (PCA/LDA/LBP/k-NN math) fails
    fast here, without waiting for the next full measurement.
 
-Bands leave margin below the measured values (BASELINE.md: eigenfaces
-0.9575, fisherfaces 0.9717 with the sigma=2/4 TanTriggs default, lbph
-0.9719 with the radius-2 default, cnn 0.9990 with the widened net) to
-absorb seed/backend jitter while still catching real regressions.
+Bands sit ~3 points below the round-3 HARD-protocol measurements
+(BASELINE.md, 2026-07-30: pose rotation + scale jitter + elastic
+deformation + occlusion on every config — see scripts/measure_accuracy.py
+HARD_POSE/HARD_WILD): eigenfaces 0.895, fisherfaces 0.8283, lbph 0.925,
+cnn 0.9937 (300 train identities, in-graph augmentation, flip-TTA). The
+classics drop honestly under occlusion/pose — linear templates cannot
+model either — while the CNN band stays pinned at the >=0.99 north star.
 """
 
 import os
@@ -28,14 +31,16 @@ from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# config key -> (BASELINE.md row label prefix, minimum acceptable accuracy)
+# config key -> (BASELINE.md row label prefix, minimum acceptable accuracy);
+# ~3 points under the hard-protocol measurement (round-2 verdict: the old
+# 7-10-point slack let real regressions pass silently)
 MEASURED_BANDS = {
-    "eigenfaces": ("Eigenfaces", 0.90),
-    "fisherfaces": ("Fisherfaces", 0.85),  # sigma-2/4 TT measured 0.9717; 0.8117 was sigma-1/2
-    "lbph": ("LBPH", 0.85),  # radius-2 default measured 0.95+; 0.525 was radius-1
+    "eigenfaces": ("Eigenfaces", 0.86),  # hard protocol measured 0.895
+    "fisherfaces": ("Fisherfaces", 0.80),  # hard protocol measured 0.8283
+    "lbph": ("LBPH", 0.89),  # hard protocol measured 0.925
     # band == the north star: a recorded measurement below >=0.99 must fail
-    # even if it's otherwise plausible (measured 0.9990 +/- 0.0015, ~6 std
-    # of margin above the band)
+    # even if it's otherwise plausible (hard protocol measured 0.9937
+    # +/- 0.0036 with augmentation + TTA)
     "cnn": ("CNN ArcFace", 0.99),
 }
 

@@ -133,3 +133,71 @@ def test_verification_accuracy_random_embeddings_near_chance():
 def test_verification_pairs_requires_multi_sample_classes():
     with pytest.raises(ValueError):
         make_verification_pairs(np.arange(10), num_pairs=10)
+
+
+def test_augment_batch_shapes_and_determinism():
+    """In-graph augmentation: shape-preserving, deterministic per key,
+    different across keys, and the cutout fills with the (standardized)
+    mean rather than wrapping values."""
+    import jax
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.embedder import augment_batch
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(6, 32, 32)).astype(np.float32))
+    k = jax.random.PRNGKey(1)
+    a1 = np.asarray(augment_batch(k, x))
+    a2 = np.asarray(augment_batch(k, x))
+    a3 = np.asarray(augment_batch(jax.random.PRNGKey(2), x))
+    assert a1.shape == (6, 32, 32)
+    np.testing.assert_array_equal(a1, a2)  # same key -> same augmentation
+    assert np.abs(a1 - a3).max() > 1e-3  # different key -> different
+    assert np.isfinite(a1).all()
+
+
+def test_tta_extract_matches_flip_average():
+    """tta=True must return the re-normalized average of the plain and
+    mirrored embeddings — and stay unit-norm."""
+    from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+    X, y, _ = make_synthetic_faces(num_subjects=4, per_subject=4, size=(32, 32),
+                                   seed=5)
+    emb = CNNEmbedding(embed_dim=16, input_size=(32, 32), stem_features=8,
+                       stage_features=(8, 16), stage_blocks=(1, 1),
+                       train_steps=0, tta=True)
+    emb.compute(X, y)
+    e_tta = np.asarray(emb._extract_batch(np.asarray(X[:4], np.float32)))
+    np.testing.assert_allclose(np.linalg.norm(e_tta, axis=-1), 1.0, atol=1e-5)
+    emb.tta = False
+    e_plain = np.asarray(emb._extract_batch(np.asarray(X[:4], np.float32)))
+    e_flip = np.asarray(emb._extract_batch(
+        np.asarray(X[:4], np.float32)[:, :, ::-1]))
+    want = e_plain + e_flip
+    want /= np.linalg.norm(want, axis=-1, keepdims=True)
+    np.testing.assert_allclose(e_tta, want, atol=1e-4)
+
+
+def test_augmented_training_runs_and_improves_separation():
+    """augment=True + cosine schedule must train end-to-end (the jitted
+    step now consumes a PRNG key) and still separate classes."""
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder, normalize_faces, train_embedder)
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+    X, y, _ = make_synthetic_faces(num_subjects=4, per_subject=6, size=(32, 32),
+                                   seed=7)
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8, 16),
+                       stage_blocks=(1, 1))
+    params = init_embedder(net, num_classes=4, input_shape=(32, 32), seed=0)
+    xn = np.asarray(normalize_faces(np.asarray(X, np.float32), (32, 32)))
+    params = train_embedder(net, params, xn, y, steps=60, batch_size=16,
+                            augment=True, lr_schedule="cosine", seed=0)
+    e = np.asarray(net.apply({"params": params["net"]}, jnp.asarray(xn)))
+    sims = e @ e.T
+    same = y[:, None] == y[None, :]
+    off_diag = ~np.eye(len(y), dtype=bool)
+    assert sims[same & off_diag].mean() > sims[~same].mean() + 0.1
