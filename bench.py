@@ -260,9 +260,10 @@ def main():
         t1s, t2s, k2_used, mean_s = measure_chained_retrying(timed_chain)
         if mean_s is None:
             detail["sweep"][str(batch)]["device_compute"] = {
-                "invalid": "min(T(K2)) - min(T(K1)) non-positive over "
-                           f"{MEASURE_PAIRS} repeats (dispatch jitter "
-                           "exceeded chain delta); no number recorded",
+                "invalid": "chain delta min(T(K2)) - min(T(K1)) never "
+                           f"cleared MIN_DELTA_S over {MEASURE_PAIRS} "
+                           "repeats (non-positive or under-resolved vs "
+                           "readback quantization); no number recorded",
                 "t_k1_samples_s": [round(t, 4) for t in t1s],
                 "t_k2_samples_s": [round(t, 4) for t in t2s],
             }
@@ -477,7 +478,8 @@ def main():
             t1s, t2s, k2_used, mean_s = measure_chained_retrying(timed_chain)
             if mean_s is None:
                 row[name] = {
-                    "invalid": "min-diff non-positive (dispatch jitter)",
+                    "invalid": "chain delta never cleared MIN_DELTA_S "
+                               "(non-positive or under-resolved)",
                     "t_k1_samples_s": [round(t, 4) for t in t1s],
                     "t_k2_samples_s": [round(t, 4) for t in t2s],
                 }

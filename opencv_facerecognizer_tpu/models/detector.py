@@ -146,6 +146,16 @@ def decode_detections(
         return nms_ops.nms_fixed(b, s, max_faces, iou_threshold, score_threshold)
 
     boxes, scores, valid = jax.vmap(per_image)(boxes, scores)
+    # Clamp to the decoded canvas: cy +/- bh/2 freely projects past the
+    # edge for border faces, and every consumer (serving pipeline included
+    # — this is the shared decode) expects in-frame pixel boxes. Bounds are
+    # EXCLUSIVE yxyx (y1 == H is a legal bottom-edge box, matching dataset
+    # targets and crop slicing). Invalid slots are zero boxes, unaffected.
+    # detect_batch additionally clips to the caller's pre-padding extent.
+    lim = jnp.asarray(
+        [hs * STRIDE, ws * STRIDE, hs * STRIDE, ws * STRIDE], boxes.dtype
+    )
+    boxes = jnp.clip(boxes, 0.0, lim)
     return boxes, scores, valid
 
 
@@ -422,7 +432,14 @@ class CNNFaceDetector:
         ph, pw = (-h) % STRIDE, (-w) % STRIDE
         if ph or pw:
             images = jnp.pad(images, ((0, 0), (0, ph), (0, pw)), mode="edge")
-        return self._detect_jit(self._params, images)
+        boxes, scores, valid = self._detect_jit(self._params, images)
+        # Decode clamps to its (possibly padded) canvas; additionally clip
+        # to the CALLER's pre-padding extent so border faces never report
+        # coordinates inside the padding strip. Bounds are exclusive yxyx
+        # (y1 == h is a legal bottom-edge box).
+        lim = jnp.asarray([h, w, h, w], boxes.dtype)
+        boxes = jnp.clip(boxes, 0.0, lim)
+        return boxes, scores, valid
 
     def detect(self, img: np.ndarray):
         """Single grayscale image -> [(x0, y0, x1, y1)] like the reference's

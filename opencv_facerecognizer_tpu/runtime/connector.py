@@ -378,7 +378,13 @@ class SocketConnector(_TopicDispatchConnector):
                     # publisher that already snapshotted this sock must get
                     # an immediate OSError, not append its line after our
                     # truncated one (spliced JSON frames on the wire).
-                    # Closing also unblocks the socket's read loop.
+                    # shutdown() first: close() alone does not interrupt a
+                    # thread parked in recv() on Linux, so the read loop
+                    # would stay blocked until the peer acts.
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
                     try:
                         sock.close()
                     except OSError:

@@ -107,6 +107,14 @@ def main():
 
     def steady(tag):
         ms = chained_ms_per_batch(pipeline, frames_stack)
+        if ms is None:  # chain delta never cleared readback quantization
+            result["stages"].append({
+                "rows": gallery.size, "capacity": gallery.capacity,
+                "pallas": gallery._pallas_enabled(),
+                "steady_ms_per_batch": None, "invalid": "under-resolved",
+            })
+            _log(f"[{tag}] UNRESOLVED steady timing")
+            return
         result["stages"].append({
             "rows": gallery.size, "capacity": gallery.capacity,
             "pallas": gallery._pallas_enabled(),

@@ -80,6 +80,19 @@ def detector_variants():
         ms = chained_ms(fwd, (det.params, frames))
         n_params = sum(int(np.prod(p.shape)) for p in
                        __import__("jax").tree_util.tree_leaves(det.params))
+        if ms is None:  # chain delta never cleared readback quantization
+            # Quality/train columns stay: they are valid regardless of the
+            # timing outcome.
+            rows[name] = {
+                "ms_per_batch32_fwd": None, "invalid": "under-resolved",
+                "recall": round(quality["recall"], 4),
+                "precision": round(quality["precision"], 4),
+                "mean_iou": round(quality["mean_matched_iou"], 3),
+                "params": n_params,
+                "train_s": round(train_s, 1),
+            }
+            _log(f"[det {name}] UNRESOLVED timing ({n_params} params)")
+            continue
         rows[name] = {
             "ms_per_batch32_fwd": round(ms, 3),
             "recall": round(quality["recall"], 4),
@@ -130,6 +143,11 @@ def embedder_variants():
         ms = chained_ms(fwd, (params, frames))
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree_util.tree_leaves(params))
+        if ms is None:  # chain delta never cleared readback quantization
+            rows[name] = {"ms_per_256crops_fwd": None,
+                          "invalid": "under-resolved", "params": n_params}
+            _log(f"[emb {name}] UNRESOLVED timing ({n_params} params)")
+            continue
         rows[name] = {"ms_per_256crops_fwd": round(ms, 3), "params": n_params}
         _log(f"[emb {name}] {ms:.3f} ms/256 crops ({n_params} params)")
     return rows

@@ -96,3 +96,24 @@ def test_detect_before_train_raises():
     det = CNNFaceDetector()
     with pytest.raises(RuntimeError):
         det.detect(np.zeros((64, 64), dtype=np.float32))
+
+
+def test_detect_batch_clips_boxes_to_unpadded_extent():
+    """Non-multiple-of-8 inputs are edge-padded before decode; the returned
+    boxes must still live inside the CALLER's (h, w), not the padded canvas
+    (a border face could otherwise report coords up to STRIDE-1 px out)."""
+    import jax
+
+    det = CNNFaceDetector(features=(8, 8), max_faces=4, space_to_depth=2,
+                          score_threshold=0.0)
+    params = det.net.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64)))["params"]
+    det.load_params(params)
+    h, w = 67, 70  # pads to 72x72
+    boxes, scores, valid = det.detect_batch(
+        jnp.asarray(np.random.default_rng(0).uniform(0, 255, (2, h, w)),
+                    jnp.float32))
+    b = np.asarray(boxes)
+    assert b.shape == (2, 4, 4)
+    # exclusive yxyx bounds: y1 == h / x1 == w are legal edge boxes
+    assert (b[..., [0, 2]] <= h).all() and (b[..., [1, 3]] <= w).all()
+    assert (b >= 0).all()
