@@ -53,10 +53,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--flush-ms", type=float, default=30.0)
     p.add_argument("--transfer-uint8", action="store_true",
                    help="buffer and ship frames host->device as uint8 "
-                        "(4x less transfer; cast to f32 happens on device "
-                        "— right for 8-bit camera sources)")
+                        "(4x less transfer volume; cast to f32 happens on "
+                        "device). Measured crossover (BENCH_DETAIL.json "
+                        "sweep): a clear win at batch >= 128; at batch "
+                        "<= 32 on a tunneled/high-latency link the extra "
+                        "transfer's per-request floor can cost more than "
+                        "the bytes save (batch-8 p99 measured ~109 ms vs "
+                        "f32's sub-ms) — pick by measurement on your link")
     p.add_argument("--similarity-threshold", type=float, default=0.3)
     p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
+    p.add_argument("--async-grow", action="store_true",
+                   help="gallery auto-grow compiles + installs the next "
+                        "tier on a background thread: overflowing "
+                        "enrolments return immediately and become "
+                        "matchable seconds later, instead of stalling the "
+                        "serving loop for the XLA recompile")
     p.add_argument("--metrics-jsonl", help="append per-batch metrics to this file")
     return p
 
@@ -106,7 +117,8 @@ def _load_stack(args):
         gallery_mesh = make_mesh()
 
     gallery = ShardedGallery(capacity=max(args.capacity, 2 * len(emb)),
-                             dim=emb.shape[1], mesh=gallery_mesh)
+                             dim=emb.shape[1], mesh=gallery_mesh,
+                             async_grow=args.async_grow)
     gallery.add(emb, labels)
     if mesh_a is not None:
         from opencv_facerecognizer_tpu.parallel import TwoStagePipeline

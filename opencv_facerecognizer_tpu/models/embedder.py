@@ -33,10 +33,19 @@ from opencv_facerecognizer_tpu.ops import image as image_ops
 
 
 class _SepBlock(nn.Module):
-    """Depthwise-separable conv block with optional stride + residual."""
+    """Depthwise-separable conv block with optional stride + residual.
+
+    ``norm="light"`` drops the GroupNorm between the depthwise and
+    pointwise convs (keeping the ReLU): each GroupNorm is a cross-channel
+    reduction the VPU runs between MXU calls, and at 2 per block they are
+    pure inter-matmul stall time. Measured (scripts/explore_perf.py r4):
+    the light scheme is what lifted the separable net's MFU; training
+    stability is covered by the remaining per-block GroupNorm.
+    """
 
     features: int
     stride: int = 1
+    norm: str = "full"
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -47,7 +56,8 @@ class _SepBlock(nn.Module):
             ch, (3, 3), strides=(self.stride, self.stride),
             feature_group_count=ch, use_bias=False, dtype=self.dtype,
         )(x)
-        x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
+        if self.norm == "full":
+            x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.Conv(self.features, (1, 1), use_bias=False, dtype=self.dtype)(x)
         x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
@@ -66,6 +76,7 @@ class _DenseBlock(nn.Module):
 
     features: int
     stride: int = 1
+    norm: str = "full"  # dense blocks have one norm either way
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -87,6 +98,16 @@ class FaceEmbedNet(nn.Module):
     for one v5e chip at batch 256; tests use a tiny variant. ``block``
     picks the stage op: "separable" (depthwise+pointwise, fewer FLOPs,
     VPU-heavy) or "dense" (plain 3x3 convs, MXU-native).
+
+    ``space_to_depth`` folds an s x s pixel block into s^2 input channels
+    before the stem conv (lossless) — the same MXU-starving-stem fix the
+    detector uses (detector.py:46-50): a 1-input-channel conv at 112x112
+    feeds the 128-lane systolic array 9 rows of work per tile. The net's
+    TOTAL downsample (2^(1 + len(stages))) is preserved: stem/stage
+    strides drop to 1 once the folding already covered them, so the final
+    spatial extent (and the GDC kernel) is identical for every setting.
+    ``norm`` ("full" | "light") picks the per-block norm scheme (see
+    ``_SepBlock``).
     """
 
     embed_dim: int = 128
@@ -94,6 +115,8 @@ class FaceEmbedNet(nn.Module):
     stage_features: Sequence[int] = (64, 128, 128)
     stage_blocks: Sequence[int] = (2, 2, 2)
     block: str = "separable"
+    space_to_depth: int = 1
+    norm: str = "full"
     dtype: Any = jnp.bfloat16
 
     @nn.compact
@@ -102,15 +125,39 @@ class FaceEmbedNet(nn.Module):
         if x.ndim == 3:
             x = x[..., None]
         x = x.astype(self.dtype)
-        x = nn.Conv(self.stem_features, (3, 3), strides=(2, 2), use_bias=False,
+        total_stride = 2 ** (1 + len(self.stage_features))
+        s = int(self.space_to_depth)
+        if s > 1:
+            if total_stride % s:
+                raise ValueError(
+                    f"space_to_depth={s} must divide the net's total "
+                    f"downsample {total_stride}"
+                )
+            n, h, w, c = x.shape
+            if h % s or w % s:
+                raise ValueError(
+                    f"input {h}x{w} not divisible by space_to_depth={s}"
+                )
+            x = x.reshape(n, h // s, s, w // s, s, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // s, w // s, s * s * c)
+        remaining = total_stride // s
+        accum = 1
+        stem_stride = 2 if accum < remaining else 1
+        accum *= stem_stride
+        x = nn.Conv(self.stem_features, (3, 3),
+                    strides=(stem_stride, stem_stride), use_bias=False,
                     dtype=self.dtype)(x)
         x = nn.GroupNorm(num_groups=4, dtype=self.dtype)(x)
         x = nn.relu(x)
         block_cls = {"separable": _SepBlock, "dense": _DenseBlock}[self.block]
         for feats, blocks in zip(self.stage_features, self.stage_blocks):
-            x = block_cls(feats, stride=2, dtype=self.dtype)(x)
+            stride = 2 if accum < remaining else 1
+            accum *= stride
+            x = block_cls(feats, stride=stride, norm=self.norm,
+                          dtype=self.dtype)(x)
             for _ in range(blocks - 1):
-                x = block_cls(feats, stride=1, dtype=self.dtype)(x)
+                x = block_cls(feats, stride=1, norm=self.norm,
+                              dtype=self.dtype)(x)
         # Global depthwise conv (GDC): one weight per spatial position/channel.
         h, w, c = x.shape[1], x.shape[2], x.shape[3]
         x = nn.Conv(c, (h, w), padding="VALID", feature_group_count=c,
@@ -307,6 +354,8 @@ class CNNEmbedding(AbstractFeature):
         stage_features: Sequence[int] = (64, 128, 128),
         stage_blocks: Sequence[int] = (2, 2, 2),
         block: str = "separable",
+        space_to_depth: int = 1,
+        norm: str = "full",
         train_steps: int = 200,
         batch_size: int = 64,
         learning_rate: float = 1e-3,
@@ -321,6 +370,8 @@ class CNNEmbedding(AbstractFeature):
         self.stage_features = tuple(int(v) for v in stage_features)
         self.stage_blocks = tuple(int(v) for v in stage_blocks)
         self.block = str(block)
+        self.space_to_depth = int(space_to_depth)
+        self.norm = str(norm)
         self.train_steps = int(train_steps)
         self.batch_size = int(batch_size)
         self.learning_rate = float(learning_rate)
@@ -334,6 +385,8 @@ class CNNEmbedding(AbstractFeature):
             stage_features=self.stage_features,
             stage_blocks=self.stage_blocks,
             block=self.block,
+            space_to_depth=self.space_to_depth,
+            norm=self.norm,
         )
         self._params: Optional[Dict[str, Any]] = None
         self._apply = jax.jit(lambda p, x: self.net.apply({"params": p}, x))
@@ -399,6 +452,8 @@ class CNNEmbedding(AbstractFeature):
             "stage_features": list(self.stage_features),
             "stage_blocks": list(self.stage_blocks),
             "block": self.block,
+            "space_to_depth": self.space_to_depth,
+            "norm": self.norm,
             "train_steps": self.train_steps,
             "batch_size": self.batch_size,
             "learning_rate": self.learning_rate,
@@ -415,6 +470,8 @@ class CNNEmbedding(AbstractFeature):
         config["stage_features"] = tuple(config.get("stage_features", (64, 128, 128)))
         config["stage_blocks"] = tuple(config.get("stage_blocks", (2, 2, 2)))
         config.setdefault("block", "separable")  # pre-r3 checkpoints
+        config.setdefault("space_to_depth", 1)  # pre-r4 checkpoints
+        config.setdefault("norm", "full")
         config.setdefault("augment", False)
         config.setdefault("lr_schedule", "constant")
         config.setdefault("tta", False)

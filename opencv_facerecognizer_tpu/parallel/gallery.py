@@ -180,6 +180,11 @@ class ShardedGallery:
     #: 131k rows, 1.73x at 1M; parity/noise at 16k).
     PALLAS_MIN_CAPACITY = 65536
 
+    #: start background-compiling the next tier once fill crosses this
+    #: fraction (async_grow mode), so the eventual grow swaps to an
+    #: already-compiled graph (SURVEY.md §5.3 elastic recovery).
+    PREWARM_FILL_FRACTION = 0.75
+
     def __init__(
         self,
         capacity: int,
@@ -187,6 +192,7 @@ class ShardedGallery:
         mesh: Mesh,
         labels_pad: int = -1,
         use_pallas: Optional[bool] = None,
+        async_grow: bool = False,
     ):
         self.mesh = mesh
         self._use_pallas_cfg = use_pallas
@@ -203,6 +209,30 @@ class ShardedGallery:
         self._host_val = np.zeros((self.capacity,), bool)
         self._write_lock = threading.Lock()
         self.grow_count = 0
+        # ---- async (off-the-serving-path) growth state ----
+        # ``async_grow=True`` turns an overflowing add() into: stage the
+        # rows host-side, compile the next tier's graphs on a background
+        # thread (prewarm_hooks), build + install the grown snapshot there,
+        # publish atomically. Serving threads NEVER pay the XLA recompile;
+        # the cost moves to enrolment-to-matchable latency (observable via
+        # ``pending_rows`` / ``wait_ready``). Default stays synchronous:
+        # enrolment tools that want rows matchable on return keep that
+        # contract.
+        self.async_grow = bool(async_grow)
+        #: callables invoked with the TARGET capacity on the grow worker
+        #: thread BEFORE the grown snapshot is installed — the fused
+        #: pipeline registers its step-compile here (parallel.pipeline).
+        self.prewarm_hooks = []
+        self._pending: list = []  # [(emb_rows, lab_rows)] staged enrolments
+        self._pending_count = 0
+        self._growing = False
+        self._grow_thread: Optional[threading.Thread] = None
+        self._grow_done = threading.Event()
+        self._grow_done.set()
+        self._epoch = 0  # bumped by reset/swap_from to invalidate a grow
+        self._warmed_capacities = set()
+        self._warm_events = {}  # capacity -> Event, set when its warm ends
+        self.last_grow_info: dict = {}
         self._data = GalleryData(
             embeddings=jax.device_put(
                 jnp.zeros((self.capacity, dim), jnp.float32), self._emb_sharding
@@ -243,31 +273,207 @@ class ShardedGallery:
     def add(self, embeddings: np.ndarray, labels: np.ndarray) -> None:
         """Append L2-normalized rows, auto-growing on overflow.
 
-        Growth doubles capacity (tp-aligned) and installs the bigger
-        arrays — the same double-buffered install as ``swap_from``, so
-        serving threads keep matching against the old snapshot until the
-        new one is published. The static-shape change means the matcher
-        (and the fused pipeline step) recompile once on the next call;
-        ``grow_count`` exposes how often that happened so operators can
-        pre-size ``capacity`` instead (a mid-serving XLA compile stalls
-        that batch by seconds on real hardware).
+        Synchronous mode (default): growth doubles capacity (tp-aligned)
+        and installs the bigger arrays before returning — rows are
+        matchable on return, but the static-shape change means the matcher
+        (and the fused pipeline step) recompile once on the next call,
+        stalling that serving batch by seconds on real hardware.
+
+        ``async_grow=True`` (the serving configuration): an overflowing
+        add stages its rows host-side and returns immediately; a
+        background worker compiles the next tier's graphs (via
+        ``prewarm_hooks``), builds the grown snapshot, and publishes it
+        atomically — serving threads never pay the compile, and the rows
+        become matchable when ``wait_ready`` unblocks (``pending_rows``
+        exposes the in-flight count). Additionally, any add that fills the
+        gallery past ``PREWARM_FILL_FRACTION`` kicks the next tier's
+        compile early, so the eventual grow usually only pays the
+        install.
         """
         embeddings = np.asarray(embeddings, np.float32)
         embeddings = embeddings / np.maximum(
             np.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-12
         )
+        labels = np.asarray(labels, np.int32)
         n = embeddings.shape[0]
+        start_worker = False
         with self._write_lock:
             size = self.size
-            if size + n > self.capacity:
-                self._grow_locked(size + n)
-            # Host mirrors are the source of truth for enrolment: a device
-            # readback here would trigger the axon backend's sync-poll mode
-            # (see module docstring of runtime.recognizer).
-            self._host_emb[size : size + n] = embeddings
-            self._host_lab[size : size + n] = np.asarray(labels, np.int32)
-            self._host_val[size : size + n] = True
-            self._install(self._host_emb, self._host_lab, self._host_val, size + n)
+            if self.async_grow and (self._growing or self._pending
+                                    or size + n > self.capacity):
+                # Stage; the worker owns all host-array mutation while a
+                # grow is in flight (a direct write here would race the
+                # worker's copy of the old arrays). Non-empty pending with
+                # no worker means a previous grow FAILED: later adds must
+                # queue behind the stranded rows (enrolment order), and
+                # this add restarts the worker to retry them.
+                self._pending.append((embeddings, labels))
+                self._pending_count += n
+                if not self._growing:
+                    self._growing = True
+                    self._grow_done.clear()
+                    start_worker = True
+            else:
+                if size + n > self.capacity:
+                    self._grow_locked(size + n)
+                # Host mirrors are the source of truth for enrolment: a
+                # device readback here would trigger the axon backend's
+                # sync-poll mode (see runtime.recognizer module docstring).
+                self._host_emb[size : size + n] = embeddings
+                self._host_lab[size : size + n] = labels
+                self._host_val[size : size + n] = True
+                self._install(self._host_emb, self._host_lab, self._host_val,
+                              size + n)
+        if start_worker:
+            self._grow_thread = threading.Thread(
+                target=self._grow_worker, daemon=True, name="gallery-grow"
+            )
+            self._grow_thread.start()
+        elif (self.async_grow and not self._growing
+              and self.size >= self.PREWARM_FILL_FRACTION * self.capacity):
+            # Early warm: compile the next tier while serving continues at
+            # the current one, so the eventual grow swap finds warm caches.
+            self._prewarm_async(self._next_capacity(self.capacity + 1))
+
+    @property
+    def pending_rows(self) -> int:
+        """Rows staged by async grow, not yet matchable."""
+        return self._pending_count
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until the current async grow attempt finishes. On success
+        ``pending_rows == 0`` and the staged rows are matchable; a failed
+        attempt leaves ``pending_rows > 0`` with the exception recorded in
+        ``last_grow_info["error"]`` (the next add() retries the grow)."""
+        return self._grow_done.wait(timeout)
+
+    def _next_capacity(self, needed: int) -> int:
+        tp = self.mesh.shape[TP_AXIS]
+        new_capacity = max(self.capacity, 1)
+        while new_capacity < needed:
+            new_capacity *= 2
+        return int(np.ceil(new_capacity / tp) * tp)
+
+    def _run_prewarm_hooks(self, capacity: int, info: dict) -> None:
+        """Warm one tier exactly once across threads: the first caller
+        (early-warm thread or grow worker) compiles; any concurrent caller
+        for the same tier WAITS on its completion event instead of racing
+        a duplicate compile (duplicate scratch arrays at a 1M-row tier
+        are a device-memory spike, and the grow worker must not install
+        before the compile has landed either way)."""
+        import time as _time
+
+        with self._write_lock:
+            if capacity in self._warmed_capacities:
+                info["prewarm_s"] = 0.0
+                return
+            ev = self._warm_events.get(capacity)
+            if ev is None:
+                ev = self._warm_events[capacity] = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            ev.wait(timeout=600)
+            info["prewarm_s"] = 0.0  # another thread paid for it
+            return
+        t0 = _time.perf_counter()
+        try:
+            for hook in list(self.prewarm_hooks):
+                try:
+                    hook(capacity)
+                except Exception as e:  # serving must survive a failed
+                    # warm: the fallback is the old behavior (compile on
+                    # first call).
+                    info.setdefault("prewarm_errors", []).append(repr(e))
+        finally:
+            with self._write_lock:
+                self._warmed_capacities.add(capacity)
+                self._warm_events.pop(capacity, None)
+            ev.set()
+        info["prewarm_s"] = round(_time.perf_counter() - t0, 3)
+
+    def _prewarm_async(self, capacity: int) -> None:
+        with self._write_lock:
+            started = (capacity in self._warmed_capacities
+                       or capacity in self._warm_events)
+        if started or not self.prewarm_hooks:
+            return
+        threading.Thread(
+            target=self._run_prewarm_hooks, args=(capacity, {}),
+            daemon=True, name="gallery-prewarm",
+        ).start()
+
+    def _grow_worker(self) -> None:
+        """Off-the-serving-path growth: copy -> compile (hooks) -> splice
+        pending -> atomic install. Serving threads keep reading the old
+        snapshot throughout; ``reset``/``swap_from`` bump ``_epoch`` to
+        invalidate an in-flight grow."""
+        import time as _time
+
+        info = {}
+        try:
+            while True:
+                with self._write_lock:
+                    if not self._pending:
+                        self._growing = False
+                        self._grow_done.set()
+                        self.last_grow_info = info
+                        return
+                    epoch = self._epoch
+                    size = self.size
+                    pending_n = self._pending_count
+                    old_emb, old_lab, old_val = (
+                        self._host_emb, self._host_lab, self._host_val,
+                    )
+                    old_cap = self.capacity
+                target = self._next_capacity(size + pending_n)
+                # Compile the new tier's graphs BEFORE taking rows live.
+                self._run_prewarm_hooks(target, info)
+                t0 = _time.perf_counter()
+                emb = np.zeros((target, self.dim), np.float32)
+                lab = np.full((target,), self.labels_pad, np.int32)
+                val = np.zeros((target,), bool)
+                emb[:old_cap] = old_emb
+                lab[:old_cap] = old_lab
+                val[:old_cap] = old_val
+                info["copy_s"] = round(_time.perf_counter() - t0, 3)
+                with self._write_lock:
+                    if self._epoch != epoch:
+                        # reset/swap_from superseded this grow; drop it and
+                        # re-examine what (if anything) is still pending.
+                        continue
+                    # Splice EVERYTHING pending (including adds staged
+                    # while compiling); if late adds overflow the target,
+                    # loop for another round.
+                    fits = []
+                    n_fit = 0
+                    while self._pending:
+                        e_rows, l_rows = self._pending[0]
+                        if size + n_fit + len(e_rows) > target:
+                            break
+                        fits.append((e_rows, l_rows))
+                        n_fit += len(e_rows)
+                        self._pending.pop(0)
+                    pos = size
+                    for e_rows, l_rows in fits:
+                        emb[pos : pos + len(e_rows)] = e_rows
+                        lab[pos : pos + len(e_rows)] = l_rows
+                        val[pos : pos + len(e_rows)] = True
+                        pos += len(e_rows)
+                    self._pending_count -= n_fit
+                    self._host_emb, self._host_lab, self._host_val = emb, lab, val
+                    self.capacity = target
+                    self.grow_count += 1
+                    t0 = _time.perf_counter()
+                    self._install(emb, lab, val, pos)
+                    info["install_s"] = round(_time.perf_counter() - t0, 3)
+        except Exception as e:  # never leave waiters hanging
+            info["error"] = repr(e)
+            with self._write_lock:
+                self._growing = False
+                self._grow_done.set()
+                self.last_grow_info = info
 
     def _grow_locked(self, needed: int) -> None:
         """Double capacity (tp-aligned) until ``needed`` rows fit; caller
@@ -285,11 +491,13 @@ class ShardedGallery:
         val[: self.capacity] = self._host_val
         self._host_emb, self._host_lab, self._host_val = emb, lab, val
         self.capacity = new_capacity
-        self._match_cache.clear()  # compiled for the old static shape
         self.grow_count += 1
 
     def reset(self) -> None:
         with self._write_lock:
+            self._epoch += 1  # invalidate any in-flight async grow
+            self._pending.clear()
+            self._pending_count = 0
             self._host_emb = np.zeros((self.capacity, self.dim), np.float32)
             self._host_lab = np.full((self.capacity,), self.labels_pad, np.int32)
             self._host_val = np.zeros((self.capacity,), bool)
@@ -322,10 +530,11 @@ class ShardedGallery:
         if other.dim != self.dim:
             raise ValueError(f"dim mismatch: {other.dim} != {self.dim}")
         with self._write_lock:
+            self._epoch += 1  # invalidate any in-flight async grow
+            self._pending.clear()
+            self._pending_count = 0
             if other.capacity != self.capacity:
-                # Different static shape: cached matchers no longer apply.
                 self.capacity = other.capacity
-                self._match_cache.clear()
             self._host_emb = other._host_emb
             self._host_lab = other._host_lab
             self._host_val = other._host_val
@@ -335,28 +544,32 @@ class ShardedGallery:
 
     # ---- matching (device-side) ----
 
-    def _pallas_enabled(self) -> bool:
+    def _pallas_enabled(self, capacity: Optional[int] = None) -> bool:
         """Single-device large-gallery fast path: the streaming pallas
         kernel (ops.pallas_match) never materializes [Q, capacity] in HBM.
         Multi-chip stays on the GSPMD formulation — XLA cannot partition a
-        custom call across the tp axis."""
+        custom call across the tp axis. ``capacity`` overrides the current
+        one so prewarm can select for a FUTURE tier."""
         if self._use_pallas_cfg is not None:
             return bool(self._use_pallas_cfg)
         dev = self.mesh.devices.flat[0]
         return (
             self.mesh.size == 1
             and dev.platform == "tpu"
-            and self.capacity >= self.PALLAS_MIN_CAPACITY
+            and (self.capacity if capacity is None else capacity)
+            >= self.PALLAS_MIN_CAPACITY
         )
 
-    def match_fn(self, k: int):
+    def match_fn(self, k: int, capacity: Optional[int] = None):
         """Pure ``(q, emb, valid, labels) -> (labels, sims, idx)`` match
         function with the pallas-vs-GSPMD selection applied — shared by
         ``match()`` and the fused pipeline step (``parallel.pipeline``), so
         every caller of the hot op gets the streaming fast path, not just
         direct ``gallery.match()`` users. Not jitted here: callers inline
-        it into their own jitted graphs."""
-        if self._pallas_enabled():
+        it into their own jitted graphs. ``capacity`` only influences the
+        selection (the fn itself is shape-polymorphic) — prewarm passes
+        the future tier's."""
+        if self._pallas_enabled(capacity):
             from opencv_facerecognizer_tpu.ops.pallas_match import (
                 streaming_match_topk,
             )
@@ -374,7 +587,12 @@ class ShardedGallery:
         return functools.partial(match_global, k=k, mesh=self.mesh)
 
     def _matcher(self, k: int):
-        if k not in self._match_cache:
+        # Keyed by (k, capacity/pallas): a grow changes the static gallery
+        # shape, but the old tier's compiled matcher stays valid for any
+        # in-flight readers and the new tier gets its own entry (no
+        # clear() — prewarmed entries survive the swap).
+        key = (k, self.capacity, self._pallas_enabled())
+        if key not in self._match_cache:
             if self._pallas_enabled():
                 fn = jax.jit(self.match_fn(k))
             else:
@@ -387,8 +605,8 @@ class ShardedGallery:
                         self._lab_sharding,
                     ),
                 )
-            self._match_cache[k] = fn
-        return self._match_cache[k]
+            self._match_cache[key] = fn
+        return self._match_cache[key]
 
     def match(self, queries: jnp.ndarray, k: int = 1):
         """[Q, D] L2-normalized queries -> (labels [Q, k], cosine sims [Q, k],

@@ -15,7 +15,7 @@ inserts the collectives; nothing here names a wire protocol.
 
 from __future__ import annotations
 
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -84,10 +84,18 @@ class RecognitionPipeline:
         self.gallery = gallery
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
-        self._step_cache: Dict[Tuple[int, int, int], Any] = {}
-        self._packed_cache: Dict[Tuple[int, int, int], Any] = {}
+        # keyed by _step_key: (batch, h, w, dtype_str, capacity, pallas)
+        self._step_cache: Dict[Tuple, Any] = {}
+        self._packed_cache: Dict[Tuple, Any] = {}
+        # Register with the gallery's async-grow machinery: when a grow is
+        # imminent/in flight, the worker thread compiles THIS pipeline's
+        # step for the target capacity before the swap is published, so
+        # the serving thread's first call at the new tier finds a warm
+        # cache instead of paying the XLA recompile (SURVEY.md §5.3).
+        gallery.prewarm_hooks.append(self.prewarm_capacity)
 
-    def _build_step(self, batch: int, height: int, width: int):
+    def _build_step(self, batch: int, height: int, width: int,
+                    capacity: Optional[int] = None):
         mesh = self.gallery.mesh
         det = self.detector
         k = self.top_k
@@ -96,8 +104,9 @@ class RecognitionPipeline:
         max_faces = det.max_faces
         # The gallery owns matcher selection (pallas streaming vs GSPMD
         # global view) — the fused step inherits whichever fits the mesh
-        # and capacity; _step_key re-selects if the gallery grows.
-        match = self.gallery.match_fn(k)
+        # and capacity; _step_key re-selects if the gallery grows, and
+        # prewarm passes the FUTURE capacity explicitly.
+        match = self.gallery.match_fn(k, capacity)
 
         def step(det_params, emb_params, gallery_emb, gallery_valid, gallery_labels, frames):
             # Camera frames ride host->device as uint8 when the caller has
@@ -193,3 +202,56 @@ class RecognitionPipeline:
             data.labels,
             frames,
         )
+
+    def prewarm_capacity(self, capacity: int) -> None:
+        """Compile this pipeline's step(s) for a FUTURE gallery capacity.
+
+        Called on the gallery's grow-worker thread (never the serving
+        thread) for every frame-shape/dtype the pipeline has already
+        served. Compilation is forced by executing each newly built step
+        once against zero-filled scratch gallery arrays of the target
+        tier; the jit executable lands in the same function caches the
+        serving thread will hit after the swap (``_step_key`` includes
+        capacity + matcher selection, so the entries are keyed exactly as
+        the post-grow lookups). Scratch arrays are dropped afterwards —
+        only the compiled executables persist.
+        """
+        g = self.gallery
+        pallas = g._pallas_enabled(capacity)
+        served = {
+            (key[0], key[1], key[2], key[3])
+            for key in list(self._packed_cache) + list(self._step_cache)
+        }
+        if not served:
+            return
+        scratch_emb = jax.device_put(
+            jnp.zeros((capacity, g.dim), jnp.float32), g._emb_sharding
+        )
+        scratch_lab = jax.device_put(
+            jnp.full((capacity,), g.labels_pad, jnp.int32), g._lab_sharding
+        )
+        scratch_val = jax.device_put(
+            jnp.zeros((capacity,), bool), g._valid_sharding
+        )
+        for batch, height, width, dtype in served:
+            new_key = (batch, height, width, dtype, capacity, pallas)
+            if new_key in self._packed_cache:
+                continue
+            step = self._step_cache.get(new_key)
+            if step is None:
+                step = self._build_step(batch, height, width, capacity)
+                self._step_cache[new_key] = step
+
+            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr,
+                            _step=step):
+                return pack_result(_step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
+
+            packed = jax.jit(packed_step)
+            frames = jnp.zeros((batch, height, width), dtype=dtype)
+            # Execute once: jit compiles per concrete shape; block so the
+            # caller (grow worker) only installs AFTER the compile landed.
+            packed(
+                self.detector.params, self.embed_params,
+                scratch_emb, scratch_val, scratch_lab, frames,
+            ).block_until_ready()
+            self._packed_cache[new_key] = packed

@@ -135,7 +135,57 @@ class TwoStagePipeline:
             detector.params, NamedSharding(mesh_a, P())
         )
         self._b_cache: Dict[Any, Any] = {}
+        self._served_crop_shapes = set()
         self._pack = jax.jit(pack_result)  # once: serving hot-loop path
+        # Same off-the-serving-path warm contract as RecognitionPipeline:
+        # the gallery's grow worker compiles stage B for the target tier
+        # before publishing the swap.
+        gallery.prewarm_hooks.append(self.prewarm_capacity)
+
+    def prewarm_capacity(self, capacity: int) -> None:
+        """Compile stage B for a FUTURE gallery capacity (grow-worker
+        thread): build the stage-B jit for the target (capacity, pallas)
+        key and force its compile with zero-filled scratch arrays at every
+        crop shape already served."""
+        g = self.gallery
+        key = (capacity, g._pallas_enabled(capacity))
+        if key in self._b_cache:
+            fn = self._b_cache[key]
+        else:
+            match = g.match_fn(self.top_k, capacity)
+            embed_net = self.embed_net
+            face_size = self.face_size
+            k = self.top_k
+
+            def stage_b(emb_params, g_emb, g_valid, g_labels, crops):
+                b, kf = crops.shape[0], crops.shape[1]
+                flat = crops.reshape((b * kf, *face_size))
+                emb = embed_net.apply(
+                    {"params": emb_params},
+                    embedder_mod.normalize_faces(flat, face_size),
+                )
+                labels, sims, _ = match(emb, g_emb, g_valid, g_labels)
+                return labels.reshape((b, kf, k)), sims.reshape((b, kf, k))
+
+            fn = self._b_cache[key] = jax.jit(stage_b)
+        served_shapes = set(self._served_crop_shapes)
+        if not served_shapes:
+            return
+        scratch_emb = jax.device_put(
+            jnp.zeros((capacity, g.dim), jnp.float32), g._emb_sharding
+        )
+        scratch_lab = jax.device_put(
+            jnp.full((capacity,), g.labels_pad, jnp.int32), g._lab_sharding
+        )
+        scratch_val = jax.device_put(
+            jnp.zeros((capacity,), bool), g._valid_sharding
+        )
+        for crop_shape in served_shapes:
+            crops = jax.device_put(jnp.zeros(crop_shape, jnp.float32),
+                                   self._b_crops)
+            out = fn(self._emb_params, scratch_emb, scratch_val, scratch_lab,
+                     crops)
+            jax.block_until_ready(out)
 
     def _stage_b_fn(self):
         """Compiled stage B for the gallery's CURRENT capacity/matcher —
@@ -181,6 +231,7 @@ class TwoStagePipeline:
 
     def _submit_b(self, hopped):
         boxes, det_scores, valid, crops_b = hopped
+        self._served_crop_shapes.add(tuple(crops_b.shape))
         data = self.gallery.data  # one atomic snapshot per batch (live)
         labels, sims = self._stage_b_fn()(
             self._emb_params, data.embeddings, data.valid, data.labels,

@@ -107,6 +107,30 @@ def detector_variants():
     return rows
 
 
+#: Round-4 structural grid (VERDICT r3 item #1): the round-3 sweep only
+#: swapped block types; embed stayed the worst stage (0.403 ms of the
+#:  0.917 ms batch at MFU 0.0998). These variants attack the two named
+#: suspects — the 1-channel 112x112 stem (same MXU-starving pathology the
+#: detector's s2d fixed) and the per-conv GroupNorms (VPU reductions
+#: between MXU calls) — plus wider-channels-at-lower-resolution.
+EMBEDDER_VARIANTS = {
+    "baseline_sep_s1_full": dict(block="separable"),
+    "sep_s1_light": dict(block="separable", norm="light"),
+    "sep_s2d2_full": dict(block="separable", space_to_depth=2),
+    "sep_s2d4_full": dict(block="separable", space_to_depth=4),
+    "sep_s2d4_light": dict(block="separable", space_to_depth=4,
+                           norm="light"),
+    "sep_s2d2_light": dict(block="separable", space_to_depth=2,
+                           norm="light"),
+    "dense_s2d4": dict(block="dense", space_to_depth=4),
+    "dense_s2d4_wide_96-192-256": dict(block="dense", space_to_depth=4,
+                                       stage_features=(96, 192, 256)),
+    "sep_s2d4_light_wide_96-192-256": dict(
+        block="separable", space_to_depth=4, norm="light",
+        stage_features=(96, 192, 256)),
+}
+
+
 def embedder_variants():
     import jax
     import jax.numpy as jnp
@@ -115,31 +139,35 @@ def embedder_variants():
         FaceEmbedNet, init_embedder, normalize_faces,
     )
 
+    V5E_BF16_PEAK_TFLOPS = 197.0  # matches bench.py's MFU denominator
     batch = 256  # 32 frames x 8 slots, the fused graph's embed batch
     size = (112, 112)
     frames = jnp.asarray(
         np.random.default_rng(0).normal(120, 40, (batch, *size)), jnp.float32)
 
-    variants = {
-        "separable_64-128-128x2": dict(stage_features=(64, 128, 128),
-                                       stage_blocks=(2, 2, 2),
-                                       block="separable"),
-        "dense_64-128-128x2": dict(stage_features=(64, 128, 128),
-                                   stage_blocks=(2, 2, 2), block="dense"),
-        "dense_64-128-128x1": dict(stage_features=(64, 128, 128),
-                                   stage_blocks=(1, 1, 1), block="dense"),
-        "dense_128-128-256x2": dict(stage_features=(128, 128, 256),
-                                    stage_blocks=(2, 2, 2), block="dense"),
-    }
     rows = {}
-    for name, cfg in variants.items():
-        net = FaceEmbedNet(embed_dim=128, stem_features=32, **cfg)
+    for name, cfg in EMBEDDER_VARIANTS.items():
+        net = FaceEmbedNet(embed_dim=128, stem_features=32,
+                           stage_features=cfg.get("stage_features",
+                                                  (64, 128, 128)),
+                           stage_blocks=cfg.get("stage_blocks", (2, 2, 2)),
+                           block=cfg.get("block", "separable"),
+                           space_to_depth=cfg.get("space_to_depth", 1),
+                           norm=cfg.get("norm", "full"))
         params = init_embedder(net, num_classes=8, input_shape=size,
                                seed=0)["net"]
 
         def fwd(p, x, _net=net):
             return jnp.sum(_net.apply({"params": p}, normalize_faces(x, size)))
 
+        # Per-variant FLOPs from XLA's cost analysis of the standalone
+        # forward, so the table carries an MFU column directly comparable
+        # to bench.py's stage attribution.
+        try:
+            compiled = jax.jit(fwd).lower(params, frames).compile()
+            flops = float(compiled.cost_analysis().get("flops", float("nan")))
+        except Exception:
+            flops = float("nan")
         ms = chained_ms(fwd, (params, frames))
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree_util.tree_leaves(params))
@@ -148,8 +176,16 @@ def embedder_variants():
                           "invalid": "under-resolved", "params": n_params}
             _log(f"[emb {name}] UNRESOLVED timing ({n_params} params)")
             continue
-        rows[name] = {"ms_per_256crops_fwd": round(ms, 3), "params": n_params}
-        _log(f"[emb {name}] {ms:.3f} ms/256 crops ({n_params} params)")
+        tflops = flops / (ms / 1e3) / 1e12 if np.isfinite(flops) else float("nan")
+        mfu = tflops / V5E_BF16_PEAK_TFLOPS
+        rows[name] = {
+            "ms_per_256crops_fwd": round(ms, 3),
+            "gflop": round(flops / 1e9, 3) if np.isfinite(flops) else None,
+            "mfu_vs_bf16_peak": round(mfu, 4) if np.isfinite(mfu) else None,
+            "params": n_params,
+        }
+        _log(f"[emb {name}] {ms:.3f} ms/256 crops, MFU {mfu:.3f} "
+             f"({n_params} params)")
     return rows
 
 

@@ -1,0 +1,110 @@
+"""Accuracy gate for embedder structural variants (VERDICT r3 item #1).
+
+Runs scripts/measure_accuracy.py's EXACT cnn_verification protocol (HARD
+distribution, disjoint identities, 6000 pairs, 10-fold) with a
+parameterized net structure, so an explore_perf winner can be admitted as
+a serving/accuracy default only on measured equal-or-better accuracy.
+
+Run:  PYTHONPATH=. python scripts/gate_embedder.py --block dense \
+          --space-to-depth 4 [--norm full] [--steps 9000] [--tag name]
+Appends one JSON line per run to scripts/.gate_embedder.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "scripts", ".gate_embedder.jsonl")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--block", default="separable")
+    ap.add_argument("--space-to-depth", type=int, default=1)
+    ap.add_argument("--norm", default="full")
+    ap.add_argument("--steps", type=int, default=9000)
+    ap.add_argument("--stage-features", default="64,128,256")
+    ap.add_argument("--stage-blocks", default="2,2,2")
+    ap.add_argument("--embed-dim", type=int, default=256)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--learning-rate", type=float, default=2e-3)
+    ap.add_argument("--margin", type=float, default=None,
+                    help="unused unless the train step grows a flag; "
+                         "recorded for provenance")
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--input-size", type=int, default=64,
+                    help="embedder input resolution; the 64x64 dataset is "
+                         "resized up in normalize_faces, so 112 gates the "
+                         "SERVING-exact structure at serving resolution")
+    ap.add_argument("--tag", default=None)
+    args = ap.parse_args(argv)
+
+    from opencv_facerecognizer_tpu.models.embedder import CNNEmbedding
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+    from opencv_facerecognizer_tpu.utils.verification import (
+        make_verification_pairs, verification_accuracy,
+    )
+
+    # EXACT mirror of measure_accuracy.cnn_verification's data protocol
+    HARD_WILD = dict(rotation=12.0, scale_jitter=0.12, elastic=1.8,
+                     occlusion=0.3)
+    size = (64, 64)
+    X_tr, y_tr, _ = make_synthetic_faces(
+        num_subjects=300, per_subject=12, size=size, seed=11, noise=10.0,
+        **HARD_WILD)
+    X_te, y_te, _ = make_synthetic_faces(
+        num_subjects=48, per_subject=12, size=size, seed=77, noise=10.0,
+        **HARD_WILD)
+
+    emb = CNNEmbedding(
+        embed_dim=args.embed_dim,
+        input_size=(args.input_size, args.input_size), stem_features=32,
+        stage_features=tuple(int(v) for v in args.stage_features.split(",")),
+        stage_blocks=tuple(int(v) for v in args.stage_blocks.split(",")),
+        block=args.block, space_to_depth=args.space_to_depth, norm=args.norm,
+        train_steps=args.steps, batch_size=args.batch_size,
+        learning_rate=args.learning_rate, seed=args.seed,
+        augment=True, lr_schedule="cosine", tta=True,
+    )
+    t0 = time.perf_counter()
+    emb.compute(X_tr, y_tr)
+    train_s = time.perf_counter() - t0
+    e = np.array(emb._extract_batch(np.asarray(X_te, np.float32)))
+    a, b, same = make_verification_pairs(y_te, num_pairs=6000, seed=5)
+    acc, std, thr = verification_accuracy(e[a], e[b], same, folds=10)
+    # fold-min gate support (VERDICT item #4: gate on the spread's lower
+    # edge, not the mean)
+    row = {
+        "tag": args.tag or f"{args.block}_s2d{args.space_to_depth}_{args.norm}",
+        "accuracy": round(float(acc), 4),
+        "std": round(float(std), 4),
+        "mean_minus_2std": round(float(acc - 2 * std), 4),
+        "threshold": round(float(thr), 3),
+        "train_s": round(train_s, 1),
+        "config": {
+            "block": args.block, "space_to_depth": args.space_to_depth,
+            "norm": args.norm, "steps": args.steps,
+            "stage_features": args.stage_features,
+            "stage_blocks": args.stage_blocks,
+            "embed_dim": args.embed_dim, "batch_size": args.batch_size,
+            "learning_rate": args.learning_rate, "seed": args.seed,
+            "input_size": args.input_size,
+        },
+        "date": time.strftime("%Y-%m-%d"),
+    }
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    print(json.dumps(row, indent=2))
+
+
+if __name__ == "__main__":
+    main()

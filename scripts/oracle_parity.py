@@ -1,0 +1,359 @@
+"""Oracle parity for the classic models (VERDICT r3 missing #1 / item #3).
+
+The reference's Eigenfaces/Fisherfaces/LBPH have never run on the same data
+as this framework (its mount is empty, and the real ORL/Yale-B/LFW images
+are unreachable), so "matching the reference" needs a same-data baseline
+column. This script is that column: an INDEPENDENT pure NumPy/SciPy
+implementation of the three classic algorithms — the same published math
+the reference family implements (Turk-Pentland PCA, Belhumeur PCA(N-c)+LDA,
+Ahonen LBPH with chi-square) — run k-fold on the SAME synthetic datasets
+and the SAME stratified folds as the framework, on both the easy and hard
+protocols.
+
+Deliberately shared with the framework (data plumbing, not the algorithm
+under test): `make_synthetic_faces` and `stratified_kfold_indices`.
+Everything algorithmic — preprocessing, subspace fits, LBP codes,
+histograms, distances, classification — is re-derived here in NumPy with
+no imports from the framework's ops/models.
+
+Agreement bar (VERDICT): any framework-vs-oracle gap > ~2 pts must be
+fixed or root-caused. Output: JSON to stdout + the ORACLE block of
+BASELINE.md rewritten in place + cache at scripts/.oracle_cache.json.
+
+Run:  PYTHONPATH=. python scripts/oracle_parity.py [--only CONFIG] [--skip-framework]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+import time
+
+import numpy as np
+from scipy import linalg as sla
+from scipy import ndimage
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BEGIN = "<!-- ORACLE:BEGIN (scripts/oracle_parity.py) -->"
+END = "<!-- ORACLE:END -->"
+CACHE = os.path.join(REPO, "scripts", ".oracle_cache.json")
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Oracle algorithm implementations (NumPy/SciPy only)
+# ---------------------------------------------------------------------------
+
+
+def tan_triggs_np(x: np.ndarray, alpha=0.1, tau=10.0, gamma=0.2,
+                  sigma0=2.0, sigma1=4.0) -> np.ndarray:
+    """Tan & Triggs 2010 illumination normalization: gamma -> DoG ->
+    two-stage contrast equalization -> tanh squash. [N, H, W] float."""
+    x = np.asarray(x, np.float32)
+    xg = np.power(x + 1.0, gamma)
+    # truncate=3.0 + mode="nearest" mirrors a radius-ceil(3 sigma),
+    # edge-replicated blur.
+    blur = lambda img, s: ndimage.gaussian_filter(
+        img, sigma=(0, s, s), mode="nearest", truncate=3.0)
+    dog = blur(xg, sigma0) - blur(xg, sigma1)
+    m1 = np.mean(np.abs(dog) ** alpha, axis=(-2, -1), keepdims=True)
+    dog = dog / np.maximum(m1, 1e-12) ** (1.0 / alpha)
+    m2 = np.mean(np.minimum(np.abs(dog), tau) ** alpha, axis=(-2, -1),
+                 keepdims=True)
+    dog = dog / np.maximum(m2, 1e-12) ** (1.0 / alpha)
+    return tau * np.tanh(dog / tau)
+
+
+def pca_fit_np(X: np.ndarray, k: int):
+    """Turk-Pentland eigenfaces fit on row-vectors [N, D] via SVD."""
+    mean = X.mean(axis=0)
+    Xc = X - mean
+    # economy SVD: right singular vectors are the eigenfaces
+    _, s, vt = np.linalg.svd(Xc, full_matrices=False)
+    return mean, vt[:k].T  # [D, k]
+
+
+def fisherfaces_fit_np(X: np.ndarray, y: np.ndarray):
+    """Belhumeur Fisherfaces: PCA to (N - c) dims, then LDA to (c - 1).
+
+    LDA solved as the generalized symmetric eigenproblem Sb v = l Sw v via
+    scipy.linalg.eigh — an independent route from the framework's
+    Cholesky-whitening implementation."""
+    classes = np.unique(y)
+    c = len(classes)
+    n = X.shape[0]
+    mean, Wpca = pca_fit_np(X, max(1, n - c))
+    Z = (X - mean) @ Wpca  # [N, n-c]
+    gmean = Z.mean(axis=0)
+    d = Z.shape[1]
+    Sw = np.zeros((d, d), np.float64)
+    Sb = np.zeros((d, d), np.float64)
+    for cls in classes:
+        Zi = Z[y == cls]
+        mi = Zi.mean(axis=0)
+        Zc = Zi - mi
+        Sw += Zc.T @ Zc
+        dm = (mi - gmean)[:, None]
+        Sb += len(Zi) * (dm @ dm.T)
+    # Shrinkage-regularized Sw (standard regularized-LDA practice: the
+    # PCA'd scatter is near-singular in its trailing directions) ...
+    Sw += np.eye(d) * 1e-4 * np.trace(Sw) / d
+    evals, evecs = sla.eigh(Sb, Sw)
+    order = np.argsort(evals)[::-1][: c - 1]
+    Wlda = evecs[:, order]  # [n-c, c-1]
+    # ... and unit-norm projection columns: generalized eigvecs come back
+    # Sw-orthonormal (v' Sw v = 1), which scales low-variance (noise)
+    # directions up by orders of magnitude — a Euclidean NN on such
+    # coordinates is dominated by noise. Unit-norm is the published
+    # convention for Fisherfaces projection bases.
+    Wlda = Wlda / np.maximum(np.linalg.norm(Wlda, axis=0, keepdims=True),
+                             1e-12)
+    return mean, Wpca @ Wlda  # [D, c-1]
+
+
+def lbp_codes_np(x: np.ndarray, radius: int = 2, neighbors: int = 8) -> np.ndarray:
+    """Ahonen extended/circular LBP codes with bilinear sampling.
+
+    [N, H, W] -> [N, H-2r, W-2r] uint8-range ints. Sample k at angle
+    2 pi k / P, (dy, dx) = (-r sin, r cos), >= comparison to the center."""
+    x = np.asarray(x, np.float32)
+    n, h, w = x.shape
+    c = x[:, radius:h - radius, radius:w - radius]
+    code = np.zeros(c.shape, np.int32)
+    for k in range(neighbors):
+        theta = 2.0 * math.pi * k / neighbors
+        dy, dx = -radius * math.sin(theta), radius * math.cos(theta)
+        fy, fx = math.floor(dy), math.floor(dx)
+        ty, tx = dy - fy, dx - fx
+        patch = np.zeros_like(c)
+        for (oy, ox, wgt) in ((0, 0, (1 - ty) * (1 - tx)),
+                              (0, 1, (1 - ty) * tx),
+                              (1, 0, ty * (1 - tx)),
+                              (1, 1, ty * tx)):
+            if wgt == 0.0:
+                continue
+            y0, x0 = radius + fy + oy, radius + fx + ox
+            patch += wgt * x[:, y0:y0 + c.shape[1], x0:x0 + c.shape[2]]
+        code += (1 << k) * (patch >= c).astype(np.int32)
+    return code
+
+
+def spatial_hist_np(codes: np.ndarray, grid=(8, 8), num_bins=256) -> np.ndarray:
+    """Per-cell L1-normalized histograms over a center-cropped grid,
+    concatenated: [N, Hc, Wc] -> [N, gy*gx*num_bins]."""
+    n, h, w = codes.shape
+    gy, gx = grid
+    ch, cw = h // gy, w // gx
+    y0, x0 = (h - gy * ch) // 2, (w - gx * cw) // 2
+    codes = codes[:, y0:y0 + gy * ch, x0:x0 + gx * cw]
+    cells = codes.reshape(n, gy, ch, gx, cw).transpose(0, 1, 3, 2, 4)
+    cells = cells.reshape(n, gy * gx, ch * cw)
+    out = np.zeros((n, gy * gx, num_bins), np.float32)
+    for i in range(n):
+        for j in range(gy * gx):
+            out[i, j] = np.bincount(cells[i, j], minlength=num_bins)
+    out /= np.maximum(out.sum(axis=-1, keepdims=True), 1e-12)
+    return out.reshape(n, gy * gx * num_bins)
+
+
+def nn_classify_np(train_f, train_y, test_f, metric: str) -> np.ndarray:
+    """1-NN under euclidean or chi-square, blocked to bound memory."""
+    preds = np.empty(len(test_f), train_y.dtype)
+    for i0 in range(0, len(test_f), 64):
+        t = test_f[i0:i0 + 64]
+        if metric == "euclidean":
+            d = ((t[:, None, :] - train_f[None, :, :]) ** 2).sum(-1)
+        elif metric == "chi_square":
+            diff = t[:, None, :] - train_f[None, :, :]
+            s = np.maximum(t[:, None, :] + train_f[None, :, :], 1e-12)
+            d = (diff * diff / s).sum(-1)
+        else:
+            raise ValueError(metric)
+        preds[i0:i0 + 64] = train_y[np.argmin(d, axis=1)]
+    return preds
+
+
+# ---------------------------------------------------------------------------
+# Oracle k-fold drivers (same folds as the framework's validation)
+# ---------------------------------------------------------------------------
+
+
+def oracle_kfold(kind: str, X: np.ndarray, y: np.ndarray, k: int) -> float:
+    from opencv_facerecognizer_tpu.utils.validation import (
+        stratified_kfold_indices,
+    )
+
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y)
+    if kind == "lbph":
+        # descriptors are per-image and fold-independent: compute once
+        feats_all = spatial_hist_np(lbp_codes_np(X, radius=2, neighbors=8))
+    folds = stratified_kfold_indices(y, k, seed=0)
+    correct = total = 0
+    for test_idx in folds:
+        if len(test_idx) == 0:
+            continue
+        mask = np.ones(len(y), bool)
+        mask[test_idx] = False
+        if kind == "eigenfaces":
+            Xtr = X[mask].reshape(mask.sum(), -1)
+            Xte = X[test_idx].reshape(len(test_idx), -1)
+            mean, W = pca_fit_np(Xtr, min(Xtr.shape))
+            ftr, fte = (Xtr - mean) @ W, (Xte - mean) @ W
+            preds = nn_classify_np(ftr, y[mask], fte, "euclidean")
+        elif kind == "fisherfaces":
+            # trainer default chain: TanTriggs(sigma0=2, sigma1=4) first
+            Xp = tan_triggs_np(X)
+            Xtr = Xp[mask].reshape(mask.sum(), -1)
+            Xte = Xp[test_idx].reshape(len(test_idx), -1)
+            mean, W = fisherfaces_fit_np(Xtr, y[mask])
+            ftr, fte = (Xtr - mean) @ W, (Xte - mean) @ W
+            preds = nn_classify_np(ftr, y[mask], fte, "euclidean")
+        elif kind == "lbph":
+            preds = nn_classify_np(feats_all[mask], y[mask],
+                                   feats_all[test_idx], "chi_square")
+        else:
+            raise ValueError(kind)
+        correct += int((preds == y[test_idx]).sum())
+        total += len(test_idx)
+    return correct / total
+
+
+def framework_kfold(kind: str, X, y, names, k: int) -> float:
+    from opencv_facerecognizer_tpu.runtime.trainer import (
+        TheTrainer, TrainerConfig,
+    )
+
+    trainer = TheTrainer(TrainerConfig(model=kind, kfold=k))
+    trainer.train(X, y, names, validate=True)
+    return float(trainer.mean_accuracy)
+
+
+# ---------------------------------------------------------------------------
+# Protocol matrix: identical datasets for both columns
+# ---------------------------------------------------------------------------
+
+#: mirrors scripts/measure_accuracy.py HARD_POSE / HARD_WILD
+HARD_POSE = dict(rotation=8.0, scale_jitter=0.08, elastic=1.2, occlusion=0.25)
+HARD_WILD = dict(rotation=12.0, scale_jitter=0.12, elastic=1.8, occlusion=0.3)
+
+CONFIGS = {
+    # key -> (kind, dataset kwargs, k)
+    "eigenfaces_easy": ("eigenfaces", dict(num_subjects=40, per_subject=10,
+                                           seed=1), 10),
+    "eigenfaces_hard": ("eigenfaces", dict(num_subjects=40, per_subject=10,
+                                           seed=1, **HARD_POSE), 10),
+    "fisherfaces_easy": ("fisherfaces", dict(num_subjects=30, per_subject=12,
+                                             seed=2, illumination=0.7,
+                                             noise=14.0), 10),
+    "fisherfaces_hard": ("fisherfaces", dict(num_subjects=30, per_subject=12,
+                                             seed=2, illumination=0.7,
+                                             noise=14.0, **HARD_POSE), 10),
+    "lbph_easy": ("lbph", dict(num_subjects=40, per_subject=8, seed=3,
+                               noise=18.0), 10),
+    "lbph_hard": ("lbph", dict(num_subjects=40, per_subject=8, seed=3,
+                               noise=18.0, **HARD_WILD), 10),
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", action="append", choices=sorted(CONFIGS))
+    ap.add_argument("--skip-framework", action="store_true",
+                    help="oracle column only (framework rows keep cache)")
+    args = ap.parse_args(argv)
+    selected = args.only or sorted(CONFIGS)
+
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
+
+    results = {}
+    if os.path.exists(CACHE):
+        try:
+            results.update(json.load(open(CACHE)))
+        except (json.JSONDecodeError, OSError) as e:
+            _log(f"ignoring unreadable cache {CACHE}: {e}")
+
+    for key in selected:
+        kind, data_kwargs, k = CONFIGS[key]
+        X, y, names = make_synthetic_faces(size=(70, 70), **data_kwargs)
+        row = dict(results.get(key) or {})
+        t0 = time.perf_counter()
+        row["oracle"] = round(oracle_kfold(kind, X, y, k), 4)
+        row["oracle_s"] = round(time.perf_counter() - t0, 1)
+        if not args.skip_framework:
+            t0 = time.perf_counter()
+            row["framework"] = round(framework_kfold(kind, X, y, names, k), 4)
+            row["framework_s"] = round(time.perf_counter() - t0, 1)
+        if "framework" in row:
+            row["delta"] = round(row["framework"] - row["oracle"], 4)
+        row["dataset"] = (f"synthetic 70x70 "
+                          + ", ".join(f"{kk}={vv}" for kk, vv in
+                                      data_kwargs.items()) + f", {k}-fold")
+        results[key] = row
+        _log(f"[{key}] oracle {row['oracle']:.4f}"
+             + (f" framework {row['framework']:.4f} "
+                f"delta {row['delta']:+.4f}" if "framework" in row else ""))
+
+    results["_meta"] = {"date": time.strftime("%Y-%m-%d")}
+    tmp = f"{CACHE}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(results, f, indent=2)
+    os.replace(tmp, CACHE)
+    print(json.dumps(results, indent=2))
+
+    # -- render the BASELINE.md ORACLE block --
+    label = {
+        "eigenfaces": "Eigenfaces (PCA+NN)",
+        "fisherfaces": "Fisherfaces (TanTriggs + PCA+LDA+NN)",
+        "lbph": "LBPH (ExtendedLBP r=2 + ChiSquare NN)",
+    }
+    lines = [BEGIN, "",
+             "| Config | Protocol | Framework (TPU) | Oracle (NumPy/SciPy) "
+             "| Delta |", "|---|---|---|---|---|"]
+    for key in sorted(CONFIGS):
+        if key not in results:
+            continue
+        r = results[key]
+        kind = CONFIGS[key][0]
+        proto = "hard" if key.endswith("hard") else "easy"
+        fw = f"{r['framework']:.4f}" if "framework" in r else "—"
+        dl = f"{r['delta']:+.4f}" if "delta" in r else "—"
+        lines.append(f"| {label[kind]} | {proto} | **{fw}** | {r['oracle']:.4f} "
+                     f"| {dl} |")
+    lines += [
+        "",
+        "Same synthetic datasets, same stratified folds "
+        "(`utils.validation.stratified_kfold_indices`), independent NumPy/"
+        "SciPy implementations of the published algorithms "
+        "(`scripts/oracle_parity.py`). Easy rows use each config's "
+        "pre-round-3 distribution (noise/illumination only); hard rows add "
+        "the round-3 pose/scale/elastic/occlusion axes. Agreement within "
+        "~2 pts means the framework's numbers are the algorithms' ceiling "
+        "on that data, not implementation artifacts. Refreshed "
+        f"{results['_meta']['date']}.", END]
+    block = "\n".join(lines)
+
+    path = os.path.join(REPO, "BASELINE.md")
+    text = open(path).read()
+    if BEGIN in text:
+        text = re.sub(re.escape(BEGIN) + r".*?" + re.escape(END), block, text,
+                      flags=re.S)
+    else:
+        text = (text.rstrip()
+                + "\n\n## Oracle parity (classic models, same data/folds)\n\n"
+                + block + "\n")
+    open(path, "w").write(text)
+    _log("BASELINE.md oracle block updated")
+
+
+if __name__ == "__main__":
+    main()

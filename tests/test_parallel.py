@@ -287,3 +287,125 @@ def test_initialize_multihost_env_and_args(monkeypatch):
     n = len(calls)
     assert mesh_mod.initialize_multihost() is True
     assert len(calls) == n
+
+
+def test_gallery_async_grow_never_blocks_and_lands_rows():
+    """async_grow: an overflowing add() returns immediately (rows staged),
+    the background worker compiles the next tier via prewarm_hooks BEFORE
+    installing, and the rows become matchable after wait_ready()."""
+    import threading
+
+    mesh = make_mesh(tp=4)
+    g = ShardedGallery(capacity=16, dim=8, mesh=mesh, async_grow=True)
+    warmed = []
+    hook_thread = []
+
+    def hook(capacity):
+        warmed.append(capacity)
+        hook_thread.append(threading.current_thread().name)
+
+    g.prewarm_hooks.append(hook)
+    e = RNG.normal(size=(16, 8)).astype(np.float32)
+    g.add(e, np.arange(16, dtype=np.int32))
+    assert g.size == 16 and g.pending_rows == 0  # fits: synchronous path
+    e2 = RNG.normal(size=(8, 8)).astype(np.float32)
+    g.add(e2, np.arange(16, 24, dtype=np.int32))  # overflows -> staged
+    assert g.wait_ready(timeout=30)
+    assert g.pending_rows == 0
+    assert g.size == 24
+    assert g.capacity == 32
+    assert g.grow_count == 1
+    assert warmed == [32]
+    assert hook_thread and hook_thread[0] != threading.main_thread().name
+    # staged rows are matchable post-install
+    q = e2 / np.linalg.norm(e2, axis=-1, keepdims=True)
+    labels, _, _ = (np.asarray(v) for v in g.match(q, k=1))
+    np.testing.assert_array_equal(labels[:, 0], np.arange(16, 24))
+
+
+def test_gallery_async_grow_absorbs_adds_during_grow():
+    """Adds arriving while a grow is in flight are staged and spliced into
+    the same (or a follow-up) install — none are lost, order preserved."""
+    import threading
+
+    mesh = make_mesh(tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh, async_grow=True)
+    slow = threading.Event()
+
+    def slow_hook(capacity):
+        slow.wait(5)  # hold the grow so follow-up adds land in pending
+
+    g.prewarm_hooks.append(slow_hook)
+    g.add(RNG.normal(size=(8, 4)).astype(np.float32),
+          np.arange(8, dtype=np.int32))
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32),
+          np.arange(8, 12, dtype=np.int32))  # overflow -> worker starts
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32),
+          np.arange(12, 16, dtype=np.int32))  # lands mid-grow
+    assert g.pending_rows == 8
+    slow.set()
+    assert g.wait_ready(timeout=30)
+    assert g.size == 16
+    assert np.array_equal(np.asarray(g.labels)[:16], np.arange(16))
+
+
+def test_gallery_reset_cancels_inflight_grow():
+    mesh = make_mesh(tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh, async_grow=True)
+    import threading
+
+    hold = threading.Event()
+    g.prewarm_hooks.append(lambda cap: hold.wait(5))
+    g.add(RNG.normal(size=(8, 4)).astype(np.float32),
+          np.arange(8, dtype=np.int32))
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32),
+          np.arange(8, 12, dtype=np.int32))
+    g.reset()  # bump epoch: the in-flight grow must not resurrect rows
+    hold.set()
+    assert g.wait_ready(timeout=30)
+    assert g.size == 0
+    assert g.pending_rows == 0
+
+
+def test_pipeline_prewarm_registers_and_compiles_future_tier():
+    """RecognitionPipeline registers a prewarm hook; after an async grow
+    the serving-path cache already holds the new tier's packed step (keyed
+    exactly as the post-grow lookup) and serving output stays correct."""
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder,
+    )
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+    import jax
+
+    mesh = make_mesh(dp=2, tp=4)
+    g = ShardedGallery(capacity=32, dim=16, mesh=mesh, async_grow=True)
+    emb = RNG.normal(size=(32, 16)).astype(np.float32)
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    g.add(emb, np.arange(32, dtype=np.int32))
+
+    det = CNNFaceDetector(features=(8, 8), head_features=8, max_faces=2,
+                          score_threshold=0.0, space_to_depth=2)
+    det.load_params(det.net.init(jax.random.PRNGKey(0),
+                                 np.zeros((1, 64, 64)))["params"])
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
+                       stage_blocks=(1,))
+    emb_params = init_embedder(net, num_classes=4, input_shape=(32, 32),
+                               seed=0)["net"]
+    pipe = RecognitionPipeline(det, net, emb_params, g, face_size=(32, 32),
+                               top_k=1)
+    assert pipe.prewarm_capacity in g.prewarm_hooks
+    frames = make_synthetic_scenes(4, (64, 64), max_faces=2, seed=5)[0]
+    out0 = np.asarray(pipe.recognize_batch_packed(frames))
+
+    g.add(RNG.normal(size=(40, 16)).astype(np.float32),
+          np.arange(32, 72, dtype=np.int32))  # overflow -> async grow
+    assert g.wait_ready(timeout=60)
+    assert g.capacity == 128
+    key = pipe._step_key(pipe._as_device_frames(frames))
+    assert key[4] == 128  # capacity baked into the serving cache key
+    assert key in pipe._packed_cache  # prewarmed BEFORE the swap published
+    out1 = np.asarray(pipe.recognize_batch_packed(frames))
+    assert out1.shape == out0.shape
