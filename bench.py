@@ -334,8 +334,6 @@ def main():
     # computed output (no DCE), and per-prefix analytic FLOPs from XLA cost
     # analysis give per-stage MFU — the roofline evidence for where the
     # batch's milliseconds and the chip's idle fraction actually live.
-    batch = HEADLINE_BATCH
-
     def make_prefix_step(batch, upto: str):
         def step(det_params, emb_params, gallery, labels, frames):
             outputs = det.net.apply({"params": det_params}, frames)
@@ -377,54 +375,78 @@ def main():
 
         return jax.jit(chained, static_argnums=5)
 
-    frames_stack = jnp.stack(all_dev[batch])
-    prefix_ms, prefix_flops = {}, {}
-    for upto in ("detect", "crop", "embed", "full"):
-        step = make_prefix_step(batch, upto)
-        compiled = jax.jit(step).lower(
-            det_params, emb_params, g, lab, all_dev[batch][0]
-        ).compile()
-        prefix_flops[upto] = _graph_flops(compiled)
-        chained = make_chained_scalar(step)
+    def attribute_stages(batch):
+        """Ablated-prefix stage table for one batch size."""
+        frames_stack = jnp.stack(all_dev[batch])
+        prefix_ms, prefix_flops = {}, {}
+        for upto in ("detect", "crop", "embed", "full"):
+            step = make_prefix_step(batch, upto)
+            compiled = jax.jit(step).lower(
+                det_params, emb_params, g, lab, all_dev[batch][0]
+            ).compile()
+            prefix_flops[upto] = _graph_flops(compiled)
+            chained = make_chained_scalar(step)
 
-        def timed_chain(k):
-            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
-            _ = np.asarray(acc)
-            t0 = time.perf_counter()
-            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
-            _ = np.asarray(acc)
-            return time.perf_counter() - t0
+            def timed_chain(k):
+                acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+                _ = np.asarray(acc)
+                t0 = time.perf_counter()
+                acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+                _ = np.asarray(acc)
+                return time.perf_counter() - t0
 
-        t1s, t2s, k2_used, mean_s = measure_chained_retrying(timed_chain)
-        prefix_ms[upto] = mean_s * 1e3 if mean_s else float("nan")
-        _log(f"[stage prefix {upto}] {prefix_ms[upto]:.3f} ms/batch "
-             f"({prefix_flops[upto] / 1e9:.1f} GFLOP)")
+            t1s, t2s, k2_used, mean_s = measure_chained_retrying(timed_chain)
+            if mean_s is None:
+                # mirror pass 2's explicit invalid record: NaN in the JSON
+                # breaks strict parsers and explains nothing
+                return prefix_ms, {
+                    "invalid": f"prefix {upto!r} under-resolved (chain "
+                               "delta never cleared MIN_DELTA_S)",
+                }
+            prefix_ms[upto] = mean_s * 1e3
+            _log(f"[b{batch} stage prefix {upto}] {prefix_ms[upto]:.3f} "
+                 f"ms/batch ({prefix_flops[upto] / 1e9:.1f} GFLOP)")
 
-    stage_order = [("detect", "detect", None), ("crop", "crop", "detect"),
-                   ("embed", "embed", "crop"), ("match", "full", "embed")]
-    stages = {}
-    for name, cur, prev in stage_order:
-        ms = prefix_ms[cur] - (prefix_ms[prev] if prev else 0.0)
-        fl = prefix_flops[cur] - (prefix_flops[prev] if prev else 0.0)
-        tf = fl / (ms / 1e3) / 1e12 if ms > 0 else float("nan")
-        stages[name] = {
-            "ms_per_batch": round(ms, 3),
-            "gflop_per_batch": round(fl / 1e9, 3),
-            "tflops_per_s": round(tf, 2) if np.isfinite(tf) else None,
-            "mfu_vs_bf16_peak": (round(tf / V5E_BF16_PEAK_TFLOPS, 4)
-                                 if np.isfinite(tf) else None),
-        }
-        _log(f"[stage {name}] {ms:.3f} ms/batch, {fl / 1e9:.1f} GFLOP, "
-             f"MFU {stages[name]['mfu_vs_bf16_peak']}")
+        stage_order = [("detect", "detect", None), ("crop", "crop", "detect"),
+                       ("embed", "embed", "crop"), ("match", "full", "embed")]
+        stages = {}
+        assert all(k in prefix_ms for k in ("detect", "crop", "embed", "full"))
+        for name, cur, prev in stage_order:
+            ms = prefix_ms[cur] - (prefix_ms[prev] if prev else 0.0)
+            fl = prefix_flops[cur] - (prefix_flops[prev] if prev else 0.0)
+            tf = fl / (ms / 1e3) / 1e12 if ms > 0 else float("nan")
+            stages[name] = {
+                "ms_per_batch": round(ms, 3),
+                "gflop_per_batch": round(fl / 1e9, 3),
+                "tflops_per_s": round(tf, 2) if np.isfinite(tf) else None,
+                "mfu_vs_bf16_peak": (round(tf / V5E_BF16_PEAK_TFLOPS, 4)
+                                     if np.isfinite(tf) else None),
+            }
+            _log(f"[b{batch} stage {name}] {ms:.3f} ms/batch, "
+                 f"{fl / 1e9:.1f} GFLOP, MFU "
+                 f"{stages[name]['mfu_vs_bf16_peak']}")
+        return prefix_ms, stages
+
+    # Headline batch first (round-over-round comparability), then the rest
+    # of the sweep — the batch-128 MFU bend needs per-stage evidence at
+    # every sweep point, not just the headline (VERDICT r3 item #2).
+    per_batch = {}
+    headline_prefix_ms, headline_stages = attribute_stages(HEADLINE_BATCH)
+    per_batch[str(HEADLINE_BATCH)] = headline_stages
+    for b in BATCH_SWEEP:
+        if b != HEADLINE_BATCH:
+            per_batch[str(b)] = attribute_stages(b)[1]
     detail["stage_attribution"] = {
-        "batch": batch,
+        "batch": HEADLINE_BATCH,
         "method": ("ablated graph prefixes (detect | +crop | +embed | "
                    "+match), each timed by chained differencing; stage = "
                    "delta of consecutive prefixes; FLOPs = delta of XLA "
                    "cost analysis. Prefix totals listed for cross-checking "
-                   "against the pass-2 full-step time."),
-        "prefix_ms": {k: round(v, 3) for k, v in prefix_ms.items()},
-        "stages": stages,
+                   "against the pass-2 full-step time. per_batch holds the "
+                   "same stage table at every sweep batch size."),
+        "prefix_ms": {k: round(v, 3) for k, v in headline_prefix_ms.items()},
+        "stages": headline_stages,
+        "per_batch": per_batch,
     }
 
     # -- pass 3: large-gallery scaling — the fused pipeline at 262k and 1M

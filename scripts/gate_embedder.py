@@ -80,7 +80,9 @@ def main(argv=None):
     train_s = time.perf_counter() - t0
     e = np.array(emb._extract_batch(np.asarray(X_te, np.float32)))
     a, b, same = make_verification_pairs(y_te, num_pairs=6000, seed=5)
-    acc, std, thr = verification_accuracy(e[a], e[b], same, folds=10)
+    acc, std, thr, fold_accs = verification_accuracy(e[a], e[b], same,
+                                                     folds=10,
+                                                     return_folds=True)
     # fold-min gate support (VERDICT item #4: gate on the spread's lower
     # edge, not the mean)
     row = {
@@ -88,6 +90,7 @@ def main(argv=None):
         "accuracy": round(float(acc), 4),
         "std": round(float(std), 4),
         "mean_minus_2std": round(float(acc - 2 * std), 4),
+        "fold_min": round(float(min(fold_accs)), 4),
         "threshold": round(float(thr), 3),
         "train_s": round(train_s, 1),
         "config": {

@@ -98,9 +98,12 @@ def cnn_verification():
     train_s = time.perf_counter() - t0
     e = np.array(emb._extract_batch(np.asarray(X_te, np.float32)))
     a, b, same = make_verification_pairs(y_te, num_pairs=6000, seed=5)
-    acc, std, thr = verification_accuracy(e[a], e[b], same, folds=10)
+    acc, std, thr, fold_accs = verification_accuracy(e[a], e[b], same,
+                                                     folds=10,
+                                                     return_folds=True)
     return {
         "accuracy": round(acc, 4), "std": round(std, 4),
+        "fold_min": round(float(min(fold_accs)), 4),
         "threshold": round(thr, 3),
         "dataset": "synthetic verification, HARD protocol (rot 12deg, "
                    "scale 0.12, elastic 1.8px, occlusion p=0.3): train 300 "
