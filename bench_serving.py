@@ -39,7 +39,10 @@ def build_pipeline(frame_hw=(256, 256), gallery_size=1024):
     Built once; serving configurations (batch/flush/depth) wrap it via
     ``make_service`` without repeating the ~60 s detector warm-train."""
     from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
-    from opencv_facerecognizer_tpu.models.embedder import FaceEmbedNet, init_embedder
+    from opencv_facerecognizer_tpu.models.embedder import (
+        SERVING_EMBEDDER_KWARGS, SERVING_FACE_SIZE, FaceEmbedNet,
+        init_embedder,
+    )
     from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
     from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
     from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
@@ -52,16 +55,18 @@ def build_pipeline(frame_hw=(256, 256), gallery_size=1024):
     )
     det.train(scenes, boxes, counts, steps=150, batch_size=16)
 
-    net = FaceEmbedNet(embed_dim=128)
-    emb_params = init_embedder(net, num_classes=16, input_shape=(112, 112),
+    net = FaceEmbedNet(**SERVING_EMBEDDER_KWARGS)
+    emb_params = init_embedder(net, num_classes=16,
+                               input_shape=SERVING_FACE_SIZE,
                                seed=0)["net"]
     rng = np.random.default_rng(0)
-    gal_emb = rng.normal(size=(gallery_size, 128)).astype(np.float32)
+    dim = SERVING_EMBEDDER_KWARGS["embed_dim"]
+    gal_emb = rng.normal(size=(gallery_size, dim)).astype(np.float32)
     mesh = make_mesh()
-    gallery = ShardedGallery(capacity=gallery_size, dim=128, mesh=mesh)
+    gallery = ShardedGallery(capacity=gallery_size, dim=dim, mesh=mesh)
     gallery.add(gal_emb, rng.integers(0, 64, gallery_size).astype(np.int32))
     pipeline = RecognitionPipeline(det, net, emb_params, gallery,
-                                   face_size=(112, 112))
+                                   face_size=SERVING_FACE_SIZE)
     # Distinct frames to cycle (no same-buffer effects).
     frames = [np.asarray(s, np.float32) for s in make_synthetic_scenes(
         num_scenes=16, scene_size=(h, w), max_faces=8,

@@ -32,7 +32,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
 
-NEG_INF = jnp.float32(-1e30)
+# numpy, not jnp: a module-level jnp scalar initializes the JAX backend at
+# IMPORT time, which blocks every importer (even transport-only child
+# processes) whenever the accelerator is unreachable. jnp ops accept the
+# numpy scalar identically.
+NEG_INF = np.float32(-1e30)
 
 
 def take_labels_with_sentinel(labels, idx, labels_pad: int):

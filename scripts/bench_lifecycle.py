@@ -76,15 +76,21 @@ def main():
 
     dev = jax.devices()[0]
     _log(f"device: {dev}")
-    batch, h, w, max_faces, dim = 32, 256, 256, 8, 128
+    from opencv_facerecognizer_tpu.models.embedder import (
+        SERVING_EMBEDDER_KWARGS, SERVING_FACE_SIZE,
+    )
+
+    batch, h, w, max_faces = 32, 256, 256, 8
+    dim = SERVING_EMBEDDER_KWARGS["embed_dim"]
 
     det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3)
     scenes, boxes, counts = make_synthetic_scenes(
         num_scenes=48, scene_size=(h, w), max_faces=max_faces,
         face_size_range=(24, 56), seed=7)
     det.train(scenes, boxes, counts, steps=150, batch_size=16)
-    net = FaceEmbedNet(embed_dim=dim)
-    emb_params = init_embedder(net, num_classes=16, input_shape=(112, 112),
+    net = FaceEmbedNet(**SERVING_EMBEDDER_KWARGS)
+    emb_params = init_embedder(net, num_classes=16,
+                               input_shape=SERVING_FACE_SIZE,
                                seed=0)["net"]
 
     rng = np.random.default_rng(0)
@@ -97,7 +103,7 @@ def main():
     gallery.add(rng.normal(size=(16384, dim)).astype(np.float32),
                 rng.integers(0, 512, 16384).astype(np.int32))
     pipeline = RecognitionPipeline(det, net, emb_params, gallery,
-                                   face_size=(112, 112))
+                                   face_size=SERVING_FACE_SIZE)
 
     frames_stack = jnp.stack([
         jnp.asarray(make_synthetic_scenes(

@@ -86,11 +86,18 @@ def cnn_verification():
     # measured 0.9342 here (2000 steps) — the 10 fixed views per identity
     # cannot teach occlusion/pose invariance. augment=True turns on the
     # in-graph flip/shift/cutout pipe (models.embedder.augment_batch), with
-    # a cosine decay over a longer run and a wider trunk.
+    # a cosine decay over a longer run and a wider trunk. r4 margin attack
+    # (scripts/.gate_embedder.jsonl): 9000 steps/b128 measured
+    # 0.9937 +/- 0.0036 (mean-2sigma 0.9865, ON the >=0.99 bar);
+    # 30000 steps/b192 measured 0.9943 +/- 0.0020, mean-2sigma 0.9903 and
+    # fold_min 0.9917 — decisively above it. Structural speedups (s2d
+    # stem folds, light norm, dense blocks) were all gated here and all
+    # measured BELOW baseline accuracy (0.9655-0.987), so the accuracy
+    # config keeps the s1/full/separable structure.
     emb = CNNEmbedding(
         embed_dim=256, input_size=size, stem_features=32,
         stage_features=(64, 128, 256), stage_blocks=(2, 2, 2),
-        train_steps=9000, batch_size=128, learning_rate=2e-3, seed=3,
+        train_steps=30000, batch_size=192, learning_rate=2e-3, seed=3,
         augment=True, lr_schedule="cosine", tta=True,
     )
     t0 = time.perf_counter()
@@ -108,8 +115,8 @@ def cnn_verification():
         "dataset": "synthetic verification, HARD protocol (rot 12deg, "
                    "scale 0.12, elastic 1.8px, occlusion p=0.3): train 300 "
                    "identities x12, eval 48 disjoint x12, 6000 pairs, "
-                   "10-fold; embed_dim=256, stages 64/128/256, 9000 steps "
-                   "batch 128, in-graph flip/rot/scale/shift/cutout "
+                   "10-fold; embed_dim=256, stages 64/128/256, 30000 steps "
+                   "batch 192, in-graph flip/rot/scale/shift/cutout "
                    "augmentation, cosine lr, flip-TTA — vs the >=0.99 "
                    "north star (BASELINE.json:5)",
         "seconds": round(train_s, 1),

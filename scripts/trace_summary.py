@@ -56,16 +56,17 @@ def build_pipeline(batch, h, w, max_faces, dim, tiny=False):
         emb_params = init_embedder(net, num_classes=4, input_shape=face,
                                    seed=0)["net"]
     else:
-        det = CNNFaceDetector(max_faces=max_faces, score_threshold=0.3)
-        scenes, boxes, counts = make_synthetic_scenes(
-            num_scenes=48, scene_size=(h, w), max_faces=max_faces,
-            face_size_range=(24, 56), seed=7)
-        det.train(scenes, boxes, counts, steps=150, batch_size=16)
-        face = (112, 112)
-        cap = 16384
-        net = FaceEmbedNet(embed_dim=dim)
-        emb_params = init_embedder(net, num_classes=16, input_shape=face,
-                                   seed=0)["net"]
+        # The SERVING-default pipeline, via the one shared constructor
+        # (bench_serving.build_pipeline) so this artifact can never drift
+        # from the config the serving benches measure.
+        import bench_serving
+
+        pipe, frame_pool = bench_serving.build_pipeline(
+            frame_hw=(h, w), gallery_size=16384)
+        frames = jnp.asarray(np.stack(
+            [frame_pool[i % len(frame_pool)] for i in range(batch)]),
+            jnp.float32)
+        return pipe, frames
     rng = np.random.default_rng(0)
     gallery = ShardedGallery(capacity=cap, dim=dim, mesh=make_mesh())
     gallery.add(rng.normal(size=(cap, dim)).astype(np.float32),
