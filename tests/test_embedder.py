@@ -201,3 +201,25 @@ def test_augmented_training_runs_and_improves_separation():
     same = y[:, None] == y[None, :]
     off_diag = ~np.eye(len(y), dtype=bool)
     assert sims[same & off_diag].mean() > sims[~same].mean() + 0.1
+
+
+def test_serving_default_constants_construct_and_run():
+    """SERVING_EMBEDDER_KWARGS/SERVING_FACE_SIZE (the accuracy-gated
+    serving default) must construct a net whose forward works at the
+    gated input size and L2-normalizes its embeddings."""
+    from opencv_facerecognizer_tpu.models.embedder import (
+        SERVING_EMBEDDER_KWARGS, SERVING_FACE_SIZE, FaceEmbedNet,
+        init_embedder, normalize_faces,
+    )
+
+    assert SERVING_FACE_SIZE == (64, 64)  # the gate protocol's resolution
+    net = FaceEmbedNet(**SERVING_EMBEDDER_KWARGS)
+    params = init_embedder(net, num_classes=4, input_shape=SERVING_FACE_SIZE,
+                           seed=0)["net"]
+    x = np.random.default_rng(0).uniform(0, 255, (2, *SERVING_FACE_SIZE))
+    emb = net.apply({"params": params},
+                    normalize_faces(jnp.asarray(x, jnp.float32),
+                                    SERVING_FACE_SIZE))
+    assert emb.shape == (2, SERVING_EMBEDDER_KWARGS["embed_dim"])
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(emb), axis=-1),
+                               1.0, atol=1e-3)
