@@ -1,0 +1,44 @@
+#!/bin/bash
+# Runs the full on-chip measurement queue in priority order, waiting for
+# the TPU backend to become reachable first (written during the round-4
+# axon tunnel outage; useful any time the artifacts need a full refresh):
+# accuracy row -> headline bench -> lifecycle -> trace -> dispatch
+# decomposition -> embedder sweep -> serving bench. Logs to
+# /tmp/chip_queue.log and /tmp/q_<job>.log.
+cd /root/repo
+export PYTHONPATH=/root/repo:$PYTHONPATH
+LOG=/tmp/chip_queue.log
+echo "queue start $(date)" >> $LOG
+
+# wait for the backend (probe every 60s)
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "TPU BACK $(date)" >> $LOG
+    break
+  fi
+  sleep 60
+done
+
+run() {
+  name=$1; shift
+  echo "=== $name start $(date)" >> $LOG
+  "$@" > /tmp/q_$name.log 2>&1
+  echo "=== $name exit=$? $(date)" >> $LOG
+}
+
+# 1. refresh the cnn accuracy row (fold_min; unblocks the band test)
+run cnn_measure python scripts/measure_accuracy.py --only cnn
+# 2. headline bench at the new serving default (+ per-batch attribution)
+run bench python bench.py
+# 3. lifecycle with async grow
+run lifecycle python scripts/bench_lifecycle.py
+# 4. profiler trace summary
+run trace python scripts/trace_summary.py
+# 5. dispatch decomposition (batch 8 = latency mode, batch 32 = headline)
+run dispatch8 python scripts/probe_dispatch.py --batch 8
+run dispatch32 python scripts/probe_dispatch.py --batch 32
+# 6. embedder sweep with @64 rows (mfu_exploration refresh)
+run sweep python scripts/explore_perf.py --skip-detector
+# 7. serving bench (latency model with new dispatch quote)
+run serving python bench_serving.py
+echo "queue done $(date)" >> $LOG

@@ -79,16 +79,25 @@ def test_fisherfaces_projection_separates_like_oracle():
     X = np.concatenate([means[i] + RNG.normal(size=(n_per, d))
                         for i in range(c)]).astype(np.float32)
     y = np.repeat(np.arange(c), n_per)
-    mean_o, W_o = fisherfaces_fit_np(X.astype(np.float64), y)
-    Z_o = (X - mean_o) @ W_o
-    preds_o = nn_classify_np(Z_o, y, Z_o, "euclidean")
+    # hold out 2 samples per class: self-matches at distance 0 would make
+    # a train-on-train comparison tautological
+    test_mask = np.zeros(len(y), bool)
+    for cls in range(c):
+        test_mask[np.flatnonzero(y == cls)[:2]] = True
+    Xtr, ytr = X[~test_mask], y[~test_mask]
+    Xte, yte = X[test_mask], y[test_mask]
+
+    mean_o, W_o = fisherfaces_fit_np(Xtr.astype(np.float64), ytr)
+    preds_o = nn_classify_np((Xtr - mean_o) @ W_o, ytr,
+                             (Xte - mean_o) @ W_o, "euclidean")
     # framework: PCA(N-c) then LDA(c-1), as models.feature.Fisherfaces does
     from opencv_facerecognizer_tpu.models.feature import Fisherfaces
 
     ff = Fisherfaces()
-    Z_f = np.asarray(ff.compute(X.reshape(c * n_per, 8, 8), y))
-    preds_f = nn_classify_np(Z_f, y, Z_f, "euclidean")
-    # both projections must give (near-)perfect self-classification on
-    # separable data — the end-to-end agreement bar
-    assert (preds_o == y).mean() == 1.0
-    assert (preds_f == y).mean() == 1.0
+    Ztr_f = np.asarray(ff.compute(Xtr.reshape(len(ytr), 8, 8), ytr))
+    Zte_f = np.asarray(ff.extract(Xte.reshape(len(yte), 8, 8)))
+    preds_f = nn_classify_np(Ztr_f, ytr, Zte_f, "euclidean")
+    # both projections must classify HELD-OUT points of separable classes
+    # perfectly — the end-to-end agreement bar
+    assert (preds_o == yte).mean() == 1.0
+    assert (preds_f == yte).mean() == 1.0
