@@ -100,6 +100,26 @@ def main():
     from opencv_facerecognizer_tpu.ops import image as image_ops
     from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
 
+    # Deadline-bounded backend check BEFORE any in-process backend init: the
+    # axon tunnel's hang-mode (round-4 outage) makes a bare jax.devices()
+    # block forever, and its fast-fail mode dies in a raw traceback. Either
+    # way the driver should get ONE structured JSON line saying the backend
+    # is down, promptly (rc=3 distinguishes "backend down, nothing measured"
+    # from a real bench crash).
+    from opencv_facerecognizer_tpu.utils.backend_probe import probe_default_backend
+
+    # allow_cpu=False: a silent fallback to the CPU backend must fast-fail
+    # too — a faces/sec/CHIP number measured on host CPU would be a lie.
+    usable, reason = probe_default_backend(min_devices=1, allow_cpu=False)
+    if not usable:
+        print(json.dumps({
+            "metric": "faces_per_sec_per_chip", "value": None,
+            "unit": "faces/sec/chip", "vs_baseline": None,
+            "error": "backend_unavailable", "reason": reason,
+        }))
+        _log(f"backend unavailable ({reason}); structured fast-fail")
+        sys.exit(3)
+
     dev = jax.devices()[0]
     _log(f"device: {dev}")
 

@@ -1,0 +1,115 @@
+"""Deadline-bounded usability probe of the default JAX backend.
+
+The tunneled TPU backend on this machine has two distinct failure modes
+(both observed during the round-4 outage):
+
+1. **fast-fail** — backend init raises ``UNAVAILABLE`` immediately;
+2. **hang-mode** — ``jax.devices()`` blocks forever (the local relay
+   accepts the TCP connection but the pool side never answers).
+
+Mode 2 is the dangerous one: any probe that touches backend init *in the
+calling process* inherits the hang, so a CPU-only dryrun that merely wanted
+to ask "is the real backend usable?" dies by timeout behind a dead TPU it
+never needed. The fix is structural: the probe runs in a **subprocess with
+a deadline**. The child is the only process that risks backend init; if it
+hangs, it is killed at the deadline and the caller falls back cleanly.
+
+Used by ``__graft_entry__.dryrun_multichip`` (multi-chip validation must be
+producible with the accelerator unplugged) and ``bench.py`` (a dead backend
+yields a structured fast-fail artifact, not a 10-minute hang + traceback).
+Mirrors the reference's failure-detection posture (SURVEY.md §5.3): health
+checks are bounded, and an unhealthy accelerator degrades the job, never
+wedges it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+# Hard override: skip the probe entirely and report the backend unusable,
+# sending callers straight to their CPU fallback. For driver/ops use when
+# the backend is known-dead and even the bounded probe's deadline is
+# unwanted latency. Deliberately affects EVERY probe consumer: the dryrun
+# falls back to virtual CPU devices, and bench.py / the measurement queue
+# fast-fail with this env var named in the artifact's reason field — a TPU
+# benchmark under a forced-CPU override would be meaningless, so refusing
+# loudly beats measuring the wrong thing.
+FORCE_CPU_ENV = "OCVF_DRYRUN_FORCE_CPU"
+TIMEOUT_ENV = "OCVF_BACKEND_PROBE_TIMEOUT_S"
+# First axon init on a healthy tunnel takes ~10-20 s; 60 s separates
+# "slow init" from "hang-mode" with wide margin.
+DEFAULT_TIMEOUT_S = 60.0
+
+# Child exit codes (anything else = init/exec raised).
+_RC_OK = 0
+_RC_TOO_FEW_DEVICES = 3
+_RC_CPU_FALLBACK = 4
+
+
+def _probe_source(min_devices: int, allow_cpu: bool) -> str:
+    """Child source: init the default backend, count devices, run one eager
+    op (round-1 driver failure: axon init succeeded but the first op raised
+    a libtpu version mismatch — init success alone proves nothing). With
+    ``allow_cpu=False`` the child additionally rejects an all-CPU default
+    backend: a silent JAX fallback to CPU would otherwise make a dead TPU
+    probe as "usable" and a benchmark would quietly measure the wrong
+    hardware under a per-chip metric name."""
+    lines = [
+        "import sys",
+        "import jax",
+        "import jax.numpy as jnp",
+        f"if len(jax.devices()) < {int(min_devices)}:",
+        f"    sys.exit({_RC_TOO_FEW_DEVICES})",
+    ]
+    if not allow_cpu:
+        lines += [
+            "if all(d.platform == 'cpu' for d in jax.devices()):",
+            f"    sys.exit({_RC_CPU_FALLBACK})",
+        ]
+    lines += [
+        "jax.block_until_ready(jnp.zeros((), jnp.float32) + 1)",
+        f"sys.exit({_RC_OK})",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def probe_default_backend(
+    min_devices: int = 1,
+    timeout_s: float | None = None,
+    probe_source: str | None = None,
+    allow_cpu: bool = True,
+) -> tuple[bool, str]:
+    """Return ``(usable, reason)`` for the default backend, never hanging.
+
+    ``allow_cpu=False`` rejects an all-CPU default backend (accelerator
+    benchmarks); the default tolerates CPU because the dryrun genuinely
+    wants whatever default backend has enough devices, including a forced
+    host platform. ``probe_source`` overrides the child program (tests
+    inject a sleeping child to simulate hang-mode without a dead tunnel).
+    """
+    if os.environ.get(FORCE_CPU_ENV, "") not in ("", "0"):
+        return False, f"{FORCE_CPU_ENV} override set"
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(TIMEOUT_ENV, DEFAULT_TIMEOUT_S))
+    source = (probe_source if probe_source is not None
+              else _probe_source(min_devices, allow_cpu))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", source],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"probe exceeded {timeout_s:.0f}s deadline (backend hang-mode)"
+    except OSError as exc:
+        return False, f"probe spawn failed: {exc}"
+    if proc.returncode == _RC_OK:
+        return True, "ok"
+    if proc.returncode == _RC_TOO_FEW_DEVICES:
+        return False, f"backend has fewer than {min_devices} devices"
+    if proc.returncode == _RC_CPU_FALLBACK:
+        return False, "default backend is CPU (accelerator missing or fell back)"
+    return False, f"backend init/first-op failed (probe rc={proc.returncode})"

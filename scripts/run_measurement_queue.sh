@@ -1,28 +1,84 @@
 #!/bin/bash
-# Runs the full on-chip measurement queue in priority order, waiting for
-# the TPU backend to become reachable first (written during the round-4
-# axon tunnel outage; useful any time the artifacts need a full refresh):
-# accuracy row -> headline bench -> lifecycle -> trace -> dispatch
-# decomposition -> embedder sweep -> serving bench. Logs to
-# /tmp/chip_queue.log and /tmp/q_<job>.log.
+# Runs the full on-chip measurement queue in priority order, waiting (with a
+# BOUNDED budget) for the TPU backend to become reachable first. Written
+# during the round-4 axon tunnel outage; useful any time the artifacts need
+# a full refresh: accuracy row -> headline bench -> lifecycle -> trace ->
+# dispatch decomposition -> embedder sweep -> serving bench.
+#
+# Supervision (round-5 hardening of the round-4 fire-and-forget loop):
+# - bounded WAIT budget OCVF_QUEUE_MAX_WAIT_S (default 6h): cumulative time
+#   spent waiting for the backend (probe time + sleeps; job runtime is NOT
+#   charged — a long healthy queue must not trip a spurious give-up late);
+#   on exhaustion the queue exits rc=3 with a GIVE-UP log line;
+# - backend usability is owned by utils/backend_probe.py (same deadline
+#   semantics and env knobs as bench.py / the dryrun, allow_cpu=False since
+#   every job here is an on-chip measurement) and re-checked before EVERY
+#   job (two processes sharing the one chip serialize and look like hangs —
+#   a mid-queue outage must pause the queue, not let a job time out against
+#   a dead or busy backend);
+# - OCVF_DRYRUN_FORCE_CPU set => refuse immediately with the env var named
+#   (waiting 6h to report "backend down" would misdiagnose an env override);
+# - each job gets a hard timeout so one wedged job cannot eat the queue.
+#
+# Relaunch: this script is idempotent — each job overwrites its own
+# artifact. To (re)start:   nohup bash scripts/run_measurement_queue.sh &
+# Progress: tail -f /tmp/chip_queue.log ; per-job logs /tmp/q_<job>.log
 cd /root/repo
 export PYTHONPATH=/root/repo:$PYTHONPATH
 LOG=/tmp/chip_queue.log
-echo "queue start $(date)" >> $LOG
+MAX_WAIT_S=${OCVF_QUEUE_MAX_WAIT_S:-21600}
+JOB_TIMEOUT_S=${OCVF_QUEUE_JOB_TIMEOUT_S:-5400}
+WAITED_ACC=0
+GAVE_UP=0
+BACK_LOGGED=0
+echo "queue start $(date) (wait budget ${MAX_WAIT_S}s, job timeout ${JOB_TIMEOUT_S}s)" >> $LOG
 
-# wait for the backend (probe every 60s)
-while true; do
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "TPU BACK $(date)" >> $LOG
-    break
+if [ -n "$OCVF_DRYRUN_FORCE_CPU" ] && [ "$OCVF_DRYRUN_FORCE_CPU" != "0" ]; then
+  echo "REFUSED: OCVF_DRYRUN_FORCE_CPU is set — on-chip queue cannot run under a forced-CPU override $(date)" >> $LOG
+  exit 3
+fi
+
+probe() {
+  # One source of truth for "backend usable": the same subprocess-with-
+  # deadline probe bench.py and the dryrun use (honors
+  # OCVF_BACKEND_PROBE_TIMEOUT_S identically). allow_cpu=False: a silent
+  # CPU fallback must read as "down", not launch CPU measurements.
+  python -c "from opencv_facerecognizer_tpu.utils.backend_probe import probe_default_backend; import sys; sys.exit(0 if probe_default_backend(allow_cpu=False)[0] else 1)" >/dev/null 2>&1
+}
+
+# Wait for the backend, charging probe time + sleeps (NOT job runtime)
+# against the shared budget. Returns 1 on exhaustion. Logs TPU BACK once.
+wait_for_backend() {
+  [ $GAVE_UP -eq 1 ] && return 1
+  local t0=$(date +%s)
+  while ! probe; do
+    BACK_LOGGED=0  # backend observed down: log recovery when it returns
+    if [ $(( WAITED_ACC + $(date +%s) - t0 )) -ge "$MAX_WAIT_S" ]; then
+      echo "GIVE UP: backend still down after $(( WAITED_ACC + $(date +%s) - t0 ))s cumulative wait $(date)" >> $LOG
+      GAVE_UP=1
+      return 1
+    fi
+    sleep 60
+  done
+  WAITED_ACC=$(( WAITED_ACC + $(date +%s) - t0 ))
+  if [ $BACK_LOGGED -eq 0 ]; then
+    echo "TPU BACK (cumulative wait ${WAITED_ACC}s) $(date)" >> $LOG
+    BACK_LOGGED=1
   fi
-  sleep 60
-done
+  return 0
+}
 
 run() {
   name=$1; shift
+  # Re-verify the backend is up AND idle before every job: a job launched
+  # into a dead tunnel burns its whole timeout; one launched while another
+  # process holds the chip serializes behind it and looks hung.
+  if ! wait_for_backend; then
+    echo "=== $name SKIPPED (backend down, budget exhausted) $(date)" >> $LOG
+    return
+  fi
   echo "=== $name start $(date)" >> $LOG
-  "$@" > /tmp/q_$name.log 2>&1
+  timeout $JOB_TIMEOUT_S "$@" > /tmp/q_$name.log 2>&1
   echo "=== $name exit=$? $(date)" >> $LOG
 }
 
@@ -41,4 +97,8 @@ run dispatch32 python scripts/probe_dispatch.py --batch 32
 run sweep python scripts/explore_perf.py --skip-detector
 # 7. serving bench (latency model with new dispatch quote)
 run serving python bench_serving.py
+if [ $GAVE_UP -eq 1 ]; then
+  echo "queue gave up (budget exhausted; some jobs skipped) $(date)" >> $LOG
+  exit 3
+fi
 echo "queue done $(date)" >> $LOG

@@ -1,0 +1,122 @@
+"""Deadline-bounded backend probe: a hung accelerator init must never wedge
+the caller (MULTICHIP_r04 rc=124 postmortem — the round-4 axon outage had a
+hang-mode where ``jax.devices()`` blocked forever and the in-process probe
+took the CPU-only dryrun down with it). SURVEY.md §5.3 failure handling."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from opencv_facerecognizer_tpu.utils import backend_probe
+
+
+@pytest.fixture()
+def clean_env(monkeypatch):
+    """The override env var must not leak into tests from the ambient shell
+    (the documented outage workflow exports it)."""
+    monkeypatch.delenv(backend_probe.FORCE_CPU_ENV, raising=False)
+
+
+def test_hanging_probe_is_killed_at_deadline(clean_env):
+    """Simulated hang-mode: the child sleeps far past the deadline; the
+    caller must return (False, hang reason) promptly instead of blocking."""
+    t0 = time.perf_counter()
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=1.5, probe_source="import time; time.sleep(60)"
+    )
+    elapsed = time.perf_counter() - t0
+    assert not usable
+    assert "deadline" in reason
+    assert elapsed < 10.0  # killed at ~1.5s, not after the child's 60s
+
+
+def test_healthy_probe_reports_usable(clean_env):
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=30.0, probe_source="import sys; sys.exit(0)"
+    )
+    assert usable and reason == "ok"
+
+
+def test_too_few_devices_rc_maps_to_reason(clean_env):
+    usable, reason = backend_probe.probe_default_backend(
+        min_devices=8, timeout_s=30.0, probe_source="import sys; sys.exit(3)"
+    )
+    assert not usable
+    assert "fewer than 8" in reason
+
+
+def test_cpu_fallback_rejected_when_disallowed(clean_env):
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=30.0, allow_cpu=False, probe_source="import sys; sys.exit(4)"
+    )
+    assert not usable
+    assert "CPU" in reason
+
+
+def test_cpu_fallback_source_detects_cpu_backend(clean_env):
+    """Real child (not injected): under this box's forced-CPU test backend
+    the allow_cpu=False source must reject with the CPU reason, proving the
+    platform check works against an actual silent-CPU default."""
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=120.0,
+        allow_cpu=False,
+        probe_source=(
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            + backend_probe._probe_source(1, allow_cpu=False)
+        ),
+    )
+    assert not usable
+    assert "CPU" in reason
+
+
+def test_init_failure_rc_maps_to_reason(clean_env):
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=30.0, probe_source="import sys; sys.exit(7)"
+    )
+    assert not usable
+    assert "rc=7" in reason
+
+
+def test_force_cpu_env_skips_probe(monkeypatch):
+    """The override must short-circuit without spawning anything — it exists
+    for when even the bounded deadline is unwanted latency."""
+    monkeypatch.setenv(backend_probe.FORCE_CPU_ENV, "1")
+    t0 = time.perf_counter()
+    usable, reason = backend_probe.probe_default_backend(
+        timeout_s=30.0, probe_source="import time; time.sleep(60)"
+    )
+    assert not usable
+    assert backend_probe.FORCE_CPU_ENV in reason
+    assert time.perf_counter() - t0 < 0.5
+
+
+def test_dryrun_probe_falls_back_without_touching_backend(monkeypatch):
+    """__graft_entry__'s usability gate must route through the subprocess
+    probe (env override honored => no in-process backend init to hang)."""
+    import __graft_entry__ as ge
+
+    monkeypatch.setenv(backend_probe.FORCE_CPU_ENV, "1")
+    assert ge._default_backend_usable(8) is False
+
+
+@pytest.mark.slow
+def test_bench_fast_fails_structured_when_backend_down():
+    """bench.py with the backend forced-unusable must emit ONE structured
+    JSON line (error=backend_unavailable) and exit rc=3 quickly — not hang,
+    not traceback (BENCH_r04.json failure mode)."""
+    env = dict(os.environ)
+    env[backend_probe.FORCE_CPU_ENV] = "1"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+    )
+    assert proc.returncode == 3, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["error"] == "backend_unavailable"
+    assert payload["value"] is None
