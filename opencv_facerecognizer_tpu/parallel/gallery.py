@@ -175,6 +175,15 @@ class GalleryData(NamedTuple):
     valid: jnp.ndarray  # [capacity], P(tp)
     size: int
 
+    @property
+    def capacity(self) -> int:
+        """Tier of THIS snapshot. Cache keys must derive from the snapshot
+        (not ``gallery.capacity``) so a concurrent grow can never pair one
+        tier's compiled step with another tier's arrays — the mixed pairing
+        forces an XLA retrace on the serving thread, the exact stall
+        async-grow prewarm exists to avoid."""
+        return int(self.embeddings.shape[0])
+
 
 class ShardedGallery:
     """Enrolled gallery of L2-normalized embeddings, row-sharded over tp."""
@@ -227,6 +236,13 @@ class ShardedGallery:
         #: thread BEFORE the grown snapshot is installed — the fused
         #: pipeline registers its step-compile here (parallel.pipeline).
         self.prewarm_hooks = []
+        #: callables invoked with a capacity THRESHOLD after a grow
+        #: publishes: pipelines drop compiled entries for tiers strictly
+        #: below it. Growing A->B->C evicts A's executables when C installs
+        #: (B survives for readers that took their snapshot before C) —
+        #: without this, crossing 16k->1M (7 tiers x shapes x dtypes)
+        #: permanently retains every stale tier's executables.
+        self.evict_hooks = []
         self._pending: list = []  # [(emb_rows, lab_rows)] staged enrolments
         self._pending_count = 0
         self._growing = False
@@ -301,6 +317,7 @@ class ShardedGallery:
         labels = np.asarray(labels, np.int32)
         n = embeddings.shape[0]
         start_worker = False
+        evict_below = None
         with self._write_lock:
             size = self.size
             if self.async_grow and (self._growing or self._pending
@@ -319,6 +336,7 @@ class ShardedGallery:
                     start_worker = True
             else:
                 if size + n > self.capacity:
+                    evict_below = self.capacity  # tier being replaced
                     self._grow_locked(size + n)
                 # Host mirrors are the source of truth for enrolment: a
                 # device readback here would trigger the axon backend's
@@ -328,6 +346,8 @@ class ShardedGallery:
                 self._host_val[size : size + n] = True
                 self._install(self._host_emb, self._host_lab, self._host_val,
                               size + n)
+        if evict_below is not None:
+            self._evict_stale(evict_below)
         if start_worker:
             self._grow_thread = threading.Thread(
                 target=self._grow_worker, daemon=True, name="gallery-grow"
@@ -472,6 +492,9 @@ class ShardedGallery:
                     t0 = _time.perf_counter()
                     self._install(emb, lab, val, pos)
                     info["install_s"] = round(_time.perf_counter() - t0, 3)
+                # Outside the lock: drop compiled entries for tiers below
+                # the one just replaced (see evict_hooks).
+                self._evict_stale(old_cap)
         except Exception as e:  # never leave waiters hanging
             info["error"] = repr(e)
             with self._write_lock:
@@ -496,6 +519,21 @@ class ShardedGallery:
         self._host_emb, self._host_lab, self._host_val = emb, lab, val
         self.capacity = new_capacity
         self.grow_count += 1
+
+    def _evict_stale(self, below_capacity: int) -> None:
+        """Drop compiled executables for tiers strictly below
+        ``below_capacity`` — called after a grow publishes, with the
+        REPLACED tier as threshold, so the previous tier survives for any
+        reader still holding its snapshot while everything older is freed.
+        Safe without the write lock: dict mutation is atomic under the GIL
+        and an in-flight call already holds its function reference."""
+        for key in [k for k in list(self._match_cache) if k[1] < below_capacity]:
+            self._match_cache.pop(key, None)
+        for hook in list(self.evict_hooks):
+            try:
+                hook(below_capacity)
+            except Exception:  # eviction is best-effort bookkeeping;
+                pass  # serving must never die to a cleanup hook
 
     def reset(self) -> None:
         with self._write_lock:
@@ -590,18 +628,23 @@ class ShardedGallery:
             return fn
         return functools.partial(match_global, k=k, mesh=self.mesh)
 
-    def _matcher(self, k: int):
-        # Keyed by (k, capacity/pallas): a grow changes the static gallery
-        # shape, but the old tier's compiled matcher stays valid for any
-        # in-flight readers and the new tier gets its own entry (no
-        # clear() — prewarmed entries survive the swap).
-        key = (k, self.capacity, self._pallas_enabled())
+    def _matcher(self, k: int, data: GalleryData):
+        # Keyed by (k, capacity/pallas) DERIVED FROM THE SNAPSHOT being
+        # matched — a separate self.capacity read could straddle a
+        # concurrent grow and pair tier B's key with tier A's arrays
+        # (pipeline._step_key has the same rule). A grow changes the
+        # static gallery shape, but the old tier's compiled matcher stays
+        # valid for any in-flight readers and the new tier gets its own
+        # entry (eviction in _evict_stale, not clear() — prewarmed entries
+        # survive the swap).
+        capacity = data.capacity
+        key = (k, capacity, self._pallas_enabled(capacity))
         if key not in self._match_cache:
-            if self._pallas_enabled():
-                fn = jax.jit(self.match_fn(k))
+            if self._pallas_enabled(capacity):
+                fn = jax.jit(self.match_fn(k, capacity))
             else:
                 fn = jax.jit(
-                    self.match_fn(k),
+                    self.match_fn(k, capacity),
                     in_shardings=(
                         NamedSharding(self.mesh, P(DP_AXIS, None)),
                         self._emb_sharding,
@@ -622,4 +665,5 @@ class ShardedGallery:
         if queries.shape[0] % dp:
             raise ValueError(f"query count {queries.shape[0]} not divisible by dp={dp}")
         data = self._data  # one snapshot read; never mix fields across writes
-        return self._matcher(int(k))(queries, data.embeddings, data.valid, data.labels)
+        return self._matcher(int(k), data)(
+            queries, data.embeddings, data.valid, data.labels)

@@ -91,8 +91,11 @@ class RecognitionPipeline:
         # imminent/in flight, the worker thread compiles THIS pipeline's
         # step for the target capacity before the swap is published, so
         # the serving thread's first call at the new tier finds a warm
-        # cache instead of paying the XLA recompile (SURVEY.md §5.3).
+        # cache instead of paying the XLA recompile (SURVEY.md §5.3) —
+        # and after a later grow publishes, stale tiers' executables are
+        # dropped (evict_hooks) instead of accumulating forever.
         gallery.prewarm_hooks.append(self.prewarm_capacity)
+        gallery.evict_hooks.append(self.evict_below)
 
     def _build_step(self, batch: int, height: int, width: int,
                     capacity: Optional[int] = None):
@@ -142,14 +145,20 @@ class RecognitionPipeline:
         frames_sharding = NamedSharding(mesh, P(DP_AXIS, None, None))
         return jax.jit(step, in_shardings=(None, None, None, None, None, frames_sharding))
 
-    def _step_key(self, frames: jnp.ndarray):
+    def _step_key(self, frames: jnp.ndarray, data) -> Tuple:
         # Gallery capacity (and with it the pallas/GSPMD selection) can
         # change at runtime via auto-grow — bake both into the cache key so
         # a grown gallery re-selects its matcher instead of re-tracing the
-        # old closure at the new shapes. Input dtype is a trace shape too
-        # (uint8 fast transfer vs f32).
-        return (*frames.shape, str(frames.dtype), self.gallery.capacity,
-                self.gallery._pallas_enabled())
+        # old closure at the new shapes. Both derive from the SAME
+        # GalleryData snapshot the call will feed: reading
+        # ``gallery.capacity`` separately could pair a stale key with
+        # new-tier arrays across a concurrent grow install, forcing the
+        # retrace (and, with GSPMD at 1M rows, the [Q, capacity] HBM
+        # materialization) that prewarm exists to avoid. Input dtype is a
+        # trace shape too (uint8 fast transfer vs f32).
+        capacity = data.capacity
+        return (*frames.shape, str(frames.dtype), capacity,
+                self.gallery._pallas_enabled(capacity))
 
     @staticmethod
     def _as_device_frames(frames) -> jnp.ndarray:
@@ -165,10 +174,11 @@ class RecognitionPipeline:
         divide by dp size, and B * max_faces must too (it does when B
         does)."""
         frames = self._as_device_frames(frames)
-        key = self._step_key(frames)
-        if key not in self._step_cache:
-            self._step_cache[key] = self._build_step(*frames.shape)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
+        key = self._step_key(frames, data)
+        if key not in self._step_cache:
+            self._step_cache[key] = self._build_step(*frames.shape,
+                                                     capacity=data.capacity)
         return self._step_cache[key](
             self.detector.params,
             self.embed_params,
@@ -183,17 +193,18 @@ class RecognitionPipeline:
         [B, K, 6 + 2k] f32 array (see ``pack_result``) — the serving loop's
         single-readback path. Decode host-side with ``unpack_result``."""
         frames = self._as_device_frames(frames)
-        key = self._step_key(frames)
+        data = self.gallery.data  # one atomic snapshot (see GalleryData)
+        key = self._step_key(frames, data)
         if key not in self._packed_cache:
             step = self._step_cache.get(key)
             if step is None:
-                step = self._step_cache[key] = self._build_step(*frames.shape)
+                step = self._step_cache[key] = self._build_step(
+                    *frames.shape, capacity=data.capacity)
 
             def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr):
                 return pack_result(step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
 
             self._packed_cache[key] = jax.jit(packed_step)
-        data = self.gallery.data
         return self._packed_cache[key](
             self.detector.params,
             self.embed_params,
@@ -210,11 +221,14 @@ class RecognitionPipeline:
         thread) for every frame-shape/dtype the pipeline has already
         served. Compilation is forced by executing each newly built step
         once against zero-filled scratch gallery arrays of the target
-        tier; the jit executable lands in the same function caches the
+        tier; the jit executables land in the same function caches the
         serving thread will hit after the swap (``_step_key`` includes
         capacity + matcher selection, so the entries are keyed exactly as
-        the post-grow lookups). Scratch arrays are dropped afterwards —
-        only the compiled executables persist.
+        the post-grow lookups). BOTH paths are executed — the packed
+        single-readback step and the unpacked ``recognize_batch`` step are
+        separate XLA executables, so warming only one would leave the
+        other's first post-grow call paying the full compile. Scratch
+        arrays are dropped afterwards — only the executables persist.
         """
         g = self.gallery
         pallas = g._pallas_enabled(capacity)
@@ -241,17 +255,30 @@ class RecognitionPipeline:
             if step is None:
                 step = self._build_step(batch, height, width, capacity)
                 self._step_cache[new_key] = step
+            frames = jnp.zeros((batch, height, width), dtype=dtype)
+            # Execute each once: jit compiles per concrete shape; block so
+            # the caller (grow worker) only installs AFTER compiles landed.
+            jax.block_until_ready(step(
+                self.detector.params, self.embed_params,
+                scratch_emb, scratch_val, scratch_lab, frames,
+            ))
 
             def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr,
                             _step=step):
                 return pack_result(_step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
 
             packed = jax.jit(packed_step)
-            frames = jnp.zeros((batch, height, width), dtype=dtype)
-            # Execute once: jit compiles per concrete shape; block so the
-            # caller (grow worker) only installs AFTER the compile landed.
             packed(
                 self.detector.params, self.embed_params,
                 scratch_emb, scratch_val, scratch_lab, frames,
             ).block_until_ready()
             self._packed_cache[new_key] = packed
+
+    def evict_below(self, min_capacity: int) -> None:
+        """Drop compiled steps for gallery tiers strictly below
+        ``min_capacity`` (called from the gallery after a later grow
+        publishes — see ``ShardedGallery.evict_hooks``). In-flight calls
+        already hold their function references; only the cache forgets."""
+        for cache in (self._step_cache, self._packed_cache):
+            for key in [k for k in list(cache) if k[4] < min_capacity]:
+                cache.pop(key, None)

@@ -139,8 +139,10 @@ class TwoStagePipeline:
         self._pack = jax.jit(pack_result)  # once: serving hot-loop path
         # Same off-the-serving-path warm contract as RecognitionPipeline:
         # the gallery's grow worker compiles stage B for the target tier
-        # before publishing the swap.
+        # before publishing the swap, and stale tiers' executables are
+        # dropped after a later grow publishes.
         gallery.prewarm_hooks.append(self.prewarm_capacity)
+        gallery.evict_hooks.append(self.evict_below)
 
     def prewarm_capacity(self, capacity: int) -> None:
         """Compile stage B for a FUTURE gallery capacity (grow-worker
@@ -187,13 +189,17 @@ class TwoStagePipeline:
                      crops)
             jax.block_until_ready(out)
 
-    def _stage_b_fn(self):
-        """Compiled stage B for the gallery's CURRENT capacity/matcher —
+    def _stage_b_fn(self, data):
+        """Compiled stage B for the given snapshot's capacity/matcher —
         auto-grow changes both, so key the cache like
-        ``RecognitionPipeline._step_key`` does."""
-        key = (self.gallery.capacity, self.gallery._pallas_enabled())
+        ``RecognitionPipeline._step_key`` does, deriving capacity from the
+        SAME GalleryData snapshot the call will feed (a separate
+        ``gallery.capacity`` read could straddle a concurrent grow
+        install and pair a stale key with new-tier arrays)."""
+        capacity = data.capacity
+        key = (capacity, self.gallery._pallas_enabled(capacity))
         if key not in self._b_cache:
-            match = self.gallery.match_fn(self.top_k)
+            match = self.gallery.match_fn(self.top_k, capacity)
             embed_net = self.embed_net
             face_size = self.face_size
             k = self.top_k
@@ -210,6 +216,12 @@ class TwoStagePipeline:
 
             self._b_cache[key] = jax.jit(stage_b)
         return self._b_cache[key]
+
+    def evict_below(self, min_capacity: int) -> None:
+        """Drop stage-B executables for gallery tiers strictly below
+        ``min_capacity`` (see ``ShardedGallery.evict_hooks``)."""
+        for key in [k for k in list(self._b_cache) if k[0] < min_capacity]:
+            self._b_cache.pop(key, None)
 
     def _submit_a(self, frames):
         frames = jnp.asarray(frames)
@@ -233,7 +245,7 @@ class TwoStagePipeline:
         boxes, det_scores, valid, crops_b = hopped
         self._served_crop_shapes.add(tuple(crops_b.shape))
         data = self.gallery.data  # one atomic snapshot per batch (live)
-        labels, sims = self._stage_b_fn()(
+        labels, sims = self._stage_b_fn(data)(
             self._emb_params, data.embeddings, data.valid, data.labels,
             crops_b,
         )

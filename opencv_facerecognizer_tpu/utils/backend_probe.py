@@ -92,7 +92,12 @@ def probe_default_backend(
     if os.environ.get(FORCE_CPU_ENV, "") not in ("", "0"):
         return False, f"{FORCE_CPU_ENV} override set"
     if timeout_s is None:
-        timeout_s = float(os.environ.get(TIMEOUT_ENV, DEFAULT_TIMEOUT_S))
+        try:
+            timeout_s = float(os.environ.get(TIMEOUT_ENV, DEFAULT_TIMEOUT_S))
+        except ValueError:
+            # A config typo must not turn the structured fast-fail into a
+            # raw traceback (or eat the queue's wait budget) — fall back.
+            timeout_s = DEFAULT_TIMEOUT_S
     source = (probe_source if probe_source is not None
               else _probe_source(min_devices, allow_cpu))
     try:

@@ -77,6 +77,32 @@ def build_pipeline(batch, h, w, max_faces, dim, tiny=False):
     return pipe, frames
 
 
+def _line_self_times(events):
+    """True per-op SELF time for one trace line: each event's duration minus
+    the durations of events nested directly inside it. Summing raw
+    durations would double-count nested events (a parent op enclosing its
+    children on the same line), inflating top-op totals relative to the
+    busy-fraction path, which unions intervals. Assumes proper nesting
+    within a line, which xplane guarantees per-line."""
+    self_ns = defaultdict(int)
+    stack = []  # [end_ns, name, duration_ns, direct_child_ns]
+
+    def _close(frame):
+        end, name, dur, child_ns = frame
+        self_ns[name] += max(dur - child_ns, 0)
+        if stack:
+            stack[-1][3] += dur  # charge full duration to direct parent
+
+    for e in sorted(events, key=lambda e: (e.start_ns, -e.end_ns)):
+        dur = e.duration_ns or max(e.end_ns - e.start_ns, 0)
+        while stack and stack[-1][0] <= e.start_ns:
+            _close(stack.pop())
+        stack.append([e.end_ns, e.name, dur, 0])
+    while stack:
+        _close(stack.pop())
+    return self_ns
+
+
 def summarize_xspace(trace_dir, top_n=20):
     """Parse the newest .xplane.pb under trace_dir into {planes, per-plane
     busy fraction, top ops}. Works purely through jax.profiler.ProfileData."""
@@ -115,9 +141,9 @@ def summarize_xspace(trace_dir, top_n=20):
                 else:
                     cur_e = max(cur_e, e)
             busy += cur_e - cur_s
-            for e in events:
-                op_self_ns[e.name] += e.duration_ns or 0
-                total_event_ns += e.duration_ns or 0
+            for name, ns in _line_self_times(events).items():
+                op_self_ns[name] += ns
+                total_event_ns += ns
             lines_summary.append({
                 "line": line.name, "events": len(events),
                 "busy_ms": round(busy / 1e6, 3),
@@ -178,7 +204,9 @@ def main(argv=None):
         "the trace; steps dispatched back-to-back, ONE readback at the end "
         "so the tunnel's sync-poll floor sits outside the dispatch stream). "
         "busy_fraction is per trace line (union of event intervals / line "
-        "span); top_ops_ms aggregates event self-durations by op name."
+        "span); top_ops_ms aggregates TRUE self time by op name (each "
+        "event's duration minus its direct children's), so nested events "
+        "are not double-counted and totals are comparable to busy time."
     )
 
     if args.tiny:

@@ -404,8 +404,97 @@ def test_pipeline_prewarm_registers_and_compiles_future_tier():
           np.arange(32, 72, dtype=np.int32))  # overflow -> async grow
     assert g.wait_ready(timeout=60)
     assert g.capacity == 128
-    key = pipe._step_key(pipe._as_device_frames(frames))
+    key = pipe._step_key(pipe._as_device_frames(frames), g.data)
     assert key[4] == 128  # capacity baked into the serving cache key
     assert key in pipe._packed_cache  # prewarmed BEFORE the swap published
+    # BOTH executables are warm: recognize_batch (unpacked) must not pay a
+    # first-call compile after the grow either (ADVICE r4).
+    assert key in pipe._step_cache
     out1 = np.asarray(pipe.recognize_batch_packed(frames))
     assert out1.shape == out0.shape
+
+
+def test_step_key_derives_from_snapshot_not_live_gallery():
+    """The serving cache key must come from the SAME GalleryData snapshot
+    the call feeds: a grow installing between the snapshot read and a
+    separate gallery.capacity read would otherwise pair a stale key with
+    new-tier arrays (ADVICE r4 pipeline._step_key)."""
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder,
+    )
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+
+    import jax
+
+    mesh = make_mesh(dp=2, tp=4)
+    g = ShardedGallery(capacity=16, dim=16, mesh=mesh)
+    det = CNNFaceDetector(features=(8, 8), head_features=8, max_faces=2,
+                          score_threshold=0.0, space_to_depth=2)
+    det.load_params(det.net.init(jax.random.PRNGKey(0),
+                                 np.zeros((1, 64, 64)))["params"])
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
+                       stage_blocks=(1,))
+    emb_params = init_embedder(net, num_classes=4, input_shape=(32, 32),
+                               seed=0)["net"]
+    pipe = RecognitionPipeline(det, net, emb_params, g, face_size=(32, 32))
+    old_data = g.data  # reader's snapshot, taken pre-grow
+    emb = RNG.normal(size=(40, 16)).astype(np.float32)
+    g.add(emb, np.arange(40, dtype=np.int32))  # sync grow: 16 -> 64
+    assert g.capacity == 64
+    frames = jnp.zeros((2, 64, 64), jnp.float32)
+    # Key from the OLD snapshot names the OLD tier even though the live
+    # gallery has moved on — snapshot and key can never mix tiers.
+    assert pipe._step_key(frames, old_data)[4] == 16
+    assert pipe._step_key(frames, g.data)[4] == 64
+
+
+def test_grow_evicts_tiers_older_than_previous():
+    """Growing A->B->C drops tier-A compiled entries from the gallery match
+    cache and registered pipelines (B survives for in-flight readers):
+    without eviction, crossing many tiers retains every executable forever
+    (ADVICE r4 gallery._match_cache)."""
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder,
+    )
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+
+    import jax
+
+    mesh = make_mesh(dp=2, tp=4)
+    g = ShardedGallery(capacity=16, dim=16, mesh=mesh)
+    det = CNNFaceDetector(features=(8, 8), head_features=8, max_faces=2,
+                          score_threshold=0.0, space_to_depth=2)
+    det.load_params(det.net.init(jax.random.PRNGKey(0),
+                                 np.zeros((1, 64, 64)))["params"])
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
+                       stage_blocks=(1,))
+    emb_params = init_embedder(net, num_classes=4, input_shape=(32, 32),
+                               seed=0)["net"]
+    pipe = RecognitionPipeline(det, net, emb_params, g, face_size=(32, 32))
+    assert pipe.evict_below in g.evict_hooks
+
+    emb = RNG.normal(size=(8, 16)).astype(np.float32)
+    g.add(emb, np.arange(8, dtype=np.int32))
+    frames = np.zeros((2, 64, 64), np.float32)
+    pipe.recognize_batch(frames)  # compile at tier 16
+    g.match(jnp.asarray(emb[:4]), k=1)  # matcher cache entry at tier 16
+    assert any(k[4] == 16 for k in pipe._step_cache)
+    assert any(k[1] == 16 for k in g._match_cache)
+
+    g.add(RNG.normal(size=(16, 16)).astype(np.float32),
+          np.arange(8, 24, dtype=np.int32))  # grow 16 -> 32 (B)
+    # previous tier (16) must SURVIVE the first grow (in-flight readers)
+    assert any(k[4] == 16 for k in pipe._step_cache)
+    pipe.recognize_batch(frames)  # compile at tier 32
+    g.add(RNG.normal(size=(24, 16)).astype(np.float32),
+          np.arange(24, 48, dtype=np.int32))  # grow 32 -> 64 (C)
+    # tier 16 evicted everywhere; tier 32 (previous) survives
+    assert not any(k[4] == 16 for k in pipe._step_cache)
+    assert not any(k[4] == 16 for k in pipe._packed_cache)
+    assert not any(k[1] == 16 for k in g._match_cache)
+    assert any(k[4] == 32 for k in pipe._step_cache)
+    # serving still correct at the new tier
+    out = pipe.recognize_batch(frames)
+    assert np.asarray(out.labels).shape == (2, 2, 1)

@@ -1,0 +1,48 @@
+"""Self-time accounting for the profiler-trace summary (ADVICE r4: raw
+duration sums double-count nested events, inflating top-op totals relative
+to the interval-union busy fraction)."""
+
+import os
+import sys
+from collections import namedtuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from trace_summary import _line_self_times  # noqa: E402
+
+Ev = namedtuple("Ev", "name start_ns end_ns duration_ns")
+
+
+def _ev(name, start, end):
+    return Ev(name, start, end, end - start)
+
+
+def test_nested_child_charged_to_parent_once():
+    # parent [0,100] encloses child [10,30] and grandchild [12,20]
+    events = [_ev("parent", 0, 100), _ev("child", 10, 30), _ev("grand", 12, 20)]
+    self_ns = _line_self_times(events)
+    assert self_ns["grand"] == 8
+    assert self_ns["child"] == 20 - 8  # child minus grandchild
+    assert self_ns["parent"] == 100 - 20  # parent minus DIRECT child only
+    # invariant: self times sum to the union of intervals (== busy time)
+    assert sum(self_ns.values()) == 100
+
+
+def test_siblings_do_not_interfere():
+    events = [_ev("p", 0, 50), _ev("a", 5, 15), _ev("b", 20, 40)]
+    self_ns = _line_self_times(events)
+    assert self_ns["a"] == 10 and self_ns["b"] == 20
+    assert self_ns["p"] == 50 - 10 - 20
+    assert sum(self_ns.values()) == 50
+
+
+def test_sequential_top_level_events_unchanged():
+    events = [_ev("x", 0, 10), _ev("y", 10, 25), _ev("x", 30, 35)]
+    self_ns = _line_self_times(events)
+    assert self_ns["x"] == 15  # same-name events aggregate
+    assert self_ns["y"] == 15
+
+
+def test_empty_line():
+    assert _line_self_times([]) == {}
