@@ -192,6 +192,87 @@ class FaceEmbedNet(nn.Module):
         return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
 
 
+def fused_forward(net: "FaceEmbedNet", params: Dict[str, Any],
+                  x: jnp.ndarray, *, interpret: bool = False,
+                  block_b: int = 8) -> jnp.ndarray:
+    """Serving-only fused forward of a separable ``FaceEmbedNet``: same
+    params, same math, different schedule.
+
+    Stage blocks run as one pallas call each (``ops.pallas_sepblock`` —
+    the activation never leaves VMEM inside a block, and the depthwise
+    conv avoids XLA's grouped-conv lowering); the GDC runs as an einsum
+    (``nhwc,hwc->nc`` — a multiply+reduce instead of a C-group grouped
+    convolution); stem conv and embedding head stay XLA (dense convs and
+    matmuls are already MXU-native). Training and the accuracy gate keep
+    the flax graph — this path only re-schedules inference, and
+    tests/test_pallas_sepblock.py pins the numerical equivalence
+    (cosine > 0.9999 against ``net.apply``).
+
+    Mirrors ``FaceEmbedNet.__call__``'s stride/naming scheme exactly
+    (params: Conv_0/GroupNorm_0 stem, _SepBlock_i blocks, Conv_1 GDC,
+    Dense_0 head); raises for configs it does not cover rather than
+    silently diverging.
+    """
+    if net.block != "separable":
+        raise ValueError("fused_forward covers block='separable' only")
+    if net.norm != "full":
+        raise ValueError("fused_forward covers norm='full' only")
+    from opencv_facerecognizer_tpu.ops.pallas_sepblock import fused_sep_block
+
+    dtype = net.dtype
+    if x.ndim == 3:
+        x = x[..., None]
+    x = x.astype(dtype)
+    total_stride = 2 ** (1 + len(net.stage_features))
+    s = int(net.space_to_depth)
+    if s > 1:
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // s, s, w // s, s, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // s, w // s, s * s * c)
+    remaining = total_stride // s
+    accum = 1
+    stem_stride = 2 if accum < remaining else 1
+    accum *= stem_stride
+
+    x = jax.lax.conv_general_dilated(
+        x.astype(dtype), params["Conv_0"]["kernel"].astype(dtype),
+        window_strides=(stem_stride, stem_stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # the stem norm IS the flax module (same graph, no duplicated math —
+    # only the stage blocks get the pallas schedule)
+    x = nn.GroupNorm(num_groups=4, dtype=dtype).apply(
+        {"params": params["GroupNorm_0"]}, x)
+    x = jnp.maximum(x, 0.0).astype(dtype)
+
+    i = 0
+    for feats, blocks in zip(net.stage_features, net.stage_blocks):
+        for b in range(blocks):
+            stride = 2 if (b == 0 and accum < remaining) else 1
+            if b == 0:
+                accum *= stride
+            p = params[f"_SepBlock_{i}"]
+            in_ch = x.shape[-1]
+            x = fused_sep_block(
+                x,
+                p["Conv_0"]["kernel"], p["GroupNorm_0"]["scale"],
+                p["GroupNorm_0"]["bias"], p["Conv_1"]["kernel"],
+                p["GroupNorm_1"]["scale"], p["GroupNorm_1"]["bias"],
+                stride=stride, residual=(stride == 1 and in_ch == feats),
+                block_b=block_b, interpret=interpret,
+            )
+            i += 1
+
+    # GDC as multiply+reduce: kernel [h, w, 1, C] applied per channel
+    gdc = params["Conv_1"]["kernel"].astype(dtype)
+    x = jnp.einsum("nhwc,hwc->nc", x.astype(dtype), gdc[:, :, 0, :])
+    dense = params["Dense_0"]
+    x = (x.astype(dtype) @ dense["kernel"].astype(dtype)
+         + dense["bias"].astype(dtype))
+    x = x.astype(jnp.float32)
+    return x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+
 def arcface_loss(
     embeddings: jnp.ndarray,
     labels: jnp.ndarray,

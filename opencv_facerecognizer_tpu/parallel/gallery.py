@@ -529,6 +529,13 @@ class ShardedGallery:
         and an in-flight call already holds its function reference."""
         for key in [k for k in list(self._match_cache) if k[1] < below_capacity]:
             self._match_cache.pop(key, None)
+        # An evicted tier is no longer warm: if a swap_from shrinks the
+        # gallery and enrolment re-grows THROUGH this tier, prewarm must
+        # recompile it rather than skip on a stale membership.
+        with self._write_lock:
+            self._warmed_capacities = {
+                c for c in self._warmed_capacities if c >= below_capacity
+            }
         for hook in list(self.evict_hooks):
             try:
                 hook(below_capacity)
@@ -639,7 +646,8 @@ class ShardedGallery:
         # survive the swap).
         capacity = data.capacity
         key = (k, capacity, self._pallas_enabled(capacity))
-        if key not in self._match_cache:
+        fn = self._match_cache.get(key)  # fetch once (evict race)
+        if fn is None:
             if self._pallas_enabled(capacity):
                 fn = jax.jit(self.match_fn(k, capacity))
             else:
@@ -653,7 +661,7 @@ class ShardedGallery:
                     ),
                 )
             self._match_cache[key] = fn
-        return self._match_cache[key]
+        return fn
 
     def match(self, queries: jnp.ndarray, k: int = 1):
         """[Q, D] L2-normalized queries -> (labels [Q, k], cosine sims [Q, k],

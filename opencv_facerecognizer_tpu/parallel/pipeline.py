@@ -176,10 +176,14 @@ class RecognitionPipeline:
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         key = self._step_key(frames, data)
-        if key not in self._step_cache:
-            self._step_cache[key] = self._build_step(*frames.shape,
-                                                     capacity=data.capacity)
-        return self._step_cache[key](
+        # Fetch ONCE and hold the reference: a concurrent double-grow can
+        # evict this tier's entry between a membership check and a second
+        # subscript (evict_below runs on the grow worker).
+        step = self._step_cache.get(key)
+        if step is None:
+            step = self._step_cache[key] = self._build_step(
+                *frames.shape, capacity=data.capacity)
+        return step(
             self.detector.params,
             self.embed_params,
             data.embeddings,
@@ -195,7 +199,8 @@ class RecognitionPipeline:
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         key = self._step_key(frames, data)
-        if key not in self._packed_cache:
+        packed = self._packed_cache.get(key)  # fetch once (evict race)
+        if packed is None:
             step = self._step_cache.get(key)
             if step is None:
                 step = self._step_cache[key] = self._build_step(
@@ -204,8 +209,8 @@ class RecognitionPipeline:
             def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr):
                 return pack_result(step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
 
-            self._packed_cache[key] = jax.jit(packed_step)
-        return self._packed_cache[key](
+            packed = self._packed_cache[key] = jax.jit(packed_step)
+        return packed(
             self.detector.params,
             self.embed_params,
             data.embeddings,

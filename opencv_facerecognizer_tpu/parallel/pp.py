@@ -198,7 +198,8 @@ class TwoStagePipeline:
         install and pair a stale key with new-tier arrays)."""
         capacity = data.capacity
         key = (capacity, self.gallery._pallas_enabled(capacity))
-        if key not in self._b_cache:
+        fn = self._b_cache.get(key)  # fetch once (evict race)
+        if fn is None:
             match = self.gallery.match_fn(self.top_k, capacity)
             embed_net = self.embed_net
             face_size = self.face_size
@@ -214,8 +215,8 @@ class TwoStagePipeline:
                 labels, sims, _ = match(emb, g_emb, g_valid, g_labels)
                 return labels.reshape((b, kf, k)), sims.reshape((b, kf, k))
 
-            self._b_cache[key] = jax.jit(stage_b)
-        return self._b_cache[key]
+            fn = self._b_cache[key] = jax.jit(stage_b)
+        return fn
 
     def evict_below(self, min_capacity: int) -> None:
         """Drop stage-B executables for gallery tiers strictly below

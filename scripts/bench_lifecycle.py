@@ -46,9 +46,10 @@ def chained_ms_per_batch(pipeline, frames_stack):
     from opencv_facerecognizer_tpu.utils.benchtime import scalar_chain_ms
 
     data = pipeline.gallery.data
-    key = pipeline._step_key(frames_stack[0])
+    key = pipeline._step_key(frames_stack[0], data)
     if key not in pipeline._step_cache:
-        pipeline._step_cache[key] = pipeline._build_step(*frames_stack[0].shape)
+        pipeline._step_cache[key] = pipeline._build_step(
+            *frames_stack[0].shape, capacity=data.capacity)
     step = pipeline._step_cache[key]
 
     def scalar(det_p, emb_p, g_emb, g_valid, g_lab, frames):

@@ -1,0 +1,148 @@
+"""On-chip A/B of the fused separable-block serving path (VERDICT r4 #6).
+
+Measures ms/forward of the serving embedder at serving crop shapes
+(batch x max_faces crops at SERVING_FACE_SIZE) under the shared
+chained-differencing instrument, for:
+
+- ``flax``: the training graph, ``net.apply`` (XLA grouped-conv depthwise
+  lowering, per-op HBM roundtrips);
+- ``fused``: ``models.embedder.fused_forward`` (one pallas call per block,
+  VMEM-resident activations, dw conv as unrolled VPU FMAs, GDC einsum).
+
+Equivalence is pinned by tests/test_pallas_sepblock.py; this script only
+decides whether the fused schedule is FASTER on real hardware — the
+serving default flips only on a measured win (the same
+measured-or-it-didn't-happen bar every other perf claim in this repo
+clears). Writes BENCH_DETAIL.json["sepblock_fused"].
+
+Run:  PYTHONPATH=. python scripts/bench_sepblock.py [--batches 64,256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="64,256")
+    ap.add_argument("--tiny", action="store_true",
+                    help="small net + interpret mode: smoke-tests the "
+                         "measurement path on CPU without touching "
+                         "BENCH_DETAIL.json")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.models.embedder import (
+        SERVING_EMBEDDER_KWARGS, SERVING_FACE_SIZE, FaceEmbedNet,
+        fused_forward, init_embedder,
+    )
+    from opencv_facerecognizer_tpu.utils.benchtime import scalar_chain_ms
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+        net = FaceEmbedNet(embed_dim=16, stem_features=8,
+                           stage_features=(8, 16), stage_blocks=(1, 1))
+        face = (32, 32)
+        batches = [4]
+        interpret = True
+    else:
+        net = FaceEmbedNet(**SERVING_EMBEDDER_KWARGS)
+        face = SERVING_FACE_SIZE
+        batches = [int(b) for b in args.batches.split(",")]
+        interpret = False
+    dev = jax.devices()[0]
+    _log(f"device: {dev}")
+    params = init_embedder(net, num_classes=16, input_shape=face,
+                           seed=0)["net"]
+    rng = np.random.default_rng(0)
+
+    def flax_scalar(p, x):
+        return jnp.sum(net.apply({"params": p}, x))
+
+    def fused_scalar(p, x):
+        return jnp.sum(fused_forward(net, p, x, interpret=interpret))
+
+    # analytic FLOPs of the flax forward = the work both schedules do
+    flops = float("nan")
+    try:
+        x0 = jnp.zeros((batches[0], *face), jnp.float32)
+        lowered = jax.jit(lambda p, x: net.apply({"params": p}, x)).lower(
+            params, x0)
+        ca = lowered.compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        flops = float(ca.get("flops", float("nan"))) / batches[0]
+    except Exception as e:  # noqa: BLE001 — cost analysis is best-effort
+        _log(f"cost analysis unavailable: {e}")
+
+    results = {}
+    for batch in batches:
+        x = jnp.asarray(rng.normal(size=(batch, *face)).astype(np.float32))
+        row = {}
+        for name, scalar in (("flax", flax_scalar), ("fused", fused_scalar)):
+            try:
+                ms = scalar_chain_ms(scalar, (params, x))
+            except Exception as e:  # noqa: BLE001 — a Mosaic lowering
+                # rejection on real hardware must land in the artifact,
+                # not kill the queue job.
+                row[name] = {"error": repr(e)[:500]}
+                _log(f"batch {batch} {name}: FAILED {e!r}")
+                continue
+            entry = {"ms_per_forward": None if ms is None else round(ms, 4)}
+            if ms and np.isfinite(flops):
+                tflops = flops * batch / (ms / 1e3) / 1e12
+                entry["tflops"] = round(tflops, 2)
+                entry["mfu_vs_bf16_peak"] = round(
+                    tflops / V5E_BF16_PEAK_TFLOPS, 4)
+            row[name] = entry
+            _log(f"batch {batch} {name}: {entry}")
+        f_ms = row.get("flax", {}).get("ms_per_forward")
+        p_ms = row.get("fused", {}).get("ms_per_forward")
+        if f_ms and p_ms:
+            row["speedup"] = round(f_ms / p_ms, 3)
+        results[str(batch)] = row
+
+    doc = {
+        "device": str(dev),
+        "date": time.strftime("%Y-%m-%d"),
+        "face_size": list(face),
+        "flops_per_sample": None if not np.isfinite(flops) else flops,
+        "note": ("chained-differencing ms/forward of the serving embedder: "
+                 "flax graph vs fused pallas schedule (same params, "
+                 "equivalence pinned in tests). Flip the serving default "
+                 "only on a measured speedup here."),
+        "batches": results,
+    }
+    print(json.dumps(doc, indent=2))
+    if args.tiny:
+        return
+    detail_path = os.path.join(REPO, "BENCH_DETAIL.json")
+    try:
+        detail = json.load(open(detail_path))
+    except (OSError, json.JSONDecodeError):
+        detail = {}
+    detail["sepblock_fused"] = doc
+    with open(detail_path, "w") as fh:
+        json.dump(detail, fh, indent=2)
+    _log("merged sepblock_fused into BENCH_DETAIL.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -62,7 +62,9 @@ def candidates():
     from opencv_facerecognizer_tpu.models.feature import (
         Fisherfaces, SpatialHistogram, TanTriggsPreprocessing,
     )
-    from opencv_facerecognizer_tpu.models.operators import ChainOperator
+    from opencv_facerecognizer_tpu.models.operators import (
+        ChainOperator, CombineOperator,
+    )
     from opencv_facerecognizer_tpu.ops import lbp as lbp_ops
     from opencv_facerecognizer_tpu.ops.distance import (
         ChiSquareDistance, CosineDistance, EuclideanDistance,
@@ -117,6 +119,57 @@ def candidates():
         "lbp10_chi2": lambda: (
             ChainOperator(tt(), hist(sz=(10, 10))),
             NearestNeighbor(ChiSquareDistance()),
+        ),
+        # round 2 (after every round-1 challenger measured BELOW the 0.8283
+        # baseline): ensembles + preprocessing ablations
+        # global Fisher axes and local LBP-Fisher axes see different error
+        # modes (illumination gradient vs occlusion); concatenate them
+        "combine_fisher_lbpfisher": lambda: (
+            CombineOperator(
+                ChainOperator(tt(), Fisherfaces()),
+                ChainOperator(tt(), ChainOperator(hist(), Fisherfaces())),
+            ),
+            NearestNeighbor(CosineDistance()),
+        ),
+        # LBP is illumination-invariant by construction — TanTriggs's
+        # gamma+DoG may be destroying the texture LBP codes
+        "rawlbp_chi2": lambda: (
+            hist(),
+            NearestNeighbor(ChiSquareDistance()),
+        ),
+        "rawlbp_fisher_cosine": lambda: (
+            ChainOperator(hist(), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        # k=3 neighbor voting over the strong baseline feature
+        "fisher_knn3": lambda: (
+            ChainOperator(tt(), Fisherfaces()),
+            NearestNeighbor(EuclideanDistance(), k=3),
+        ),
+        "fisher_cosine": lambda: (
+            ChainOperator(tt(), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        # round 3: refine the round-2 winner (rawlbp_fisher_cosine 0.93)
+        "rawlbp1_fisher_cosine": lambda: (
+            ChainOperator(hist(r=1), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        "rawlbp10_fisher_cosine": lambda: (
+            ChainOperator(hist(sz=(10, 10)), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        "rawlbp6_fisher_cosine": lambda: (
+            ChainOperator(hist(sz=(6, 6)), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        "rawlbp_fisher_euclid": lambda: (
+            ChainOperator(hist(), Fisherfaces()),
+            NearestNeighbor(EuclideanDistance()),
+        ),
+        "rawlbp_fisher_knn3": lambda: (
+            ChainOperator(hist(), Fisherfaces()),
+            NearestNeighbor(CosineDistance(), k=3),
         ),
     }
 

@@ -111,9 +111,9 @@ def main(argv=None):
         ts.append(time.perf_counter() - t0)
     rows["full_device_ms"] = p50_ms(ts)
 
-    key = pipe._step_key(dev_frames)
-    fn = pipe._packed_cache[key]
     data = gallery.data
+    key = pipe._step_key(dev_frames, data)
+    fn = pipe._packed_cache[key]
     ts = []
     for i in range(N):
         t0 = time.perf_counter()

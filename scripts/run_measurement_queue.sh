@@ -95,6 +95,8 @@ run dispatch8 python scripts/probe_dispatch.py --batch 8
 run dispatch32 python scripts/probe_dispatch.py --batch 32
 # 6. embedder sweep with @64 rows (mfu_exploration refresh)
 run sweep python scripts/explore_perf.py --skip-detector
+# 6b. fused pallas sepblock schedule A/B (flip serving default on a win)
+run sepblock python scripts/bench_sepblock.py
 # 7. serving bench (latency model with new dispatch quote)
 run serving python bench_serving.py
 if [ $GAVE_UP -eq 1 ]; then

@@ -1,0 +1,112 @@
+"""Numerical equivalence of the fused separable-block pallas kernel (and
+the fused serving forward built on it) against the flax graph — the
+transform re-schedules inference; it must not change the math beyond bf16
+rounding (ops/pallas_sepblock.py module docstring)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opencv_facerecognizer_tpu.models import embedder as emb_mod
+from opencv_facerecognizer_tpu.models.embedder import (
+    FaceEmbedNet, fused_forward, init_embedder,
+)
+from opencv_facerecognizer_tpu.ops.pallas_sepblock import fused_sep_block
+
+RNG = np.random.default_rng(11)
+
+
+def _flax_block(features, stride, x, seed=0):
+    blk = emb_mod._SepBlock(features=features, stride=stride)
+    params = blk.init(jax.random.PRNGKey(seed), x)["params"]
+    return blk, params
+
+
+@pytest.mark.parametrize("stride,cin,cout,hw", [
+    (1, 32, 32, 16),   # residual block
+    (1, 32, 64, 16),   # channel change, no residual
+    (2, 64, 128, 16),  # downsampling stage head
+    (2, 32, 32, 8),    # stride without channel change
+])
+def test_fused_block_matches_flax(stride, cin, cout, hw):
+    x = jnp.asarray(RNG.normal(size=(4, hw, hw, cin)).astype(np.float32),
+                    jnp.bfloat16)
+    blk, params = _flax_block(cout, stride, x)
+    want = np.asarray(blk.apply({"params": params}, x), np.float32)
+    got = np.asarray(fused_sep_block(
+        x, params["Conv_0"]["kernel"], params["GroupNorm_0"]["scale"],
+        params["GroupNorm_0"]["bias"], params["Conv_1"]["kernel"],
+        params["GroupNorm_1"]["scale"], params["GroupNorm_1"]["bias"],
+        stride=stride, residual=(stride == 1 and cin == cout),
+        interpret=True, block_b=2,
+    ), np.float32)
+    assert got.shape == want.shape
+    # bf16 activations: elementwise agreement within bf16 ulp-scale noise
+    scale = max(1.0, float(np.abs(want).max()))
+    np.testing.assert_allclose(got, want, atol=0.03 * scale, rtol=0.05)
+    # and tight agreement in aggregate (the rounding noise is unbiased)
+    corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+    assert corr > 0.9995, corr
+
+
+def test_fused_block_batch_padding():
+    """Batch not divisible by block_b: padded lanes must not leak."""
+    x = jnp.asarray(RNG.normal(size=(5, 8, 8, 16)).astype(np.float32),
+                    jnp.bfloat16)
+    blk, params = _flax_block(16, 1, x)
+    want = np.asarray(blk.apply({"params": params}, x), np.float32)
+    got = np.asarray(fused_sep_block(
+        x, params["Conv_0"]["kernel"], params["GroupNorm_0"]["scale"],
+        params["GroupNorm_0"]["bias"], params["Conv_1"]["kernel"],
+        params["GroupNorm_1"]["scale"], params["GroupNorm_1"]["bias"],
+        stride=1, residual=True, interpret=True, block_b=4,
+    ), np.float32)
+    assert got.shape == want.shape
+    scale = max(1.0, float(np.abs(want).max()))
+    np.testing.assert_allclose(got, want, atol=0.03 * scale, rtol=0.05)
+
+
+def test_fused_forward_matches_net_apply():
+    """End-to-end: fused serving forward vs net.apply on a small separable
+    net — final L2-normalized embeddings nearly identical."""
+    net = FaceEmbedNet(embed_dim=32, stem_features=8, stage_features=(8, 16),
+                       stage_blocks=(2, 1))
+    params = init_embedder(net, 4, (32, 32), seed=0)["net"]
+    x = RNG.normal(size=(4, 32, 32)).astype(np.float32)
+    want = np.asarray(net.apply({"params": params}, x))
+    got = np.asarray(fused_forward(net, params, jnp.asarray(x),
+                                   interpret=True, block_b=2))
+    assert got.shape == want.shape
+    cos = np.sum(got * want, axis=-1)  # both L2-normalized
+    assert np.all(cos > 0.9999), cos
+    np.testing.assert_allclose(got, want, atol=2e-2)
+
+
+def test_fused_forward_serving_config_shapes():
+    """The SERVING default config itself traces through the fused path
+    (structure coverage, small batch to keep CPU time sane)."""
+    from opencv_facerecognizer_tpu.models.embedder import (
+        SERVING_EMBEDDER_KWARGS, SERVING_FACE_SIZE,
+    )
+
+    net = FaceEmbedNet(**SERVING_EMBEDDER_KWARGS)
+    params = init_embedder(net, 4, SERVING_FACE_SIZE, seed=0)["net"]
+    x = RNG.normal(size=(2, *SERVING_FACE_SIZE)).astype(np.float32)
+    want = np.asarray(net.apply({"params": params}, x))
+    got = np.asarray(fused_forward(net, params, jnp.asarray(x),
+                                   interpret=True, block_b=2))
+    cos = np.sum(got * want, axis=-1)
+    assert np.all(cos > 0.9999), cos
+
+
+def test_fused_forward_rejects_uncovered_configs():
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
+                       stage_blocks=(1,), block="dense")
+    with pytest.raises(ValueError, match="separable"):
+        fused_forward(net, {}, jnp.zeros((1, 32, 32)))
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
+                       stage_blocks=(1,), norm="light")
+    with pytest.raises(ValueError, match="norm"):
+        fused_forward(net, {}, jnp.zeros((1, 32, 32)))
