@@ -18,7 +18,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("dataset", help="dataset dir: one sub-folder of images per subject")
     p.add_argument("model_path", help="output checkpoint path (.ckpt)")
     p.add_argument("--model", default="fisherfaces",
-                   choices=["fisherfaces", "eigenfaces", "lbph", "cnn"])
+                   choices=["fisherfaces", "eigenfaces", "lbph", "lbp_fisherfaces", "cnn"])
     p.add_argument("--image-size", type=int, nargs=2, default=(70, 70),
                    metavar=("H", "W"))
     p.add_argument("--kfold", type=int, default=3)

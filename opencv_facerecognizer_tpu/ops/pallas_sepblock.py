@@ -75,19 +75,29 @@ def _groupnorm(x, scale, bias, groups: int, eps: float):
     return y * scale[None, None, None, :] + bias[None, None, None, :]
 
 
-def _sepblock_kernel(x_ref, xpad_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref,
-                     g2s_ref, g2b_ref, out_ref, *, stride: int, groups: int,
+def _sepblock_kernel(*refs, stride: int, groups: int,
                      eps: float, residual: bool, out_h: int, out_w: int):
     """One batch tile: the whole separable block, VMEM-resident.
 
-    x_ref [Bb, H, W, C] (unpadded, residual source); xpad_ref
-    [Bb, H+2, W+2, C] (SAME-padded dw input — stride 2 uses rows/cols
-    [0:H+1], matching XLA's lo=0/hi=1 SAME split); wdw_ref [3, 3, C];
-    wpw_ref [C, F]; out_ref [Bb, out_h, out_w, F].
+    Refs: [x_ref only when residual] xpad_ref, wdw_ref, g1s_ref, g1b_ref,
+    wpw_ref, g2s_ref, g2b_ref, out_ref. x_ref [Bb, H, W, C] is the
+    residual source and is only an input at all when the block HAS a
+    residual — shipping it HBM->VMEM on the stride-2 stage heads would be
+    dead bandwidth on the exact path this kernel exists to speed up.
+    xpad_ref [Bb, H+2, W+2, C] is the SAME-padded dw input (stride 2 uses
+    rows/cols [0:H+1], matching XLA's lo=0/hi=1 SAME split); wdw_ref
+    [3, 3, C]; wpw_ref [C, F]; out_ref [Bb, out_h, out_w, F].
     """
+    if residual:
+        (x_ref, xpad_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
+         g2b_ref, out_ref) = refs
+    else:
+        (xpad_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
+         g2b_ref, out_ref) = refs
     xpad = xpad_ref[:].astype(jnp.float32)
     wdw = wdw_ref[:].astype(jnp.float32)
-    bb, _, _, c = x_ref.shape
+    bb = xpad_ref.shape[0]
+    c = xpad_ref.shape[3]
 
     # depthwise 3x3 as 9 unrolled shifted FMAs (VPU); bf16-round the
     # operands once, accumulate f32 — mirrors the MXU's bf16xbf16->f32.
@@ -164,24 +174,31 @@ def fused_sep_block(x, w_dw, g1_scale, g1_bias, w_pw, g2_scale, g2_bias, *,
     grid = (x.shape[0] // block_b,)
 
     full = lambda *s: pl.BlockSpec(s, lambda i: (0,) * len(s))  # noqa: E731
+    # x (the residual source) is only an input when the block has a
+    # residual: stride-2 stage heads skip the dead HBM->VMEM copy.
+    in_specs = [
+        pl.BlockSpec((block_b, h + 2, w + 2, c), lambda i: (i, 0, 0, 0)),
+        full(3, 3, c),
+        full(c), full(c),
+        full(c, f),
+        full(f), full(f),
+    ]
+    inputs = [xpad, w_dw[:, :, 0, :], g1_scale, g1_bias, w_pw[0, 0],
+              g2_scale, g2_bias]
+    if residual:
+        in_specs.insert(0, pl.BlockSpec((block_b, h, w, c),
+                                        lambda i: (i, 0, 0, 0)))
+        inputs.insert(0, x)
     out = pl.pallas_call(
         functools.partial(
             _sepblock_kernel, stride=stride, groups=groups, eps=eps,
             residual=residual, out_h=out_h, out_w=out_w,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((block_b, h, w, c), lambda i: (i, 0, 0, 0)),
-            pl.BlockSpec((block_b, h + 2, w + 2, c), lambda i: (i, 0, 0, 0)),
-            full(3, 3, c),
-            full(c), full(c),
-            full(c, f),
-            full(f), full(f),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((block_b, out_h, out_w, f),
                                lambda i: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((x.shape[0], out_h, out_w, f), x.dtype),
         interpret=interpret,
-    )(x, xpad, w_dw[:, :, 0, :], g1_scale, g1_bias, w_pw[0, 0], g2_scale,
-      g2_bias)
+    )(*inputs)
     return out[:b]

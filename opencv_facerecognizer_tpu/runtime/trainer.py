@@ -7,6 +7,9 @@ pickled the model. This rebuild keeps that flow and adds the CNN backend:
 - ``model="fisherfaces" | "eigenfaces" | "lbph"`` — the classic plugins
   (BASELINE.json:7-9 configs), trained and validated exactly like the
   reference but batched on device.
+- ``model="lbp_fisherfaces"`` — the round-5 robustness winner (raw LBP
+  spatial histograms -> Fisherfaces -> cosine NN; measured rationale at
+  the `_build_model` branch and in BASELINE.md).
 - ``model="cnn"`` — ArcFace-trained CNN embedder; ``build_gallery()`` then
   yields the ShardedGallery + nets for the serving pipeline.
 
@@ -47,7 +50,7 @@ from opencv_facerecognizer_tpu.utils.validation import KFoldCrossValidation
 class TrainerConfig:
     """Flat config (SURVEY.md §5.6): one dataclass, no magic."""
 
-    model: str = "fisherfaces"  # fisherfaces | eigenfaces | lbph | cnn
+    model: str = "fisherfaces"  # fisherfaces | eigenfaces | lbph | lbp_fisherfaces | cnn
     image_size: Tuple[int, int] = (70, 70)
     kfold: int = 3
     num_components: int = 0  # subspace dims (0 = auto)
@@ -103,6 +106,28 @@ class TheTrainer:
                 lbp_ops.ExtendedLBP(radius=2, neighbors=8), sz=(8, 8)
             )
             classifier = NearestNeighbor(ChiSquareDistance(), k=cfg.knn_k)
+        elif cfg.model == "lbp_fisherfaces":
+            # The measured robustness winner on the hard Yale-B analog
+            # (scripts/explore_fisherfaces.py, round 5): RAW ExtendedLBP
+            # spatial histograms -> Fisherfaces -> cosine NN. Measured
+            # surprises driving the design: (a) NO TanTriggs — LBP codes
+            # are illumination-invariant already, and the DoG band-pass
+            # destroys the micro-texture they encode (with TT: 0.8067;
+            # raw: 0.93+); (b) a COARSE 6x6 grid beats 8x8/10x10 — fewer,
+            # bigger cells give the LDA a denser histogram basis;
+            # (c) radius 3 > 2 > 1. Hard-protocol k-fold: 0.9817 vs
+            # 0.8283 for classic Fisherfaces (seed 2), and on UNSEEN
+            # generator seeds {22, 42}: 0.9817/0.9950 vs 0.55/0.585 — the
+            # classic's 0.83 was a lucky seed; this config's robustness
+            # replicates (+0.15 over the pixel-space linear oracle
+            # ceiling, BASELINE.md).
+            feature = ChainOperator(
+                SpatialHistogram(
+                    lbp_ops.ExtendedLBP(radius=3, neighbors=8), sz=(6, 6)
+                ),
+                Fisherfaces(cfg.num_components),
+            )
+            classifier = NearestNeighbor(CosineDistance(), k=cfg.knn_k)
         elif cfg.model == "cnn":
             serialization.register(CNNEmbedding)
             feature = CNNEmbedding(

@@ -171,6 +171,19 @@ def candidates():
             ChainOperator(hist(), Fisherfaces()),
             NearestNeighbor(CosineDistance(), k=3),
         ),
+        # round 4: grid/radius around the 6x6 winner (0.9617)
+        "rawlbp4_fisher_cosine": lambda: (
+            ChainOperator(hist(sz=(4, 4)), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        "rawlbp5_fisher_cosine": lambda: (
+            ChainOperator(hist(sz=(5, 5)), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
+        "rawlbp6r3_fisher_cosine": lambda: (
+            ChainOperator(hist(r=3, sz=(6, 6)), Fisherfaces()),
+            NearestNeighbor(CosineDistance()),
+        ),
     }
 
 

@@ -137,6 +137,13 @@ CONFIGS = {
     "lbph": ("lbph_lfw",
              lambda: classic_kfold("lbph", 40, 8, 10, seed=3, noise=18.0,
                                    **HARD_WILD)),
+    # the Fisherfaces robustness winner (scripts/explore_fisherfaces.py):
+    # raw-LBP spatial histograms -> Fisherfaces -> cosine NN on the SAME
+    # hard Yale-B-analog protocol as the fisherfaces row
+    "lbp_fisherfaces": ("lbp_fisherfaces_yaleb",
+                        lambda: classic_kfold("lbp_fisherfaces", 30, 12, 10,
+                                              seed=2, illumination=0.7,
+                                              noise=14.0, **HARD_POSE)),
     "cnn": ("cnn_verification", cnn_verification),
 }
 
@@ -150,7 +157,18 @@ def main(argv=None):
     ap.add_argument("--only", action="append", choices=sorted(CONFIGS),
                     help="measure only these configs; others keep their "
                          "cached values (repeatable)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the host backend. Accuracy is backend-"
+                         "independent (verified: the fisherfaces row "
+                         "reproduces to 4 decimals on CPU); use for the "
+                         "classic rows when the TPU tunnel is down. The "
+                         "cnn row is chip-scale training — refresh it on "
+                         "hardware.")
     args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     selected = args.only or sorted(CONFIGS)
 
     results = {}
@@ -191,6 +209,8 @@ def main(argv=None):
          "fisherfaces_yaleb"),
         ("LBPH (SpatialHistogram r=2 + ChiSquare NN) k-fold, LFW-analog",
          "lbph_lfw"),
+        ("LBP-Fisherfaces (raw ExtendedLBP r=3 6x6 + PCA+LDA + cosine NN) "
+         "k-fold, Yale-B-analog", "lbp_fisherfaces_yaleb"),
         ("CNN ArcFace embedding, 6000-pair verification, disjoint identities",
          "cnn_verification"),
     ]
