@@ -166,8 +166,12 @@ def spatial_hist_np(codes: np.ndarray, grid=(8, 8), num_bins=256) -> np.ndarray:
 
 
 def nn_classify_np(train_f, train_y, test_f, metric: str) -> np.ndarray:
-    """1-NN under euclidean or chi-square, blocked to bound memory."""
+    """1-NN under euclidean, chi-square, or cosine, blocked to bound
+    memory."""
     preds = np.empty(len(test_f), train_y.dtype)
+    if metric == "cosine":  # loop-invariant: normalize the train side once
+        train_n = train_f / np.maximum(
+            np.linalg.norm(train_f, axis=-1, keepdims=True), 1e-12)
     for i0 in range(0, len(test_f), 64):
         t = test_f[i0:i0 + 64]
         if metric == "euclidean":
@@ -176,6 +180,10 @@ def nn_classify_np(train_f, train_y, test_f, metric: str) -> np.ndarray:
             diff = t[:, None, :] - train_f[None, :, :]
             s = np.maximum(t[:, None, :] + train_f[None, :, :], 1e-12)
             d = (diff * diff / s).sum(-1)
+        elif metric == "cosine":
+            tn = t / np.maximum(
+                np.linalg.norm(t, axis=-1, keepdims=True), 1e-12)
+            d = 1.0 - tn @ train_n.T
         else:
             raise ValueError(metric)
         preds[i0:i0 + 64] = train_y[np.argmin(d, axis=1)]
@@ -197,6 +205,10 @@ def oracle_kfold(kind: str, X: np.ndarray, y: np.ndarray, k: int) -> float:
     if kind == "lbph":
         # descriptors are per-image and fold-independent: compute once
         feats_all = spatial_hist_np(lbp_codes_np(X, radius=2, neighbors=8))
+    elif kind == "lbp_fisherfaces":
+        # the round-5 robustness config: RAW r=3 codes, coarse 6x6 grid
+        feats_all = spatial_hist_np(lbp_codes_np(X, radius=3, neighbors=8),
+                                    grid=(6, 6))
     folds = stratified_kfold_indices(y, k, seed=0)
     correct = total = 0
     for test_idx in folds:
@@ -221,6 +233,11 @@ def oracle_kfold(kind: str, X: np.ndarray, y: np.ndarray, k: int) -> float:
         elif kind == "lbph":
             preds = nn_classify_np(feats_all[mask], y[mask],
                                    feats_all[test_idx], "chi_square")
+        elif kind == "lbp_fisherfaces":
+            mean, W = fisherfaces_fit_np(feats_all[mask], y[mask])
+            ftr = (feats_all[mask] - mean) @ W
+            fte = (feats_all[test_idx] - mean) @ W
+            preds = nn_classify_np(ftr, y[mask], fte, "cosine")
         else:
             raise ValueError(kind)
         correct += int((preds == y[test_idx]).sum())
@@ -262,6 +279,10 @@ CONFIGS = {
                                noise=18.0), 10),
     "lbph_hard": ("lbph", dict(num_subjects=40, per_subject=8, seed=3,
                                noise=18.0, **HARD_WILD), 10),
+    "lbp_fisherfaces_hard": ("lbp_fisherfaces",
+                             dict(num_subjects=30, per_subject=12, seed=2,
+                                  illumination=0.7, noise=14.0,
+                                  **HARD_POSE), 10),
 }
 
 
@@ -270,7 +291,15 @@ def main(argv=None):
     ap.add_argument("--only", action="append", choices=sorted(CONFIGS))
     ap.add_argument("--skip-framework", action="store_true",
                     help="oracle column only (framework rows keep cache)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the host backend for the framework column "
+                         "(accuracy is backend-independent; see "
+                         "measure_accuracy.py --cpu)")
     args = ap.parse_args(argv)
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     selected = args.only or sorted(CONFIGS)
 
     from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_faces
@@ -315,6 +344,7 @@ def main(argv=None):
         "eigenfaces": "Eigenfaces (PCA+NN)",
         "fisherfaces": "Fisherfaces (TanTriggs + PCA+LDA+NN)",
         "lbph": "LBPH (ExtendedLBP r=2 + ChiSquare NN)",
+        "lbp_fisherfaces": "LBP-Fisherfaces (raw r=3 6x6 + PCA+LDA + cosine)",
     }
     lines = [BEGIN, "",
              "| Config | Protocol | Framework (TPU) | Oracle (NumPy/SciPy) "
