@@ -15,6 +15,7 @@ inserts the collectives; nothing here names a wire protocol.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
@@ -77,6 +78,7 @@ class RecognitionPipeline:
         gallery: ShardedGallery,
         face_size: Tuple[int, int] = (112, 112),
         top_k: int = 1,
+        fused_embedder: bool = False,
     ):
         self.detector = detector
         self.embed_net = embed_net
@@ -84,6 +86,18 @@ class RecognitionPipeline:
         self.gallery = gallery
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
+        # Opt-in pallas schedule for the embed stage (ops.pallas_sepblock;
+        # same params/math, equivalence pinned in tests). Stays off by
+        # default until scripts/bench_sepblock.py measures a win on chip —
+        # the flip is then this one flag. Single-device meshes only: GSPMD
+        # cannot partition a pallas custom call over the mesh, so fail
+        # fast here instead of dying in an opaque Mosaic partition error
+        # at first dispatch.
+        if fused_embedder and gallery.mesh.size > 1:
+            raise ValueError(
+                "fused_embedder=True requires a single-device mesh "
+                f"(got {gallery.mesh.size} devices)")
+        self.fused_embedder = bool(fused_embedder)
         # keyed by _step_key: (batch, h, w, dtype_str, capacity, pallas)
         self._step_cache: Dict[Tuple, Any] = {}
         self._packed_cache: Dict[Tuple, Any] = {}
@@ -105,6 +119,12 @@ class RecognitionPipeline:
         face_size = self.face_size
         embed_net = self.embed_net
         max_faces = det.max_faces
+        if self.fused_embedder:
+            interpret = mesh.devices.flat[0].platform != "tpu"
+            embed_apply = functools.partial(
+                embedder_mod.fused_forward, embed_net, interpret=interpret)
+        else:
+            embed_apply = lambda p, x: embed_net.apply({"params": p}, x)  # noqa: E731
         # The gallery owns matcher selection (pallas streaming vs GSPMD
         # global view) — the fused step inherits whichever fits the mesh
         # and capacity; _step_key re-selects if the gallery grows, and
@@ -125,9 +145,10 @@ class RecognitionPipeline:
             # 2) align: dynamic crop+resize, all slots (invalid ones too)
             crops = image_ops.batched_crop_resize(frames, boxes, face_size)
             flat = crops.reshape((batch * max_faces, *face_size))
-            # 3) embed
-            emb = embed_net.apply(
-                {"params": emb_params}, embedder_mod.normalize_faces(flat, face_size)
+            # 3) embed (flax graph, or the fused pallas schedule when
+            # self.fused_embedder — same params either way)
+            emb = embed_apply(
+                emb_params, embedder_mod.normalize_faces(flat, face_size)
             )  # [B*K, E] unit-norm
             # 4) match against the gallery (selection in gallery.match_fn:
             # GSPMD global view when sharded, pallas streaming single-chip)

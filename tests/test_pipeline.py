@@ -115,3 +115,39 @@ def test_pipeline_batch_caching(pipeline_setup):
     assert len(pipe._step_cache) == 1  # same shape -> no recompile
     pipe.recognize_batch(scenes[:16])
     assert len(pipe._step_cache) == 2
+
+
+def test_pipeline_fused_embedder_matches_flax(pipeline_setup):
+    """fused_embedder=True swaps the embed stage onto the pallas schedule
+    (interpret mode off-TPU) without changing results — the one-flag flip
+    the on-chip A/B (scripts/bench_sepblock.py) decides."""
+    import jax
+    from jax.sharding import Mesh
+
+    from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+
+    det, net, params, scenes, boxes, counts, crops, labels = pipeline_setup
+    # single-device mesh: pallas custom calls don't partition over tp
+    mesh = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                (DP_AXIS, TP_AXIS))
+    gallery = ShardedGallery(capacity=64, dim=32, mesh=mesh)
+    emb = np.asarray(net.apply({"params": params["net"]},
+                               normalize_faces(crops, FACE)))
+    gallery.add(emb, labels)
+    outs = {}
+    for fused in (False, True):
+        pipe = RecognitionPipeline(det, net, params["net"], gallery,
+                                   face_size=FACE, top_k=1,
+                                   fused_embedder=fused)
+        outs[fused] = pipe.recognize_batch(scenes[:4])
+    np.testing.assert_array_equal(np.asarray(outs[False].valid),
+                                  np.asarray(outs[True].valid))
+    np.testing.assert_allclose(np.asarray(outs[False].boxes),
+                               np.asarray(outs[True].boxes), atol=1e-4)
+    # embeddings differ only by bf16 rounding -> near-identical sims; label
+    # flips are possible only at exact ties, which the synthetic gallery
+    # doesn't produce
+    np.testing.assert_array_equal(np.asarray(outs[False].labels),
+                                  np.asarray(outs[True].labels))
+    np.testing.assert_allclose(np.asarray(outs[False].similarities),
+                               np.asarray(outs[True].similarities), atol=2e-2)

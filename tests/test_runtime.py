@@ -331,6 +331,26 @@ def test_trainer_model_zoo():
         assert trainer.mean_accuracy > 0.7, model_type
 
 
+def test_trainer_lbp_fisherfaces_checkpoint(tmp_path):
+    """The r5 robustness config (raw r=3 LBP 6x6 -> Fisherfaces -> cosine
+    NN) trains, validates, and roundtrips through the msgpack checkpoint —
+    the composite (ChainOperator + SpatialHistogram(ExtendedLBP r=3) +
+    Fisherfaces + cosine NearestNeighbor) must all re-serialize."""
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    # 48x48 keeps the 6x6 grid cells at ~7 px (r=3 LBP crops 3 px/side)
+    X, y, names = make_synthetic_faces(5, 6, (48, 48), seed=41)
+    trainer = TheTrainer(model="lbp_fisherfaces", image_size=(48, 48),
+                         kfold=3)
+    path = str(tmp_path / "model.ckpt")
+    trainer.train(X, y, names, model_path=path)
+    assert trainer.mean_accuracy > 0.8
+    restored = serialization.load_model(path)
+    pred, _ = restored.predict(X[:4])
+    assert (np.asarray(pred) == y[:4]).mean() == 1.0
+    assert restored.subject_names == names
+
+
 def test_trainer_cnn_gallery_handoff():
     from opencv_facerecognizer_tpu.parallel import make_mesh
 
