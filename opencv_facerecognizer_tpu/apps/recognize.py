@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "pp: two-stage pipeline parallelism — detector on "
                         "half the devices, embedder+gallery on the other "
                         "half (needs an even device count >= 2)")
+    p.add_argument("--fused-embedder", action="store_true",
+                   help="run the embed stage on the fused pallas schedule "
+                        "(ops.pallas_sepblock; single-device mesh only — "
+                        "flip after scripts/bench_sepblock.py measures a "
+                        "win on your chip)")
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--flush-ms", type=float, default=30.0)
     p.add_argument("--transfer-uint8", action="store_true",
@@ -81,6 +86,12 @@ def _load_stack(args):
     from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
     from opencv_facerecognizer_tpu.utils import dataset as dataset_utils
     from opencv_facerecognizer_tpu.utils import serialization
+
+    # Pure argument validation FIRST — before checkpoint loads and the
+    # full gallery embedding pass, which can take minutes.
+    if args.fused_embedder and args.parallel == "pp":
+        raise SystemExit("--fused-embedder applies to --parallel fused only "
+                         "(stage-B meshes aren't single-device)")
 
     serialization.register(CNNEmbedding)
     model = serialization.load_model(args.model)
@@ -131,6 +142,7 @@ def _load_stack(args):
         pipeline = RecognitionPipeline(
             detector, feature.net, feature._params["net"], gallery,
             face_size=feature.input_size,
+            fused_embedder=args.fused_embedder,
         )
     return pipeline, names
 

@@ -144,6 +144,12 @@ CONFIGS = {
                         lambda: classic_kfold("lbp_fisherfaces", 30, 12, 10,
                                               seed=2, illumination=0.7,
                                               noise=14.0, **HARD_POSE)),
+    # the same config on the lbph row's LFW-analog protocol (it beats that
+    # row's chi-square recipe there too: 0.9625 vs 0.9250)
+    "lbp_fisherfaces_lfw": ("lbp_fisherfaces_lfw",
+                            lambda: classic_kfold("lbp_fisherfaces", 40, 8,
+                                                  10, seed=3, noise=18.0,
+                                                  **HARD_WILD)),
     "cnn": ("cnn_verification", cnn_verification),
 }
 
@@ -211,6 +217,8 @@ def main(argv=None):
          "lbph_lfw"),
         ("LBP-Fisherfaces (raw ExtendedLBP r=3 6x6 + PCA+LDA + cosine NN) "
          "k-fold, Yale-B-analog", "lbp_fisherfaces_yaleb"),
+        ("LBP-Fisherfaces, same config on the LFW-analog protocol",
+         "lbp_fisherfaces_lfw"),
         ("CNN ArcFace embedding, 6000-pair verification, disjoint identities",
          "cnn_verification"),
     ]
