@@ -279,6 +279,9 @@ CONFIGS = {
                                noise=18.0), 10),
     "lbph_hard": ("lbph", dict(num_subjects=40, per_subject=8, seed=3,
                                noise=18.0, **HARD_WILD), 10),
+    "lbp_fisherfaces_easy": ("lbp_fisherfaces",
+                             dict(num_subjects=30, per_subject=12, seed=2,
+                                  illumination=0.7, noise=14.0), 10),
     "lbp_fisherfaces_hard": ("lbp_fisherfaces",
                              dict(num_subjects=30, per_subject=12, seed=2,
                                   illumination=0.7, noise=14.0,
