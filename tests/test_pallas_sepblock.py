@@ -101,6 +101,21 @@ def test_fused_forward_serving_config_shapes():
     assert np.all(cos > 0.9999), cos
 
 
+def test_fused_forward_space_to_depth_variant():
+    """The s2d mirror branch (stem-stride folding) must track the flax
+    graph too — it's not the serving default but the config surface covers
+    it, and an untested branch could silently diverge."""
+    net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8, 16),
+                       stage_blocks=(1, 1), space_to_depth=2)
+    params = init_embedder(net, 4, (32, 32), seed=0)["net"]
+    x = RNG.normal(size=(2, 32, 32)).astype(np.float32)
+    want = np.asarray(net.apply({"params": params}, x))
+    got = np.asarray(fused_forward(net, params, jnp.asarray(x),
+                                   interpret=True, block_b=2))
+    cos = np.sum(got * want, axis=-1)
+    assert np.all(cos > 0.9999), cos
+
+
 def test_fused_forward_rejects_uncovered_configs():
     net = FaceEmbedNet(embed_dim=16, stem_features=8, stage_features=(8,),
                        stage_blocks=(1,), block="dense")
