@@ -18,7 +18,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("dataset", help="dataset dir: one sub-folder of images per subject")
     p.add_argument("model_path", help="output checkpoint path (.ckpt)")
     p.add_argument("--model", default="fisherfaces",
-                   choices=["fisherfaces", "eigenfaces", "lbph", "lbp_fisherfaces", "cnn"])
+                   choices=["fisherfaces", "eigenfaces", "lbph",
+                            "lbp_fisherfaces", "cnn", "auto"],
+                   help="model family; 'auto' k-folds every family on the "
+                        "dataset and keeps the measured winner")
     p.add_argument("--image-size", type=int, nargs=2, default=(70, 70),
                    metavar=("H", "W"))
     p.add_argument("--kfold", type=int, default=3)
@@ -53,6 +56,34 @@ def main(argv=None) -> int:
         parser.error(f"--knn-k only applies with --classifier nn "
                      f"(got --classifier {args.classifier})")
     from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer, TrainerConfig
+
+    if args.model == "auto":
+        # Flags that select a specific artifact shape don't compose with
+        # selection — fail loudly (this file's policy) instead of silently
+        # ignoring them.
+        if args.profile_dir or args.eigenfaces_plot:
+            parser.error("--profile-dir/--eigenfaces-plot don't apply with "
+                         "--model auto (profile/plot the selected model in "
+                         "a follow-up single-model run)")
+        from opencv_facerecognizer_tpu.runtime.trainer import select_model
+        from opencv_facerecognizer_tpu.utils import dataset as dataset_utils
+
+        images, labels, names = dataset_utils.read_images(
+            args.dataset, image_size=tuple(args.image_size))
+        trainer, scores = select_model(
+            images, labels, names, model_path=args.model_path,
+            image_size=tuple(args.image_size), kfold=args.kfold,
+            num_components=args.num_components, knn_k=args.knn_k,
+            tan_triggs=not args.no_tan_triggs, embed_dim=args.embed_dim,
+            train_steps=args.train_steps,
+            classifier=args.classifier, svm_kernel=args.svm_kernel,
+        )
+        for kind in sorted(scores, key=scores.get, reverse=True):
+            print(f"  {kind:>16}: {scores[kind]:.4f} k-fold")
+        print(f"selected: {trainer.config.model} "
+              f"({trainer.mean_accuracy:.4f} mean k-fold accuracy)")
+        print(f"model saved to {args.model_path}")
+        return 0
 
     config = TrainerConfig(
         model=args.model,

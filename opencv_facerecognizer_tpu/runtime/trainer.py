@@ -190,6 +190,32 @@ class TheTrainer:
     def mean_accuracy(self) -> float:
         return self.validation.mean_accuracy if self.validation else float("nan")
 
+    # ---- model selection ----
+
+    #: k-fold selection order for ``select_model``: cheap classics first,
+    #: the CNN backend last (it trains longest). The round-5 measured
+    #: default winner (lbp_fisherfaces) sits where its train cost does.
+    SELECT_CANDIDATES = ("eigenfaces", "fisherfaces", "lbph",
+                         "lbp_fisherfaces", "cnn")
+
+    def validate_only(self, images: np.ndarray, labels: np.ndarray,
+                      subject_names: List[str]) -> float:
+        """K-fold this config on a scratch model WITHOUT the full-dataset
+        fit (``train`` = this + fit; ``select_model`` scores candidates
+        with this so losers never pay the fit — for the CNN backend that
+        fit is the whole training run again). Returns the mean accuracy;
+        ``self.validation`` holds the folds."""
+        from opencv_facerecognizer_tpu.ops import image as image_ops
+
+        images = np.asarray(images, np.float32)
+        if images.shape[1:] != tuple(self.config.image_size):
+            images = np.asarray(image_ops.resize(images, self.config.image_size))
+        labels = np.asarray(labels, np.int32)
+        scratch = self._build_model(subject_names)
+        self.validation = KFoldCrossValidation(
+            k=max(self.config.kfold, 2)).validate(scratch, images, labels)
+        return self.mean_accuracy
+
     # ---- serving handoff (cnn backend) ----
 
     def build_gallery(self, images: np.ndarray, labels: np.ndarray, mesh, capacity: int = 0):
@@ -204,3 +230,45 @@ class TheTrainer:
         gallery = ShardedGallery(capacity=capacity, dim=emb.shape[1], mesh=mesh)
         gallery.add(emb, np.asarray(labels, np.int32))
         return gallery
+
+
+def select_model(
+    images: np.ndarray,
+    labels: np.ndarray,
+    subject_names: List[str],
+    candidates: Optional[Tuple[str, ...]] = None,
+    model_path: Optional[str] = None,
+    **config_overrides,
+) -> Tuple[TheTrainer, Dict[str, float]]:
+    """K-fold every candidate model kind on the SAME data and keep the
+    winner: the reference workflow's 'which classic do I use?' question as
+    a one-call measured answer (the round-5 LBP-Fisherfaces result showed
+    the answer is dataset-dependent and guessing costs double-digit
+    accuracy points).
+
+    Each candidate scores through ``TheTrainer.validate_only`` with the
+    shared ``config_overrides`` (kfold, image_size, classifier, ...); only
+    the winner pays the full-dataset fit. Returns ``(winning trainer —
+    trained on the full set and checkpointed to ``model_path`` if given,
+    {kind: mean k-fold accuracy})``. Ties break toward the earlier
+    candidate (cheaper family).
+    """
+    from opencv_facerecognizer_tpu.ops import image as image_ops
+
+    candidates = tuple(candidates or TheTrainer.SELECT_CANDIDATES)
+    trainers = {kind: TheTrainer(TrainerConfig(model=kind), **config_overrides)
+                for kind in candidates}
+    # image_size is shared (same overrides) — resize ONCE here; each
+    # validate_only's internal resize then no-ops on matching shapes.
+    shared_size = tuple(trainers[candidates[0]].config.image_size)
+    images = np.asarray(images, np.float32)
+    if images.shape[1:] != shared_size:
+        images = np.asarray(image_ops.resize(images, shared_size))
+    scores: Dict[str, float] = {}
+    for kind in candidates:
+        scores[kind] = float(trainers[kind].validate_only(
+            images, labels, subject_names))
+    best = max(candidates, key=lambda k: scores[k])
+    winner = trainers[best]
+    winner.train(images, labels, subject_names, model_path, validate=False)
+    return winner, scores

@@ -394,3 +394,24 @@ def test_trainer_classifier_swap(tmp_path):
         assert (np.asarray(pred) == y[:6]).mean() >= 0.8, clf_kind
     with pytest.raises(ValueError):
         TheTrainer(classifier="nope").train(X, y, names, validate=False)
+
+
+def test_select_model_picks_measured_winner(tmp_path):
+    """select_model k-folds every candidate on the same data, fits ONLY
+    the winner on the full set, and checkpoints it (the 'which model?'
+    question answered by measurement — SURVEY §2.1 Validation extension)."""
+    from opencv_facerecognizer_tpu.runtime.trainer import select_model
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    X, y, names = make_synthetic_faces(5, 6, (48, 48), seed=41)
+    path = str(tmp_path / "auto.ckpt")
+    winner, scores = select_model(
+        X, y, names, candidates=("eigenfaces", "lbp_fisherfaces"),
+        model_path=path, image_size=(48, 48), kfold=3)
+    assert set(scores) == {"eigenfaces", "lbp_fisherfaces"}
+    best = max(scores, key=scores.get)
+    assert winner.config.model == best
+    assert winner.mean_accuracy == scores[best]
+    restored = serialization.load_model(path)
+    pred, _ = restored.predict(X[:4])
+    assert (np.asarray(pred) == y[:4]).mean() >= 0.75
