@@ -101,20 +101,11 @@ run sweep python scripts/explore_perf.py --skip-detector
 run sepblock python scripts/bench_sepblock.py
 # 6c. if THIS run's sepblock job succeeded (gate on its exit status — a
 # stale sepblock_fused section from a prior refresh must not trigger the
-# re-run) and the fused schedule won the A/B (>=5% at any measured batch),
-# re-measure the full headline under it, recorded as a SIBLING section so
-# the default schedule's sweep stays intact for comparison
-if [ "$LAST_EXIT" = "0" ] && python - <<'PYEOF'
-import json, sys
-try:
-    d = json.load(open("BENCH_DETAIL.json"))
-    sp = [v.get("speedup", 0) or 0
-          for v in d.get("sepblock_fused", {}).get("batches", {}).values()]
-    sys.exit(0 if sp and max(sp) >= 1.05 else 1)
-except Exception:
-    sys.exit(1)
-PYEOF
-then
+# re-run) and the fused schedule won the A/B (>=5% at any measured batch,
+# decision logic unit-tested in tests/test_queue_gate.py), re-measure the
+# full headline under it, recorded as a SIBLING section so the default
+# schedule's sweep stays intact for comparison
+if [ "$LAST_EXIT" = "0" ] && python scripts/check_sepblock_win.py; then
   run bench_fused env OCVF_FUSED_EMBEDDER=1 OCVF_DETAIL_SECTION=sweep_fused python bench.py
 fi
 # 7. serving bench (latency model with new dispatch quote)

@@ -1,0 +1,46 @@
+"""The measurement queue's fused-schedule re-run gate
+(scripts/check_sepblock_win.py): pure decision logic, pinned here so the
+queue's one branch can't silently rot."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+from check_sepblock_win import sepblock_won  # noqa: E402
+
+
+def _write(tmp_path, doc):
+    p = tmp_path / "BENCH_DETAIL.json"
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_win_at_any_batch_triggers(tmp_path):
+    doc = {"sepblock_fused": {"batches": {
+        "64": {"speedup": 0.98}, "256": {"speedup": 1.31}}}}
+    assert sepblock_won(_write(tmp_path, doc))
+
+
+def test_below_threshold_does_not_trigger(tmp_path):
+    doc = {"sepblock_fused": {"batches": {
+        "64": {"speedup": 1.01}, "256": {"speedup": 1.04}}}}
+    assert not sepblock_won(_write(tmp_path, doc))
+
+
+def test_failed_ab_rows_do_not_trigger(tmp_path):
+    # bench_sepblock records {"error": ...} rows (no speedup key) when a
+    # side fails — those must read as no-win, not crash
+    doc = {"sepblock_fused": {"batches": {
+        "64": {"flax": {"error": "Mosaic"}},
+        "256": {"speedup": None}}}}
+    assert not sepblock_won(_write(tmp_path, doc))
+
+
+def test_missing_file_or_section_does_not_trigger(tmp_path):
+    assert not sepblock_won(str(tmp_path / "nope.json"))
+    assert not sepblock_won(_write(tmp_path, {}))
+    (tmp_path / "bad.json").write_text("{not json")
+    assert not sepblock_won(str(tmp_path / "bad.json"))
