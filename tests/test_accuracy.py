@@ -15,7 +15,7 @@ Bands sit ~3 points below the round-3 HARD-protocol measurements
 (BASELINE.md, 2026-07-30: pose rotation + scale jitter + elastic
 deformation + occlusion on every config — see scripts/measure_accuracy.py
 HARD_POSE/HARD_WILD): eigenfaces 0.895, fisherfaces 0.8283, lbph 0.925,
-cnn 0.9937 (300 train identities, in-graph augmentation, flip-TTA). The
+cnn 0.9943 (300 train identities, in-graph augmentation, flip-TTA). The
 classics drop honestly under occlusion/pose — linear templates cannot
 model either — while the CNN band stays pinned at the >=0.99 north star.
 """
@@ -47,8 +47,9 @@ MEASURED_BANDS = {
     "lbp_fisherfaces_lfw": ("LBP-Fisherfaces, same config on the LFW", 0.93),
     "lbp_fisherfaces_orl": ("LBP-Fisherfaces, same config on the ORL", 0.96),
     # band == the north star: a recorded measurement below >=0.99 must fail
-    # even if it's otherwise plausible (hard protocol measured 0.9937
-    # +/- 0.0036 with augmentation + TTA)
+    # even if it's otherwise plausible (hard protocol measured 0.9943
+    # +/- 0.0020 at 30000 steps/b192, on-chip 2026-07-31, with
+    # augmentation + TTA)
     "cnn": ("CNN ArcFace", 0.99),
 }
 
@@ -149,31 +150,26 @@ def test_canary_cnn_verification():
 
 def test_cnn_fold_min_above_north_star():
     """The >=0.99 bar gates the verification spread's LOWER edge, not the
-    mean (VERDICT r3 item #4). Measured r4 (30000 steps, batch 192): mean
-    0.9943 +/- 0.0020, fold_min 0.9917 (scripts/.gate_embedder.jsonl,
-    tag baseline_30000_b192 — the recipe measure_accuracy.py records).
+    mean (VERDICT r3 item #4). Measured live on-chip 2026-07-31 (30000
+    steps, batch 192): mean 0.9943 +/- 0.0020, fold_min 0.9917 — exactly
+    reproducing the r4 gate-run artifact.
 
-    The gate reads fold_min from the accuracy cache when the post-r4
-    measurement has been run; otherwise it falls back to the committed
-    gate-run artifact for the SAME protocol/recipe, so the lower-edge bar
-    is enforced against a real measurement either way."""
+    Reads ONLY scripts/.accuracy_cache.json (the live measurement cache
+    that scripts/measure_accuracy.py --only cnn refreshes). The r4-outage
+    fallback to the committed .gate_embedder.jsonl artifact was burned
+    down once the on-chip refresh landed (VERDICT r4 item #7): a
+    regression band that gates a checked-in artifact can't catch a
+    regression until the refresh lands."""
     import json
 
-    fold_min = None
     cache = os.path.join(REPO, "scripts", ".accuracy_cache.json")
-    if os.path.exists(cache):
-        fold_min = json.load(open(cache)).get(
-            "cnn_verification", {}).get("fold_min")
-    if fold_min is None:
-        gate = os.path.join(REPO, "scripts", ".gate_embedder.jsonl")
-        assert os.path.exists(gate), (
-            "no fold_min measurement anywhere: run "
-            "scripts/measure_accuracy.py --only cnn")
-        rows = [json.loads(l) for l in open(gate) if l.strip()]
-        match = [r for r in rows if r.get("tag") == "baseline_30000_b192"]
-        assert match, ("gate artifact lacks the recorded recipe row "
-                       "baseline_30000_b192; re-measure")
-        fold_min = match[-1]["fold_min"]
+    assert os.path.exists(cache), (
+        "no accuracy cache: run scripts/measure_accuracy.py --only cnn")
+    fold_min = json.load(open(cache)).get(
+        "cnn_verification", {}).get("fold_min")
+    assert fold_min is not None, (
+        "accuracy cache lacks cnn_verification.fold_min: re-run "
+        "scripts/measure_accuracy.py --only cnn")
     assert fold_min >= 0.99, (
         f"CNN verification fold minimum {fold_min} fell below the "
         ">=0.99 north star — the spread's lower edge regressed")
