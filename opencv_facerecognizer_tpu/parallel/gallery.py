@@ -243,7 +243,7 @@ class ShardedGallery:
         #: without this, crossing 16k->1M (7 tiers x shapes x dtypes)
         #: permanently retains every stale tier's executables.
         self.evict_hooks = []
-        self._pending: list = []  # [(emb_rows, lab_rows)] staged enrolments
+        self._pending: list = []  # [[emb_rows, lab_rows, normalized?]] staged
         self._pending_count = 0
         self._growing = False
         self._grow_thread: Optional[threading.Thread] = None
@@ -290,6 +290,12 @@ class ShardedGallery:
 
     # ---- enrolment (host-side; serving never blocks on these) ----
 
+    @staticmethod
+    def _normalize_rows(embeddings: np.ndarray) -> np.ndarray:
+        return embeddings / np.maximum(
+            np.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-12
+        )
+
     def add(self, embeddings: np.ndarray, labels: np.ndarray) -> None:
         """Append L2-normalized rows, auto-growing on overflow.
 
@@ -300,35 +306,57 @@ class ShardedGallery:
         stalling that serving batch by seconds on real hardware.
 
         ``async_grow=True`` (the serving configuration): an overflowing
-        add stages its rows host-side and returns immediately; a
-        background worker compiles the next tier's graphs (via
-        ``prewarm_hooks``), builds the grown snapshot, and publishes it
-        atomically — serving threads never pay the compile, and the rows
-        become matchable when ``wait_ready`` unblocks (``pending_rows``
-        exposes the in-flight count). Additionally, any add that fills the
-        gallery past ``PREWARM_FILL_FRACTION`` kicks the next tier's
-        compile early, so the eventual grow usually only pays the
-        install.
+        add stages its rows host-side RAW and returns immediately — even
+        the L2 normalization runs on the grow worker (measured 16 s for
+        920k rows on a 1-core host; an enrolling connector thread must not
+        pay that). The worker compiles the next tier's graphs
+        (``prewarm_hooks``), normalizes + splices the staged rows, uploads
+        the grown snapshot, WAITS for device residency (serving keeps
+        reading the old tier — otherwise the first new-tier call absorbs
+        the multi-second H2D of a large gallery; measured 36 s at 1M rows
+        on the tunneled backend), then publishes atomically. Rows become
+        matchable when ``wait_ready`` unblocks (``pending_rows`` exposes
+        the in-flight count). Additionally, any add that fills the gallery
+        past ``PREWARM_FILL_FRACTION`` kicks the next tier's compile
+        early, so the eventual grow usually only pays copy + upload.
         """
         embeddings = np.asarray(embeddings, np.float32)
-        embeddings = embeddings / np.maximum(
-            np.linalg.norm(embeddings, axis=-1, keepdims=True), 1e-12
-        )
         labels = np.asarray(labels, np.int32)
         n = embeddings.shape[0]
+        # Optimistic branch predict, OUTSIDE the lock: the sync path needs
+        # normalized rows, and normalizing a large add while holding the
+        # write lock would block every other enroller behind it. A raced
+        # prediction is only a cost shift: predicted-sync-but-staged wastes
+        # one normalization (flagged True, worker skips), predicted-staged-
+        # but-sync normalizes under the lock (rare; both windows are the
+        # gap between this read and the locked re-check).
+        normalized = not (self.async_grow and (self._growing or self._pending
+                                               or self.size + n > self.capacity))
+        if normalized:
+            embeddings = self._normalize_rows(embeddings)  # dividing copy
+        else:
+            # Private copy before staging: asarray is a no-copy view of a
+            # float32 input, and a staged-by-reference buffer the caller
+            # refills after add() returns would enroll garbage (the worker
+            # may not splice for seconds). ~0.3 s memcpy at 920k rows vs
+            # the 16 s normalization being deferred.
+            embeddings = np.array(embeddings, copy=True)
         start_worker = False
         evict_below = None
         with self._write_lock:
             size = self.size
             if self.async_grow and (self._growing or self._pending
                                     or size + n > self.capacity):
-                # Stage; the worker owns all host-array mutation while a
-                # grow is in flight (a direct write here would race the
-                # worker's copy of the old arrays). Non-empty pending with
-                # no worker means a previous grow FAILED: later adds must
+                # Stage RAW; the worker owns all host-array mutation while
+                # a grow is in flight (a direct write here would race the
+                # worker's copy of the old arrays) and normalizes staged
+                # rows off this thread. Entries are mutable lists so the
+                # worker can swap in the normalized array in place:
+                # [rows, labels, normalized?]. Non-empty pending with no
+                # worker means a previous grow FAILED: later adds must
                 # queue behind the stranded rows (enrolment order), and
                 # this add restarts the worker to retry them.
-                self._pending.append((embeddings, labels))
+                self._pending.append([embeddings, labels, normalized])
                 self._pending_count += n
                 if not self._growing:
                     self._growing = True
@@ -341,6 +369,8 @@ class ShardedGallery:
                 # Host mirrors are the source of truth for enrolment: a
                 # device readback here would trigger the axon backend's
                 # sync-poll mode (see runtime.recognizer module docstring).
+                if not normalized:  # lost the branch-predict race
+                    embeddings = self._normalize_rows(embeddings)
                 self._host_emb[size : size + n] = embeddings
                 self._host_lab[size : size + n] = labels
                 self._host_val[size : size + n] = True
@@ -428,16 +458,66 @@ class ShardedGallery:
             daemon=True, name="gallery-prewarm",
         ).start()
 
+    #: grow worker gives up waiting for device residency after this long
+    #: and publishes anyway (availability over stall avoidance); generous
+    #: because a 1M-row gallery is ~1 GB over a ~30 MB/s tunnel.
+    RESIDENCY_TIMEOUT_S = 300.0
+
+    @staticmethod
+    def _await_residency(data: "GalleryData", timeout_s: float,
+                         cancel=None, info=None) -> bool:
+        """Poll ``jax.Array.is_ready`` (non-blocking — a synchronous
+        readback would drop the process into the axon backend's ~100 ms
+        poll mode) until the snapshot's H2D transfers complete. True on
+        resident, False on timeout or a backend without is_ready.
+        ``cancel()`` returning True aborts the wait immediately — a
+        reset/swap_from that doomed this snapshot must not keep the
+        worker polling for up to the full timeout."""
+        import time as _time
+
+        arrays = (data.embeddings, data.labels, data.valid)
+        deadline = _time.monotonic() + timeout_s
+        while _time.monotonic() < deadline:
+            if cancel is not None and cancel():
+                return True  # doomed snapshot; publish check discards it
+            try:
+                if all(a.is_ready() for a in arrays):
+                    return True
+            except (AttributeError, NotImplementedError):
+                return True  # no is_ready on this backend: don't block
+            except Exception as e:
+                # A transient backend error must not silently skip the
+                # wait (publishing early recreates the 36 s first-call
+                # stall this path exists to prevent) — record and keep
+                # polling until resident or timeout.
+                if info is not None and "residency_probe_error" not in info:
+                    info["residency_probe_error"] = repr(e)
+            _time.sleep(0.02)
+        return False
+
     def _grow_worker(self) -> None:
-        """Off-the-serving-path growth: copy -> compile (hooks) -> splice
-        pending -> atomic install. Serving threads keep reading the old
-        snapshot throughout; ``reset``/``swap_from`` bump ``_epoch`` to
-        invalidate an in-flight grow."""
+        """Off-the-serving-path growth: compile (hooks) -> copy ->
+        normalize staged rows -> splice -> upload -> await residency ->
+        atomic publish. Serving threads keep reading the OLD snapshot
+        until the grown arrays are device-resident — publishing earlier
+        makes the first new-tier call absorb the whole H2D transfer
+        (measured 36 s for a 1M-row gallery on the tunneled backend).
+        ``reset``/``swap_from`` bump ``_epoch`` to invalidate an in-flight
+        grow; the epoch is re-checked at splice AND at publish, so a
+        reset during the residency wait wins and the stale snapshot is
+        dropped."""
         import time as _time
 
         info = {}
+        spliced = None  # popped-but-unpublished entries; see except below
+        epoch = None
         try:
             while True:
+                spliced = None
+                # Per-round flags: a round-1 timeout must not misreport a
+                # round-2 publish that DID wait successfully.
+                info.pop("residency_timeout", None)
+                info.pop("residency_probe_error", None)
                 with self._write_lock:
                     if not self._pending:
                         self._growing = False
@@ -462,42 +542,85 @@ class ShardedGallery:
                 lab[:old_cap] = old_lab
                 val[:old_cap] = old_val
                 info["copy_s"] = round(_time.perf_counter() - t0, 3)
+                # Normalize staged rows here, not on the enrolling thread
+                # (add() stages raw). In-place entry mutation is GIL-atomic
+                # and safe against a concurrent reset clearing the list —
+                # a cleared entry is garbage either way. Entries staged
+                # after this sweep stay unnormalized and are left for the
+                # next worker round (the splice below stops at the first
+                # unnormalized entry, preserving enrolment order).
+                t0 = _time.perf_counter()
+                with self._write_lock:
+                    sweep = list(self._pending)
+                for entry in sweep:
+                    if not entry[2]:
+                        entry[0] = self._normalize_rows(entry[0])
+                        entry[2] = True
+                info["normalize_s"] = round(_time.perf_counter() - t0, 3)
                 with self._write_lock:
                     if self._epoch != epoch:
                         # reset/swap_from superseded this grow; drop it and
                         # re-examine what (if anything) is still pending.
                         continue
-                    # Splice EVERYTHING pending (including adds staged
-                    # while compiling); if late adds overflow the target,
-                    # loop for another round.
+                    # Splice every normalized entry that fits (adds staged
+                    # after the sweep, or overflowing the target, loop for
+                    # another round). Popped entries are NOT yet published:
+                    # counts and host mirrors move at publish time, and an
+                    # epoch bump in between discards them exactly like a
+                    # reset discards pending rows.
                     fits = []
                     n_fit = 0
                     while self._pending:
-                        e_rows, l_rows = self._pending[0]
-                        if size + n_fit + len(e_rows) > target:
+                        entry = self._pending[0]
+                        if not entry[2] or size + n_fit + len(entry[0]) > target:
                             break
-                        fits.append((e_rows, l_rows))
-                        n_fit += len(e_rows)
+                        fits.append(entry)
+                        n_fit += len(entry[0])
                         self._pending.pop(0)
+                    spliced = fits  # restored by the except path if the
+                    # upload below dies before these rows publish
                     pos = size
-                    for e_rows, l_rows in fits:
+                    for e_rows, l_rows, _ in fits:
                         emb[pos : pos + len(e_rows)] = e_rows
                         lab[pos : pos + len(e_rows)] = l_rows
                         val[pos : pos + len(e_rows)] = True
                         pos += len(e_rows)
-                    self._pending_count -= n_fit
+                # Upload OUTSIDE the lock and wait for residency while
+                # serving threads still read the old tier. A reset/swap
+                # epoch bump cancels the wait immediately.
+                t0 = _time.perf_counter()
+                new_data = self._build_snapshot(emb, lab, val, pos)
+                if not self._await_residency(new_data, self.RESIDENCY_TIMEOUT_S,
+                                             cancel=lambda: self._epoch != epoch,
+                                             info=info):
+                    info["residency_timeout"] = True
+                info["upload_wait_s"] = round(_time.perf_counter() - t0, 3)
+                t0 = _time.perf_counter()
+                with self._write_lock:
+                    if self._epoch != epoch:
+                        continue  # a reset/swap during the wait wins; the
+                        # spliced rows are discarded exactly as the reset
+                        # discarded the rest of pending
                     self._host_emb, self._host_lab, self._host_val = emb, lab, val
                     self.capacity = target
                     self.grow_count += 1
-                    t0 = _time.perf_counter()
-                    self._install(emb, lab, val, pos)
-                    info["install_s"] = round(_time.perf_counter() - t0, 3)
+                    self._pending_count -= n_fit
+                    self._data = new_data
+                    spliced = None  # published: nothing to restore
+                info["install_s"] = round(_time.perf_counter() - t0, 3)
                 # Outside the lock: drop compiled entries for tiers below
                 # the one just replaced (see evict_hooks).
                 self._evict_stale(old_cap)
         except Exception as e:  # never leave waiters hanging
             info["error"] = repr(e)
             with self._write_lock:
+                if spliced and self._epoch == epoch:
+                    # Popped but never published (e.g. device_put died at
+                    # the new tier): put the rows back at the head so
+                    # ``pending_rows`` stays truthful and the next add()
+                    # retries them in enrolment order. On an epoch bump
+                    # they stay dropped, like the rest of pending.
+                    self._pending[:0] = spliced
                 self._growing = False
                 self._grow_done.set()
                 self.last_grow_info = info
@@ -552,15 +675,21 @@ class ShardedGallery:
             self._host_val = np.zeros((self.capacity,), bool)
             self._install(self._host_emb, self._host_lab, self._host_val, 0)
 
-    def _install(self, emb: np.ndarray, lab: np.ndarray, val: np.ndarray, size: int) -> None:
-        # Build the full snapshot first, publish with ONE attribute write —
-        # serving threads reading self._data never see a partial install.
-        self._data = GalleryData(
+    def _build_snapshot(self, emb: np.ndarray, lab: np.ndarray,
+                        val: np.ndarray, size: int) -> GalleryData:
+        """Device-put the arrays WITHOUT publishing (the async grow worker
+        waits for residency between build and publish)."""
+        return GalleryData(
             embeddings=jax.device_put(jnp.asarray(emb), self._emb_sharding),
             labels=jax.device_put(jnp.asarray(lab), self._lab_sharding),
             valid=jax.device_put(jnp.asarray(val), self._valid_sharding),
             size=size,
         )
+
+    def _install(self, emb: np.ndarray, lab: np.ndarray, val: np.ndarray, size: int) -> None:
+        # Build the full snapshot first, publish with ONE attribute write —
+        # serving threads reading self._data never see a partial install.
+        self._data = self._build_snapshot(emb, lab, val, size)
 
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
         """Host-mirror copies (no device readback)."""

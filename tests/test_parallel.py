@@ -367,6 +367,33 @@ def test_gallery_reset_cancels_inflight_grow():
     assert g.pending_rows == 0
 
 
+def test_gallery_async_grow_normalizes_on_worker_and_waits_residency():
+    """add() stages RAW rows — the enrolling thread pays no normalization
+    (measured 16 s for 920k rows on a 1-core host); the worker normalizes
+    before splicing, waits for device residency BEFORE the atomic publish
+    (so the first new-tier serving call doesn't absorb the gallery H2D),
+    and records the phase decomposition in last_grow_info."""
+    mesh = make_mesh(tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh, async_grow=True)
+    g.add(np.full((8, 4), 7.0, np.float32), np.arange(8, dtype=np.int32))
+    raw = np.full((8, 4), 5.0, np.float32)  # deliberately unnormalized
+    g.add(raw, np.arange(8, 16, dtype=np.int32))  # overflow -> staged raw
+    raw[:] = -3.0  # caller reuses its buffer: staging must have copied
+    assert g.wait_ready(timeout=30)
+    assert g.size == 16 and g.pending_rows == 0
+    # every landed row is unit-norm even though the add staged raw rows
+    norms = np.linalg.norm(g._host_emb[:16], axis=-1)
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-5)
+    # ...and holds the values STAGED, not the caller's later mutation
+    np.testing.assert_allclose(g._host_emb[8:16], 0.5, rtol=1e-5)
+    info = g.last_grow_info
+    assert "normalize_s" in info and "upload_wait_s" in info
+    assert "install_s" in info and not info.get("residency_timeout")
+    # the published device snapshot is the residency-checked one
+    np.testing.assert_allclose(np.asarray(g.data.embeddings)[:16],
+                               g._host_emb[:16], rtol=1e-6)
+
+
 def test_pipeline_prewarm_registers_and_compiles_future_tier():
     """RecognitionPipeline registers a prewarm hook; after an async grow
     the serving-path cache already holds the new tier's packed step (keyed
