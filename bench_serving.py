@@ -306,8 +306,18 @@ def main(argv=None):
                  "publish are the pipeline's own cost)."),
         **sections,
     }
+    # MERGE over the existing artifact: scripts/probe_dispatch.py owns the
+    # dispatch_decomposition section of this file, and a whole-file rewrite
+    # here silently destroyed it once (r5 queue: serving ran last and
+    # clobbered the probe's data).
+    try:
+        with open("BENCH_SERVING.json") as fh:
+            existing = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        existing = {}
+    existing.update(artifact)
     with open("BENCH_SERVING.json", "w") as fh:
-        json.dump(artifact, fh, indent=2)
+        json.dump(existing, fh, indent=2)
     print("wrote BENCH_SERVING.json", file=sys.stderr)
 
     if not args.skip_latency_mode:

@@ -79,39 +79,49 @@ def _sepblock_kernel(*refs, stride: int, groups: int,
                      eps: float, residual: bool, out_h: int, out_w: int):
     """One batch tile: the whole separable block, VMEM-resident.
 
-    Refs: [x_ref only when residual] xpad_ref, wdw_ref, g1s_ref, g1b_ref,
+    Refs: [x_ref only when residual] xin_ref, wdw_ref, g1s_ref, g1b_ref,
     wpw_ref, g2s_ref, g2b_ref, out_ref. x_ref [Bb, H, W, C] is the
     residual source and is only an input at all when the block HAS a
     residual — shipping it HBM->VMEM on the stride-2 stage heads would be
     dead bandwidth on the exact path this kernel exists to speed up.
-    xpad_ref [Bb, H+2, W+2, C] is the SAME-padded dw input (stride 2 uses
-    rows/cols [0:H+1], matching XLA's lo=0/hi=1 SAME split); wdw_ref
-    [3, 3, C]; wpw_ref [C, F]; out_ref [Bb, out_h, out_w, F].
+
+    xin_ref is the SAME-padded dw input in a stride-dependent layout:
+    stride 1 -> [Bb, H+2, W+2, C] (taps are plain unstrided slices);
+    stride 2 -> [Bb, 4, (H+2)/2, (W+2)/2, C], the four even/odd phase
+    planes of the padded input (phase index = (y%2)*2 + x%2), built by
+    XLA. Mosaic rejects strided vector slices (the r5 on-chip A/B died
+    with 'expected strides to be confined to [1, 2)'), so the stride-2
+    tap (dy, dx) instead reads phase (dy%2, dx%2) at offset
+    (dy//2, dx//2) — an unstrided slice of a phase plane.
+    wdw_ref [3, 3, C]; wpw_ref [C, F]; out_ref [Bb, out_h, out_w, F].
     """
     if residual:
-        (x_ref, xpad_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
+        (x_ref, xin_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
          g2b_ref, out_ref) = refs
     else:
-        (xpad_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
+        (xin_ref, wdw_ref, g1s_ref, g1b_ref, wpw_ref, g2s_ref,
          g2b_ref, out_ref) = refs
-    xpad = xpad_ref[:].astype(jnp.float32)
+    xin = xin_ref[:].astype(jnp.float32)
     wdw = wdw_ref[:].astype(jnp.float32)
-    bb = xpad_ref.shape[0]
-    c = xpad_ref.shape[3]
+    bb = xin_ref.shape[0]
+    c = xin_ref.shape[-1]
 
     # depthwise 3x3 as 9 unrolled shifted FMAs (VPU); bf16-round the
     # operands once, accumulate f32 — mirrors the MXU's bf16xbf16->f32.
-    span_h = (out_h - 1) * stride + 1
-    span_w = (out_w - 1) * stride + 1
     acc = jnp.zeros((bb, out_h, out_w, c), jnp.float32)
     for dy in range(3):
         for dx in range(3):
-            patch = jax.lax.slice(
-                xpad,
-                (0, dy, dx, 0),
-                (bb, dy + span_h, dx + span_w, c),
-                (1, stride, stride, 1),
-            )
+            if stride == 1:
+                patch = jax.lax.slice(
+                    xin, (0, dy, dx, 0), (bb, dy + out_h, dx + out_w, c))
+            else:
+                ph_idx = (dy % 2) * 2 + (dx % 2)
+                i0, j0 = dy // 2, dx // 2
+                patch = jax.lax.slice(
+                    xin,
+                    (0, ph_idx, i0, j0, 0),
+                    (bb, ph_idx + 1, i0 + out_h, j0 + out_w, c),
+                ).reshape(bb, out_h, out_w, c)
             patch = patch.astype(jnp.bfloat16).astype(jnp.float32)
             w = wdw[dy, dx, :].astype(jnp.bfloat16).astype(jnp.float32)
             acc = acc + patch * w[None, None, None, :]
@@ -164,26 +174,38 @@ def fused_sep_block(x, w_dw, g1_scale, g1_bias, w_pw, g2_scale, g2_bias, *,
     # last row/col; stride 1 pads (1, 1).
     pad_lo = 1 if stride == 1 else 0
     pad_hi = 2 - pad_lo
-    xpad = jnp.pad(x, ((0, 0), (pad_lo, pad_hi), (pad_lo, pad_hi), (0, 0)))
 
     block_b = max(1, min(block_b, b))
     b_pad = (-b) % block_b
     if b_pad:
         x = jnp.pad(x, ((0, b_pad), (0, 0), (0, 0), (0, 0)))
-        xpad = jnp.pad(xpad, ((0, b_pad), (0, 0), (0, 0), (0, 0)))
+    xpad = jnp.pad(x, ((0, 0), (pad_lo, pad_hi), (pad_lo, pad_hi), (0, 0)))
+    if stride == 1:
+        xin = xpad
+        xin_spec = pl.BlockSpec((block_b, h + 2, w + 2, c),
+                                lambda i: (i, 0, 0, 0))
+    else:
+        # Even/odd phase decomposition in XLA (strided slices are fine
+        # here; they are NOT inside the kernel — Mosaic rejects them, see
+        # _sepblock_kernel docstring). [B, 4, (H+2)/2, (W+2)/2, C].
+        xin = jnp.stack([xpad[:, a::2, b2::2, :]
+                         for a in (0, 1) for b2 in (0, 1)], axis=1)
+        xin_spec = pl.BlockSpec(
+            (block_b, 4, (h + 2) // 2, (w + 2) // 2, c),
+            lambda i: (i, 0, 0, 0, 0))
     grid = (x.shape[0] // block_b,)
 
     full = lambda *s: pl.BlockSpec(s, lambda i: (0,) * len(s))  # noqa: E731
     # x (the residual source) is only an input when the block has a
     # residual: stride-2 stage heads skip the dead HBM->VMEM copy.
     in_specs = [
-        pl.BlockSpec((block_b, h + 2, w + 2, c), lambda i: (i, 0, 0, 0)),
+        xin_spec,
         full(3, 3, c),
         full(c), full(c),
         full(c, f),
         full(f), full(f),
     ]
-    inputs = [xpad, w_dw[:, :, 0, :], g1_scale, g1_bias, w_pw[0, 0],
+    inputs = [xin, w_dw[:, :, 0, :], g1_scale, g1_bias, w_pw[0, 0],
               g2_scale, g2_bias]
     if residual:
         in_specs.insert(0, pl.BlockSpec((block_b, h, w, c),
