@@ -252,6 +252,7 @@ class ShardedGallery:
         self._epoch = 0  # bumped by reset/swap_from to invalidate a grow
         self._warmed_capacities = set()
         self._warm_events = {}  # capacity -> Event, set when its warm ends
+        self._chunk_jit = None  # (key, zeros, update) for _chunked_emb_put
         self.last_grow_info: dict = {}
         self._data = GalleryData(
             embeddings=jax.device_put(
@@ -589,7 +590,9 @@ class ShardedGallery:
                 # serving threads still read the old tier. A reset/swap
                 # epoch bump cancels the wait immediately.
                 t0 = _time.perf_counter()
-                new_data = self._build_snapshot(emb, lab, val, pos)
+                new_data = self._build_snapshot(
+                    emb, lab, val, pos, chunked=True,
+                    cancel=lambda: self._epoch != epoch, info=info)
                 if not self._await_residency(new_data, self.RESIDENCY_TIMEOUT_S,
                                              cancel=lambda: self._epoch != epoch,
                                              info=info):
@@ -675,12 +678,85 @@ class ShardedGallery:
             self._host_val = np.zeros((self.capacity,), bool)
             self._install(self._host_emb, self._host_lab, self._host_val, 0)
 
+    #: grow-worker uploads larger than 2x this are split into chunks of
+    #: this many bytes, PACED one at a time: the r5 lifecycle capture
+    #: measured a serving call stuck 78 s behind the un-chunked 1 GB
+    #: gallery H2D (queue-head blocking on the ~10-30 MB/s tunnel link).
+    #: Pacing (await each chunk before queueing the next) bounds any
+    #: concurrent serving transfer's wait to ~one chunk.
+    CHUNK_UPLOAD_BYTES = 32 * 1024 * 1024
+
+    def _chunked_emb_put(self, emb: np.ndarray, cancel=None,
+                         info=None) -> jnp.ndarray:
+        """Upload the embedding matrix in paced chunks: device-side zeros
+        (no transfer), then donated dynamic_update_slice per chunk, each
+        awaited (non-blocking is_ready poll) before the next is queued.
+        The device-side copies are HBM-bandwidth cheap; the win is that
+        the tunnel link is released between chunks. One deadline bounds
+        the WHOLE upload (not per chunk), and ``cancel`` is sampled
+        inside the poll so a reset aborts within one poll tick. is_ready
+        errors mirror _await_residency: backends without it stop pacing
+        (the final residency wait still runs); transient errors are
+        recorded and polling continues."""
+        import time as _time
+
+        cap, dim = emb.shape
+        rows = max(1, self.CHUNK_UPLOAD_BYTES // (dim * emb.dtype.itemsize))
+        key = (cap, dim)
+        if getattr(self, "_chunk_jit", None) is None or self._chunk_jit[0] != key:
+            zeros = jax.jit(lambda: jnp.zeros((cap, dim), jnp.float32),
+                            out_shardings=self._emb_sharding)
+            update = jax.jit(
+                lambda b, c, i: jax.lax.dynamic_update_slice(b, c, (i, 0)),
+                donate_argnums=0, out_shardings=self._emb_sharding)
+            self._chunk_jit = (key, zeros, update)
+        _, zeros, update = self._chunk_jit
+        buf = zeros()
+        deadline = _time.monotonic() + self.RESIDENCY_TIMEOUT_S
+        for start in range(0, cap, rows):
+            if cancel is not None and cancel():
+                return buf  # doomed snapshot; publish check discards it
+            chunk = jax.device_put(jnp.asarray(emb[start:start + rows]))
+            buf = update(buf, chunk, np.int32(start))
+            pacing = True
+            while pacing and _time.monotonic() < deadline:
+                if cancel is not None and cancel():
+                    return buf
+                try:
+                    if buf.is_ready():
+                        break
+                except (AttributeError, NotImplementedError):
+                    pacing = False  # no is_ready: give up pacing, not the grow
+                except Exception as e:
+                    if info is not None and "residency_probe_error" not in info:
+                        info["residency_probe_error"] = repr(e)
+                _time.sleep(0.02)
+        return buf
+
     def _build_snapshot(self, emb: np.ndarray, lab: np.ndarray,
-                        val: np.ndarray, size: int) -> GalleryData:
+                        val: np.ndarray, size: int,
+                        chunked: bool = False, cancel=None,
+                        info=None) -> GalleryData:
         """Device-put the arrays WITHOUT publishing (the async grow worker
-        waits for residency between build and publish)."""
+        waits for residency between build and publish). ``chunked`` (grow
+        worker only) paces the big embedding upload so concurrent serving
+        transfers are not head-blocked behind it; labels/valid are small
+        (5 MB at 1M rows) and always go direct. Chunking is scoped to
+        single-device meshes — the serving config this was measured on,
+        and the only one where it's a pure win: with tp>1 the
+        dynamic-offset update operand cannot be proven shard-local, so
+        GSPMD replicates every chunk to all devices (~tp x the transfer
+        bytes), while the direct sharded put moves each row exactly once.
+        On real pods each host also uploads only its own shards over its
+        own link, so the single-link head-blocking this fights is a
+        tunneled-single-chip artifact anyway."""
+        if (chunked and emb.nbytes > 2 * self.CHUNK_UPLOAD_BYTES
+                and len(self.mesh.devices.flat) == 1):
+            emb_dev = self._chunked_emb_put(emb, cancel=cancel, info=info)
+        else:
+            emb_dev = jax.device_put(jnp.asarray(emb), self._emb_sharding)
         return GalleryData(
-            embeddings=jax.device_put(jnp.asarray(emb), self._emb_sharding),
+            embeddings=emb_dev,
             labels=jax.device_put(jnp.asarray(lab), self._lab_sharding),
             valid=jax.device_put(jnp.asarray(val), self._valid_sharding),
             size=size,

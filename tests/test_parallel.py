@@ -394,6 +394,35 @@ def test_gallery_async_grow_normalizes_on_worker_and_waits_residency():
                                g._host_emb[:16], rtol=1e-6)
 
 
+def test_gallery_async_grow_chunked_upload_path():
+    """Grow uploads above 2x CHUNK_UPLOAD_BYTES go through the paced
+    chunked device-put (device-side zeros + donated dynamic_update_slice
+    per chunk) — forced here via an instance-level chunk-size override on
+    a SINGLE-device mesh (chunking is scoped to 1-device meshes: with
+    tp>1 the dynamic-offset update replicates each chunk to every device,
+    see _build_snapshot) — and the published snapshot is identical to the
+    host mirror."""
+    import jax
+
+    mesh = make_mesh(dp=1, tp=1, devices=jax.devices()[:1])
+    g = ShardedGallery(capacity=32, dim=16, mesh=mesh, async_grow=True)
+    g.CHUNK_UPLOAD_BYTES = 1024  # 16 rows/chunk: several chunks at 96 rows
+    g.add(RNG.normal(size=(32, 16)).astype(np.float32),
+          np.arange(32, dtype=np.int32))
+    g.add(RNG.normal(size=(64, 16)).astype(np.float32) * 11.0,
+          np.arange(32, 96, dtype=np.int32))  # overflow -> chunked upload
+    assert g.wait_ready(timeout=60)
+    assert g.size == 96 and g.capacity == 128
+    np.testing.assert_allclose(np.asarray(g.data.embeddings)[:96],
+                               g._host_emb[:96], rtol=1e-6)
+    assert np.array_equal(np.asarray(g.data.labels)[:96], np.arange(96))
+    assert not g.last_grow_info.get("error")
+    # all rows matchable through the sharded matcher
+    q = g._host_emb[40:44]
+    labels, _, _ = (np.asarray(v) for v in g.match(q, k=1))
+    np.testing.assert_array_equal(labels[:, 0], np.arange(40, 44))
+
+
 def test_pipeline_prewarm_registers_and_compiles_future_tier():
     """RecognitionPipeline registers a prewarm hook; after an async grow
     the serving-path cache already holds the new tier's packed step (keyed
