@@ -423,6 +423,39 @@ def test_gallery_async_grow_chunked_upload_path():
     np.testing.assert_array_equal(labels[:, 0], np.arange(40, 44))
 
 
+def test_gallery_async_grow_failed_upload_restores_rows_and_retries():
+    """If the upload dies AFTER the splice popped entries off pending, the
+    worker must restore them (pending_rows stays truthful, enrolment order
+    kept) and the next add() retries the grow successfully."""
+    mesh = make_mesh(tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh, async_grow=True)
+    g.add(RNG.normal(size=(8, 4)).astype(np.float32),
+          np.arange(8, dtype=np.int32))
+
+    real_build = g._build_snapshot
+    calls = {"n": 0}
+
+    def dying_build(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("tunnel RPC died mid-upload")
+        return real_build(*a, **k)
+
+    g._build_snapshot = dying_build
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32),
+          np.arange(8, 12, dtype=np.int32))  # overflow -> worker dies
+    assert g.wait_ready(timeout=30)
+    assert "error" in g.last_grow_info
+    assert g.pending_rows == 4  # restored, not lost
+    assert g.size == 8  # nothing published from the failed round
+    # next add restarts the worker; BOTH batches land, in order
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32),
+          np.arange(12, 16, dtype=np.int32))
+    assert g.wait_ready(timeout=30)
+    assert g.pending_rows == 0 and g.size == 16
+    assert np.array_equal(np.asarray(g.labels)[:16], np.arange(16))
+
+
 def test_pipeline_prewarm_registers_and_compiles_future_tier():
     """RecognitionPipeline registers a prewarm hook; after an async grow
     the serving-path cache already holds the new tier's packed step (keyed
