@@ -217,10 +217,14 @@ def main(argv=None):
         detail = json.load(open(detail_path))
     except (OSError, json.JSONDecodeError):
         detail = {}
-    detail["trace_summary"] = summary
+    # Batch 32 (the headline) keeps the long-standing top-level key;
+    # other batch sizes land beside it instead of clobbering it.
+    key = ("trace_summary" if args.batch == 32
+           else f"trace_summary_b{args.batch}")
+    detail[key] = summary
     with open(detail_path, "w") as fh:
         json.dump(detail, fh, indent=2)
-    _log("merged trace_summary into BENCH_DETAIL.json")
+    _log(f"merged {key} into BENCH_DETAIL.json")
     print(json.dumps(summary, indent=2)[:4000])
 
 
