@@ -104,7 +104,12 @@ def streaming_match_topk(q, g, valid, *, k: int = 1, block_q: int = 128,
     modest (fits VMEM with the tiles).
     """
     q = jnp.asarray(q, jnp.float32)
-    g = jnp.asarray(g, jnp.float32)
+    # Keep a bf16-stored gallery in bf16: the kernel casts both operands
+    # to bf16 for the MXU anyway (see _match_kernel), so upcasting here
+    # would only double the HBM traffic this streaming kernel exists to
+    # save. Other dtypes go to f32 as before.
+    if g.dtype != jnp.bfloat16:
+        g = jnp.asarray(g, jnp.float32)
     qn, d = q.shape
     n = g.shape[0]
     block_q = min(block_q, max(8, int(np.ceil(qn / 8) * 8)))

@@ -264,8 +264,11 @@ class RecognitionPipeline:
         }
         if not served:
             return
+        # Scratch MUST match the gallery's store_dtype: an f32 scratch on a
+        # bf16 gallery warms an executable serving never hits (aval
+        # mismatch -> full retrace on the serving thread post-grow).
         scratch_emb = jax.device_put(
-            jnp.zeros((capacity, g.dim), jnp.float32), g._emb_sharding
+            jnp.zeros((capacity, g.dim), g.store_dtype), g._emb_sharding
         )
         scratch_lab = jax.device_put(
             jnp.full((capacity,), g.labels_pad, jnp.int32), g._lab_sharding

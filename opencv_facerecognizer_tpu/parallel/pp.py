@@ -173,8 +173,10 @@ class TwoStagePipeline:
         served_shapes = set(self._served_crop_shapes)
         if not served_shapes:
             return
+        # store_dtype, not f32: aval mismatch would nullify the warm
+        # (see pipeline.prewarm_capacity).
         scratch_emb = jax.device_put(
-            jnp.zeros((capacity, g.dim), jnp.float32), g._emb_sharding
+            jnp.zeros((capacity, g.dim), g.store_dtype), g._emb_sharding
         )
         scratch_lab = jax.device_put(
             jnp.full((capacity,), g.labels_pad, jnp.int32), g._lab_sharding

@@ -218,16 +218,22 @@ class TheTrainer:
 
     # ---- serving handoff (cnn backend) ----
 
-    def build_gallery(self, images: np.ndarray, labels: np.ndarray, mesh, capacity: int = 0):
+    def build_gallery(self, images: np.ndarray, labels: np.ndarray, mesh,
+                      capacity: int = 0, store_dtype=np.float32):
         """Embed the enrolled set with the trained CNN and install it into a
-        ShardedGallery for the serving pipeline."""
+        ShardedGallery for the serving pipeline. ``store_dtype`` must match
+        the serving gallery's when the result is handed to
+        ``Recognizer.reload_gallery`` (``swap_from`` rejects a mismatch —
+        same-capacity snapshots of different dtypes would alias compiled
+        cache keys); pass ``jnp.bfloat16`` for the ocvf-recognize default."""
         from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 
         if self.model is None or not isinstance(self.model.feature, CNNEmbedding):
             raise RuntimeError("build_gallery requires a trained cnn model")
         emb = np.array(self.model.feature.extract(np.asarray(images, np.float32)))
         capacity = capacity or max(2 * len(emb), 64)
-        gallery = ShardedGallery(capacity=capacity, dim=emb.shape[1], mesh=mesh)
+        gallery = ShardedGallery(capacity=capacity, dim=emb.shape[1], mesh=mesh,
+                                 store_dtype=store_dtype)
         gallery.add(emb, np.asarray(labels, np.int32))
         return gallery
 

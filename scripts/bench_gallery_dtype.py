@@ -1,0 +1,126 @@
+"""A/B the gallery store dtype (f32 vs bf16) at the 1M-row tier: in-graph
+match cost (chained differencing — block_until_ready does not await on
+this tunneled backend, see bench.py) and upload wall (device_put + the
+residency await the grow worker uses). Both matchers compute bf16 x bf16
+-> f32 regardless of storage, so bf16 storage should halve HBM traffic
+and upload bytes at identical math.
+
+Run:  PYTHONPATH=. python scripts/bench_gallery_dtype.py
+Merges a "gallery_dtype" section into BENCH_DETAIL.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+
+    rows, dim, q_batch, k = 1_048_576, 256, 256, 1
+    dev = jax.devices()[0]
+    _log(f"device: {dev}; {rows} rows x {dim}")
+    rng = np.random.default_rng(0)
+    emb = rng.standard_normal((rows, dim), dtype=np.float32)
+    emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
+    lab = rng.integers(0, 4096, rows).astype(np.int32)
+    q = emb[:q_batch]
+
+    result = {"rows": rows, "dim": dim, "q_batch": q_batch, "k": k,
+              "device": str(dev), "date": time.strftime("%Y-%m-%d")}
+    # Warm the H2D path first: the tunnel's FIRST put of a given shape
+    # class runs ~40x slower than steady state (measured 36 vs 1564 MB/s),
+    # which poisoned the first A/B's upload column for whichever arm ran
+    # second-cold. GC between arms so host RSS from arm 1 can't distort
+    # arm 2 on this 1-core/limited-RAM box.
+    import gc
+
+    warm = jax.device_put(emb[:65536])
+    while not warm.is_ready():
+        time.sleep(0.01)
+    del warm
+
+    # PHASE 1 — time BOTH installs before ANY device->host readback: the
+    # first sync readback drops the process into the tunnel's ~100 ms
+    # poll mode, where H2D collapses to ~36 MB/s (measured) — timing one
+    # arm's install pre-readback and the other's post-readback charged a
+    # 25x transfer-mode penalty to whichever arm ran second (the first
+    # two A/B attempts did exactly that, in both orders).
+    arms = ((jnp.float32, "f32"), (jnp.bfloat16, "bf16"))
+    galleries = {}
+    for dtype, name in arms:
+        gc.collect()
+        g = ShardedGallery(capacity=rows, dim=dim, mesh=make_mesh(),
+                           store_dtype=dtype)
+        g.add(emb, lab)
+        ok = g._await_residency(g.data, 600.0)
+        t0 = time.perf_counter()
+        g._install(g._host_emb, g._host_lab, g._host_val, g.size)
+        ok = g._await_residency(g.data, 600.0) and ok
+        upload_s = time.perf_counter() - t0
+        result[name] = {
+            "upload_s": round(upload_s, 2), "residency_ok": bool(ok),
+            "gallery_bytes": int(rows * dim * jnp.dtype(dtype).itemsize),
+        }
+        _log(f"[{name}] install (pre-readback) {upload_s:.2f}s")
+        galleries[name] = g
+
+    # PHASE 2 — chained match timing (readbacks allowed from here on).
+    q_dev = jnp.asarray(q)
+    for dtype, name in arms:
+        g = galleries[name]
+        match = g._matcher(k, g.data)
+
+        def chain(n):
+            labels, vals, idx = match(q_dev, g.data.embeddings,
+                                      g.data.valid, g.data.labels)
+            for _ in range(n - 1):
+                q2 = q_dev + vals[0, 0] * 1e-30  # device-side dependency
+                labels, vals, idx = match(q2, g.data.embeddings,
+                                          g.data.valid, g.data.labels)
+            return np.asarray(vals).sum()
+
+        chain(2)  # compile + warm
+        k1, k2 = 4, 64
+        t1s, t2s = [], []
+        for _ in range(3):
+            t0 = time.perf_counter(); chain(k1); t1s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter(); chain(k2); t2s.append(time.perf_counter() - t0)
+        ms = (min(t2s) - min(t1s)) / (k2 - k1) * 1e3
+        result[name]["match_ms_per_call"] = round(ms, 3)
+        _log(f"[{name}] match {ms:.3f} ms/call")
+        del galleries[name], g
+
+    f, b = result["f32"], result["bf16"]
+    result["upload_speedup"] = round(f["upload_s"] / b["upload_s"], 2)
+    result["match_speedup"] = round(
+        f["match_ms_per_call"] / b["match_ms_per_call"], 2)
+    path = os.path.join(REPO, "BENCH_DETAIL.json")
+    try:
+        detail = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        detail = {}
+    detail["gallery_dtype"] = result
+    with open(path, "w") as fh:
+        json.dump(detail, fh, indent=2)
+    _log("merged gallery_dtype into BENCH_DETAIL.json")
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

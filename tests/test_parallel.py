@@ -423,6 +423,42 @@ def test_gallery_async_grow_chunked_upload_path():
     np.testing.assert_array_equal(labels[:, 0], np.arange(40, 44))
 
 
+def test_gallery_bf16_store_matches_f32():
+    """store_dtype=bfloat16 halves gallery HBM/upload bytes and must be
+    numerically interchangeable on the match path: both matchers already
+    compute the similarity matmul as bf16 x bf16 -> f32, so a bf16-stored
+    gallery changes only WHERE the cast happens (enrolment vs per call)."""
+    import jax.numpy as jnp
+
+    mesh = make_mesh(tp=4)
+    emb = RNG.normal(size=(64, 16)).astype(np.float32)
+    lab = np.arange(64, dtype=np.int32)
+    q = emb[10:20] / np.linalg.norm(emb[10:20], axis=-1, keepdims=True)
+    results = {}
+    for dtype in (jnp.float32, jnp.bfloat16):
+        g = ShardedGallery(capacity=64, dim=16, mesh=mesh, store_dtype=dtype)
+        g.add(emb, lab)
+        assert g.data.embeddings.dtype == dtype
+        labels, sims, idx = (np.asarray(v) for v in g.match(q, k=3))
+        results[str(dtype)] = (labels, sims)
+    (l32, s32), (l16, s16) = results.values()
+    np.testing.assert_array_equal(l32, l16)
+    np.testing.assert_allclose(s32, s16, atol=2e-3)
+    # grow path keeps the dtype (incl. the chunked branch on 1-device)
+    import jax
+
+    g1 = ShardedGallery(capacity=16, dim=16,
+                        mesh=make_mesh(dp=1, tp=1, devices=jax.devices()[:1]),
+                        store_dtype=jnp.bfloat16, async_grow=True)
+    g1.CHUNK_UPLOAD_BYTES = 512
+    g1.add(emb[:16], lab[:16])
+    g1.add(emb[16:], lab[16:])  # overflow -> chunked bf16 upload
+    assert g1.wait_ready(timeout=30)
+    assert g1.size == 64 and g1.data.embeddings.dtype == jnp.bfloat16
+    labels, _, _ = (np.asarray(v) for v in g1.match(q, k=1))
+    np.testing.assert_array_equal(labels[:, 0], np.arange(10, 20))
+
+
 def test_gallery_async_grow_failed_upload_restores_rows_and_retries():
     """If the upload dies AFTER the splice popped entries off pending, the
     worker must restore them (pending_rows stays truthful, enrolment order
@@ -456,10 +492,14 @@ def test_gallery_async_grow_failed_upload_restores_rows_and_retries():
     assert np.array_equal(np.asarray(g.labels)[:16], np.arange(16))
 
 
-def test_pipeline_prewarm_registers_and_compiles_future_tier():
+@pytest.mark.parametrize("store_dtype", ["float32", "bfloat16"])
+def test_pipeline_prewarm_registers_and_compiles_future_tier(store_dtype):
     """RecognitionPipeline registers a prewarm hook; after an async grow
     the serving-path cache already holds the new tier's packed step (keyed
-    exactly as the post-grow lookup) and serving output stays correct."""
+    exactly as the post-grow lookup) and serving output stays correct.
+    Parametrized over the gallery store dtype: the prewarm scratch arrays
+    must match it — an f32 scratch on a bf16 gallery warms an executable
+    serving never hits (aval mismatch -> post-grow serving retrace)."""
     from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
     from opencv_facerecognizer_tpu.models.embedder import (
         FaceEmbedNet, init_embedder,
@@ -468,9 +508,11 @@ def test_pipeline_prewarm_registers_and_compiles_future_tier():
     from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
 
     import jax
+    import jax.numpy as jnp
 
     mesh = make_mesh(dp=2, tp=4)
-    g = ShardedGallery(capacity=32, dim=16, mesh=mesh, async_grow=True)
+    g = ShardedGallery(capacity=32, dim=16, mesh=mesh, async_grow=True,
+                       store_dtype=getattr(jnp, store_dtype))
     emb = RNG.normal(size=(32, 16)).astype(np.float32)
     emb /= np.linalg.norm(emb, axis=-1, keepdims=True)
     g.add(emb, np.arange(32, dtype=np.int32))
@@ -499,8 +541,15 @@ def test_pipeline_prewarm_registers_and_compiles_future_tier():
     # BOTH executables are warm: recognize_batch (unpacked) must not pay a
     # first-call compile after the grow either (ADVICE r4).
     assert key in pipe._step_cache
+    warmed = pipe._packed_cache[key]
+    before = warmed._cache_size() if hasattr(warmed, "_cache_size") else None
     out1 = np.asarray(pipe.recognize_batch_packed(frames))
     assert out1.shape == out0.shape
+    if before is not None:
+        # The serving call must HIT the prewarmed executable, not trace a
+        # second one (e.g. scratch-vs-gallery dtype aval mismatch).
+        assert warmed._cache_size() == before, (
+            "post-grow serving call retraced the prewarmed step")
 
 
 def test_step_key_derives_from_snapshot_not_live_gallery():

@@ -366,6 +366,20 @@ def test_trainer_cnn_gallery_handoff():
     emb = np.array(trainer.model.feature.extract(X[:8]))
     labels, sims, _ = (np.asarray(v) for v in gallery.match(emb, k=1))
     assert (labels[:, 0] == y[:8]).mean() >= 0.9
+    # store_dtype passthrough: a retraining handoff must be able to match
+    # the serving gallery's dtype, or reload_gallery's swap_from rejects it
+    # (the ocvf-recognize default is bf16).
+    import jax.numpy as jnp
+
+    serving = trainer.build_gallery(X, y, make_mesh(tp=8),
+                                    store_dtype=jnp.bfloat16)
+    assert serving.data.embeddings.dtype == jnp.bfloat16
+    staged = trainer.build_gallery(X, y, make_mesh(tp=8),
+                                   capacity=serving.capacity,
+                                   store_dtype=jnp.bfloat16)
+    serving.swap_from(staged)  # must not raise (dtype + capacity match)
+    with pytest.raises(ValueError):
+        serving.swap_from(gallery)  # f32 into bf16: guarded
 
 
 def test_trainer_rejects_unknown_model_and_field():
