@@ -154,7 +154,13 @@ def main():
     gallery = rng.normal(size=(gallery_size, embed_dim)).astype(np.float32)
     gallery /= np.linalg.norm(gallery, axis=-1, keepdims=True)
     labels = rng.integers(0, 512, size=gallery_size).astype(np.int32)
-    g = jnp.asarray(gallery)
+    # bf16 rows: the serving default (ocvf-recognize --gallery-dtype).
+    # Identical math — the matcher computes bf16 x bf16 -> f32 either way
+    # (the cast just pre-pays at enrolment); measured 1.24x at 1M rows
+    # (BENCH_DETAIL.json:gallery_dtype), ~noise at this 16k headline size.
+    # Transfer f32 and cast ON DEVICE: a host-side ml_dtypes array misses
+    # PJRT's zero-copy put (gallery._put_emb documents the 25x penalty).
+    g = jnp.asarray(gallery).astype(jnp.bfloat16)
     lab = jnp.asarray(labels)
     det_params = det.params
 
@@ -519,9 +525,11 @@ def main():
     compiled_embed_for_parity = jax.jit(embed_for_parity)
     detail["large_gallery"] = {"batch": batch, "rows": {}}
     for big_n in (262_144, 1_048_576):
+        # bf16, matching the serving default (see headline gallery note:
+        # f32 over the wire, cast on device)
         g_big = jnp.asarray(
             rng.normal(size=(big_n, embed_dim)).astype(np.float32)
-        )
+        ).astype(jnp.bfloat16)
         lab_big = jnp.asarray(rng.integers(0, 512, size=big_n).astype(np.int32))
         valid_big = jnp.ones((big_n,), bool)
 

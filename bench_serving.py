@@ -63,7 +63,11 @@ def build_pipeline(frame_hw=(256, 256), gallery_size=1024):
     dim = SERVING_EMBEDDER_KWARGS["embed_dim"]
     gal_emb = rng.normal(size=(gallery_size, dim)).astype(np.float32)
     mesh = make_mesh()
-    gallery = ShardedGallery(capacity=gallery_size, dim=dim, mesh=mesh)
+    import jax.numpy as jnp
+
+    # bf16 rows: the ocvf-recognize serving default (gallery_dtype A/B)
+    gallery = ShardedGallery(capacity=gallery_size, dim=dim, mesh=mesh,
+                             store_dtype=jnp.bfloat16)
     gallery.add(gal_emb, rng.integers(0, 64, gallery_size).astype(np.int32))
     pipeline = RecognitionPipeline(det, net, emb_params, gallery,
                                    face_size=SERVING_FACE_SIZE)

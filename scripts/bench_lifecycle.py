@@ -99,8 +99,11 @@ def main():
     # async_grow: the serving configuration — overflow stages rows, a
     # background worker compiles the next tier (pipeline prewarm hook) and
     # installs it off the serving path (VERDICT r3 item #5).
+    # bf16 rows = the ocvf-recognize serving default (half the grow-upload
+    # bytes and HBM; measured 1.24x faster 1M-row match — gallery_dtype
+    # section); this artifact must measure the configuration that ships.
     gallery = ShardedGallery(capacity=16384, dim=dim, mesh=mesh,
-                             async_grow=True)
+                             async_grow=True, store_dtype=jnp.bfloat16)
     gallery.add(rng.standard_normal((16384, dim), dtype=np.float32),
                 rng.integers(0, 512, 16384).astype(np.int32))
     pipeline = RecognitionPipeline(det, net, emb_params, gallery,

@@ -75,7 +75,9 @@ def main(argv=None):
     emb_params = init_embedder(net, num_classes=16,
                                input_shape=SERVING_FACE_SIZE, seed=0)["net"]
     rng = np.random.default_rng(0)
-    gallery = ShardedGallery(capacity=16384, dim=dim, mesh=make_mesh())
+    # bf16 rows: the ocvf-recognize serving default (gallery_dtype A/B)
+    gallery = ShardedGallery(capacity=16384, dim=dim, mesh=make_mesh(),
+                             store_dtype=jnp.bfloat16)
     gallery.add(rng.normal(size=(16384, dim)).astype(np.float32),
                 rng.integers(0, 512, 16384).astype(np.int32))
     pipe = RecognitionPipeline(det, net, emb_params, gallery,

@@ -68,7 +68,9 @@ def build_pipeline(batch, h, w, max_faces, dim, tiny=False):
             jnp.float32)
         return pipe, frames
     rng = np.random.default_rng(0)
-    gallery = ShardedGallery(capacity=cap, dim=dim, mesh=make_mesh())
+    # bf16 rows: the ocvf-recognize serving default (gallery_dtype A/B)
+    gallery = ShardedGallery(capacity=cap, dim=dim, mesh=make_mesh(),
+                             store_dtype=jnp.bfloat16)
     gallery.add(rng.normal(size=(cap, dim)).astype(np.float32),
                 rng.integers(0, 512, cap).astype(np.int32))
     pipe = RecognitionPipeline(det, net, emb_params, gallery,
