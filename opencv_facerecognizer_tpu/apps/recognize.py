@@ -80,6 +80,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "matchable seconds later, instead of stalling the "
                         "serving loop for the XLA recompile")
     p.add_argument("--metrics-jsonl", help="append per-batch metrics to this file")
+    # ---- steady-state failure handling (runtime.resilience) ----
+    p.add_argument("--readback-deadline", type=float, default=30.0,
+                   metavar="S",
+                   help="dead-letter a dispatched batch whose device->host "
+                        "readback is not ready after this many seconds "
+                        "(the hang-mode outage costs one deadline, never "
+                        "a wedge)")
+    p.add_argument("--dispatch-retries", type=int, default=3,
+                   help="retries per batch on transient (outage-shaped) "
+                        "dispatch failures, with exponential backoff")
+    p.add_argument("--degraded-after", type=int, default=3,
+                   help="consecutive dispatch failures before the service "
+                        "publishes degraded mode on the status topic and "
+                        "(with --probe-on-degraded) checks the backend")
+    p.add_argument("--probe-on-degraded", action="store_true",
+                   help="on entering degraded mode, run the bounded "
+                        "subprocess backend probe (utils.backend_probe) "
+                        "and attach its verdict to the status message")
+    p.add_argument("--supervised", action="store_true",
+                   help="wrap the service in a ServiceSupervisor: a crash "
+                        "that kills the serving loop is restarted with "
+                        "the last-known-good gallery snapshot (bounded "
+                        "restarts)")
     return p
 
 
@@ -166,6 +189,9 @@ def main(argv=None) -> int:
     from opencv_facerecognizer_tpu.runtime.recognizer import (
         FRAME_TOPIC, RESULT_TOPIC, RecognizerService,
     )
+    from opencv_facerecognizer_tpu.runtime.resilience import (
+        ResiliencePolicy, ServiceSupervisor, rebuild_pipeline_on_cpu,
+    )
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
     pipeline, names = _load_stack(args)
@@ -173,9 +199,10 @@ def main(argv=None) -> int:
     metrics = Metrics(sink=metrics_sink)
 
     if args.source == "jsonl":
-        connector = JSONLConnector(sys.stdin, sys.stdout)
+        connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)
     elif args.source == "socket":
-        connector = SocketConnector(host=args.host, port=args.port, listen=True)
+        connector = SocketConnector(host=args.host, port=args.port,
+                                    listen=True, metrics=metrics)
     else:
         connector = FakeConnector()
 
@@ -188,8 +215,22 @@ def main(argv=None) -> int:
         subject_names=names,
         metrics=metrics,
         transfer_dtype=np.uint8 if args.transfer_uint8 else np.float32,
+        resilience=ResiliencePolicy(
+            dispatch_retries=args.dispatch_retries,
+            readback_deadline_s=args.readback_deadline,
+            degraded_after=args.degraded_after,
+            probe_backend_on_degraded=args.probe_on_degraded,
+        ),
+        # Dead accelerator -> rebuild the pipeline on host devices: the
+        # job degrades to CPU speed instead of wedging (README "Failure
+        # handling"). Only reachable with --probe-on-degraded.
+        cpu_fallback=rebuild_pipeline_on_cpu if args.probe_on_degraded else None,
     )
-    service.start()
+    supervisor = ServiceSupervisor(service) if args.supervised else None
+    if supervisor is not None:
+        supervisor.start()
+    else:
+        service.start()
 
     profiling = False
     if args.profile_dir:
@@ -249,7 +290,10 @@ def main(argv=None) -> int:
             import jax
 
             jax.profiler.stop_trace()
-        service.stop()
+        if supervisor is not None:
+            supervisor.stop()
+        else:
+            service.stop()
         summary = metrics.summary()
         if summary:
             print(f"metrics: {summary}", file=sys.stderr)

@@ -151,13 +151,20 @@ def match_pod_pallas(q, g, valid, labels, *, k: int, mesh: Mesh,
         top_i = jnp.take_along_axis(cand_i, pos, axis=1)
         return take_labels_with_sentinel(labels_l, top_i, labels_pad), top_v, top_i
 
-    return jax.shard_map(
-        shard_body,
+    specs = dict(
         mesh=mesh,
         in_specs=(P(DP_AXIS, None), P(TP_AXIS, None), P(TP_AXIS), P()),
         out_specs=(P(DP_AXIS, None), P(DP_AXIS, None), P(DP_AXIS, None)),
-        check_vma=False,
-    )(q, g, valid, labels)
+    )
+    if hasattr(jax, "shard_map"):
+        mapped = jax.shard_map(shard_body, check_vma=False, **specs)
+    else:
+        # jax < 0.6: shard_map lives in jax.experimental and the
+        # replication check is spelled check_rep.
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        mapped = _shard_map(shard_body, check_rep=False, **specs)
+    return mapped(q, g, valid, labels)
 
 
 class GalleryData(NamedTuple):
@@ -394,8 +401,15 @@ class ShardedGallery:
                 # [rows, labels, normalized?]. Non-empty pending with no
                 # worker means a previous grow FAILED: later adds must
                 # queue behind the stranded rows (enrolment order), and
-                # this add restarts the worker to retry them.
-                self._pending.append([embeddings, labels, normalized])
+                # this add restarts the worker to retry them. Labels are
+                # copied HERE, at the staging site: asarray of an int32
+                # input is a no-copy view, and the worker may splice
+                # seconds after add() returns — a caller reusing its label
+                # buffer would otherwise enroll wrong identities (the
+                # embeddings already got their private copy above, or are
+                # a fresh dividing copy on the lost-race path).
+                self._pending.append([embeddings, np.array(labels, copy=True),
+                                      normalized])
                 self._pending_count += n
                 if not self._growing:
                     self._growing = True
@@ -724,18 +738,57 @@ class ShardedGallery:
     #: concurrent serving transfer's wait to ~one chunk.
     CHUNK_UPLOAD_BYTES = 32 * 1024 * 1024
 
+    #: per-CHUNK pacing deadline (round-5 advisor: one shared deadline
+    #: meant a mid-upload expiry silently queued every remaining chunk
+    #: back-to-back — exactly the head-of-line blocking pacing exists to
+    #: prevent, with nothing recorded). 60 s per 32 MB chunk is ~20x the
+    #: tunnel's worst measured rate; an expiry is real degradation and is
+    #: flagged in ``info["chunk_pacing_timeout"]`` for lifecycle artifacts.
+    CHUNK_PACING_TIMEOUT_S = 60.0
+
+    @staticmethod
+    def _pace_chunk(buf, deadline: float, cancel=None, info=None) -> bool:
+        """Poll ``buf.is_ready()`` until resident, cancelled, or
+        ``deadline``; True when the chunk landed (or the wait was
+        cancelled), False when pacing gave up — deadline expiry records
+        ``info["chunk_pacing_timeout"]`` so the degraded (unpaced) window
+        is visible in grow artifacts; a backend without ``is_ready``
+        returns False silently (pacing is impossible, not degraded — the
+        final residency wait still runs). Transient is_ready errors are
+        recorded and polling continues (mirrors ``_await_residency``)."""
+        import time as _time
+
+        while True:
+            if cancel is not None and cancel():
+                return True  # doomed snapshot; publish check discards it
+            try:
+                if buf.is_ready():
+                    return True
+            except (AttributeError, NotImplementedError):
+                return False  # no is_ready on this backend: cannot pace
+            except Exception as e:
+                if info is not None and "residency_probe_error" not in info:
+                    info["residency_probe_error"] = repr(e)
+            if _time.monotonic() >= deadline:
+                if info is not None:
+                    info["chunk_pacing_timeout"] = True
+                return False
+            _time.sleep(0.02)
+
     def _chunked_emb_put(self, emb: np.ndarray, cancel=None,
                          info=None) -> jnp.ndarray:
         """Upload the embedding matrix in paced chunks: device-side zeros
         (no transfer), then donated dynamic_update_slice per chunk, each
         awaited (non-blocking is_ready poll) before the next is queued.
         The device-side copies are HBM-bandwidth cheap; the win is that
-        the tunnel link is released between chunks. One deadline bounds
-        the WHOLE upload (not per chunk), and ``cancel`` is sampled
-        inside the poll so a reset aborts within one poll tick. is_ready
-        errors mirror _await_residency: backends without it stop pacing
-        (the final residency wait still runs); transient errors are
-        recorded and polling continues."""
+        the tunnel link is released between chunks. Each chunk gets its
+        OWN pacing deadline (``CHUNK_PACING_TIMEOUT_S``) — a single slow
+        chunk degrades only itself, flagged in info — and ``cancel`` is
+        sampled inside the poll so a reset aborts within one poll tick.
+        The FIRST pacing failure (timeout or no ``is_ready``) stops pacing
+        for the remaining chunks: under a hang-mode backend the total
+        stall is bounded by one chunk deadline, not chunks * deadline
+        (the final residency wait still gates the publish either way)."""
         import time as _time
 
         cap, dim = emb.shape
@@ -751,7 +804,7 @@ class ShardedGallery:
             self._chunk_jit = (key, zeros, update)
         _, zeros, update = self._chunk_jit
         buf = zeros()
-        deadline = _time.monotonic() + self.RESIDENCY_TIMEOUT_S
+        pacing = True
         for start in range(0, cap, rows):
             if cancel is not None and cancel():
                 return buf  # doomed snapshot; publish check discards it
@@ -759,19 +812,10 @@ class ShardedGallery:
             # store_dtype-width (an on-device cast would ship f32 bytes).
             chunk = self._put_emb(emb[start:start + rows])
             buf = update(buf, chunk, np.int32(start))
-            pacing = True
-            while pacing and _time.monotonic() < deadline:
-                if cancel is not None and cancel():
-                    return buf
-                try:
-                    if buf.is_ready():
-                        break
-                except (AttributeError, NotImplementedError):
-                    pacing = False  # no is_ready: give up pacing, not the grow
-                except Exception as e:
-                    if info is not None and "residency_probe_error" not in info:
-                        info["residency_probe_error"] = repr(e)
-                _time.sleep(0.02)
+            if pacing:
+                pacing = self._pace_chunk(
+                    buf, _time.monotonic() + self.CHUNK_PACING_TIMEOUT_S,
+                    cancel=cancel, info=info)
         return buf
 
     def _build_snapshot(self, emb: np.ndarray, lab: np.ndarray,
@@ -809,27 +853,72 @@ class ShardedGallery:
         # serving threads reading self._data never see a partial install.
         self._data = self._build_snapshot(emb, lab, val, size)
 
+    #: bounded wait for the write lock in snapshot(): long enough that a
+    #: normal add/grow-splice holding it finishes, short enough that a
+    #: hang-mode device transfer stuck INSIDE the locked region (observed
+    #: outage shape) cannot wedge a degraded-mode caller on the serving
+    #: thread — which would be the exact wedge the resilience layer exists
+    #: to prevent.
+    SNAPSHOT_LOCK_TIMEOUT_S = 5.0
+
     def snapshot(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
-        """Host-mirror copies (no device readback)."""
-        return (
-            self._host_emb.copy(),
-            self._host_lab.copy(),
-            self._host_val.copy(),
-            self.size,
-        )
+        """Host-mirror copies (no device readback). Prefers the write lock
+        (a copy racing a grow splice must not capture a half-written row
+        set) but the acquire is BOUNDED: if a hung device_put is holding
+        the lock past ``SNAPSHOT_LOCK_TIMEOUT_S``, fall back to lock-free
+        copies — best-effort state now beats a guaranteed wedge."""
+        acquired = self._write_lock.acquire(timeout=self.SNAPSHOT_LOCK_TIMEOUT_S)
+        try:
+            return (
+                self._host_emb.copy(),
+                self._host_lab.copy(),
+                self._host_val.copy(),
+                self.size,
+            )
+        finally:
+            if acquired:
+                self._write_lock.release()
+
+    def load_snapshot(self, emb: np.ndarray, lab: np.ndarray,
+                      val: np.ndarray, size: int) -> None:
+        """Install host-mirror arrays from a prior ``snapshot()`` as the
+        live gallery — the supervisor's last-known-good restore path
+        (runtime.resilience.ServiceSupervisor): a crash mid-enrolment must
+        not leave a half-written gallery serving. Adopts the snapshot's
+        capacity (grows since the checkpoint are rolled back with it) and
+        invalidates any in-flight async grow, exactly like ``swap_from``."""
+        emb = np.array(emb, np.float32, copy=True)
+        if emb.ndim != 2 or emb.shape[1] != self.dim:
+            raise ValueError(f"snapshot must be [capacity, {self.dim}], "
+                             f"got {emb.shape}")
+        with self._write_lock:
+            self._epoch += 1  # invalidate any in-flight async grow
+            self._pending.clear()
+            self._pending_count = 0
+            self.capacity = emb.shape[0]
+            self._host_emb = emb
+            self._host_lab = np.array(lab, np.int32, copy=True)
+            self._host_val = np.array(val, bool, copy=True)
+            self._install(self._host_emb, self._host_lab, self._host_val,
+                          int(size))
 
     def swap_from(self, other: "ShardedGallery") -> None:
         """Atomic-at-Python-level install of another gallery's contents —
         the double-buffered reload path (SURVEY.md §5.3): build ``other``
         off to the side, then swap refs; in-flight match calls keep using
-        the old arrays they captured."""
+        the old arrays they captured.
+
+        A ``store_dtype`` mismatch is CAST, not rejected: the documented
+        retrain -> ``reload_gallery`` handoff builds its staged gallery at
+        the trainer's default f32 while serving defaults to bf16
+        (round-5 advisor) — the staged host mirrors are f32 truth either
+        way, so the device snapshot is simply rebuilt at THIS gallery's
+        width (one extra H2D; a reload already pays one). The installed
+        snapshot therefore always carries self.store_dtype, so compiled
+        cache keys (which carry capacity, not dtype) never alias."""
         if other.dim != self.dim:
             raise ValueError(f"dim mismatch: {other.dim} != {self.dim}")
-        if other.store_dtype != self.store_dtype:
-            # Same-capacity different-dtype snapshots would alias compiled
-            # cache keys (keys carry capacity, not gallery dtype).
-            raise ValueError(
-                f"store_dtype mismatch: {other.store_dtype} != {self.store_dtype}")
+        recast = other.store_dtype != self.store_dtype
         with self._write_lock:
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
@@ -839,9 +928,16 @@ class ShardedGallery:
             self._host_emb = other._host_emb
             self._host_lab = other._host_lab
             self._host_val = other._host_val
-            # Device-visible swap is the single _data assignment (last, so
-            # the host mirrors are already consistent when readers see it).
-            self._data = other._data
+            if recast:
+                # Rebuild at our width from the (always-f32) host mirrors;
+                # _install publishes with the single _data write below.
+                self._install(self._host_emb, self._host_lab, self._host_val,
+                              other.size)
+            else:
+                # Device-visible swap is the single _data assignment (last,
+                # so the host mirrors are already consistent when readers
+                # see it).
+                self._data = other._data
 
     # ---- matching (device-side) ----
 

@@ -98,6 +98,15 @@ class RecognitionPipeline:
                 "fused_embedder=True requires a single-device mesh "
                 f"(got {gallery.mesh.size} devices)")
         self.fused_embedder = bool(fused_embedder)
+        # Chaos hook (runtime.faults.FaultInjector): checked at the device-
+        # dispatch boundary of both recognize paths, so an injected
+        # UNAVAILABLE surfaces exactly where the real backend's fast-fail
+        # outage does — inside the serving loop's dispatch try-block, after
+        # batching and before any readback. None (production) costs one
+        # attribute test per batch. RecognizerService installs/uninstalls
+        # it around its start/stop so a shared pipeline never leaks faults
+        # into the next service built on it.
+        self.fault_injector = None
         # keyed by _step_key: (batch, h, w, dtype_str, capacity, pallas)
         self._step_cache: Dict[Tuple, Any] = {}
         self._packed_cache: Dict[Tuple, Any] = {}
@@ -194,6 +203,8 @@ class RecognitionPipeline:
         """[B, H, W] frames (f32 or uint8) -> RecognitionResult; B must
         divide by dp size, and B * max_faces must too (it does when B
         does)."""
+        if self.fault_injector is not None:
+            self.fault_injector.on_dispatch()
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         key = self._step_key(frames, data)
@@ -217,6 +228,8 @@ class RecognitionPipeline:
         """Same fused step, but the outputs leave the device as ONE packed
         [B, K, 6 + 2k] f32 array (see ``pack_result``) — the serving loop's
         single-readback path. Decode host-side with ``unpack_result``."""
+        if self.fault_injector is not None:
+            self.fault_injector.on_dispatch()
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
         key = self._step_key(frames, data)

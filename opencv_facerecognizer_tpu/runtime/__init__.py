@@ -12,14 +12,22 @@ from opencv_facerecognizer_tpu.runtime.connector import (
     JSONLConnector,
     MiddlewareConnector,
 )
+from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
 from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+from opencv_facerecognizer_tpu.runtime.resilience import (
+    ResiliencePolicy,
+    ServiceSupervisor,
+)
 from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer
 
 __all__ = [
     "FakeConnector",
+    "FaultInjector",
     "FrameBatcher",
     "JSONLConnector",
     "MiddlewareConnector",
     "RecognizerService",
+    "ResiliencePolicy",
+    "ServiceSupervisor",
     "TheTrainer",
 ]

@@ -44,6 +44,13 @@ class FrameBatcher:
         flush_timeout: float = 0.05,
         max_pending: int = 256,
         dtype=np.float32,
+        # Shared Metrics mirror of the drop counters (None = stats-only):
+        # the chaos/connector tests assert drops through ONE metrics
+        # surface instead of poking per-component attributes.
+        metrics=None,
+        # Chaos hook (runtime.faults): may poison a frame before the
+        # shape/dtype validation that must then drop it.
+        fault_injector=None,
     ):
         self.batch_size = int(batch_size)
         self.frame_shape = tuple(frame_shape)
@@ -52,6 +59,8 @@ class FrameBatcher:
         # uint8 halves memory 4x AND rides host->device 4x cheaper (the
         # pipeline casts to f32 in-graph); camera frames are uint8 anyway.
         self.dtype = np.dtype(dtype)
+        self.metrics = metrics
+        self._faults = fault_injector
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._frames: deque = deque()
@@ -64,10 +73,14 @@ class FrameBatcher:
 
     def put(self, frame: np.ndarray, meta: Any = None) -> bool:
         """Enqueue one frame; returns False when dropped (malformed/closed)."""
+        if self._faults is not None:
+            frame = self._faults.on_put(frame)
         frame = np.asarray(frame)
         if frame.shape != self.frame_shape or not np.issubdtype(frame.dtype, np.number):
             with self._lock:
                 self._dropped_malformed += 1
+            if self.metrics is not None:
+                self.metrics.incr("batcher_dropped_malformed")
             return False
         with self._not_empty:
             if self._closed:
@@ -75,6 +88,8 @@ class FrameBatcher:
             if len(self._frames) >= self.max_pending:
                 self._frames.popleft()  # drop oldest: freshness over backlog
                 self._dropped_overflow += 1
+                if self.metrics is not None:
+                    self.metrics.incr("batcher_dropped_overflow")
             if np.issubdtype(self.dtype, np.integer) and not np.issubdtype(
                     frame.dtype, np.integer):
                 # A bare astype would WRAP out-of-range floats (-3.0 -> 253)

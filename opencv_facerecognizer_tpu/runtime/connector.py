@@ -115,16 +115,27 @@ def _parse_jsonl_line(line: str):
 
 class _TopicDispatchConnector(MiddlewareConnector):
     """Shared handler registry + JSONL-line handling for the wire
-    transports (JSONL/socket/ROS all dispatch the same way; one body)."""
+    transports (JSONL/socket/ROS all dispatch the same way; one body).
 
-    def __init__(self):
+    ``metrics`` (optional, a ``utils.metrics.Metrics``) mirrors the
+    transport failure counters — ``connector_malformed_lines``,
+    ``connector_peer_disconnects`` — onto the same surface the serving
+    metrics live on, so failure-path tests (and a stats consumer) read one
+    ledger instead of poking per-transport attributes."""
+
+    def __init__(self, metrics=None):
         self._handlers: Dict[str, List[Handler]] = {}
         self._lock = threading.Lock()
         self.malformed_lines = 0
+        self.metrics = metrics
 
     def subscribe(self, topic: str, handler: Handler) -> None:
         with self._lock:
             self._handlers.setdefault(topic, []).append(handler)
+
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.incr(name)
 
     def _dispatch(self, topic: str, data: Dict[str, Any]) -> None:
         with self._lock:
@@ -139,6 +150,7 @@ class _TopicDispatchConnector(MiddlewareConnector):
         topic, data = parsed
         if data is None:
             self.malformed_lines += 1
+            self._count("connector_malformed_lines")
             return
         self._dispatch(topic, data)
 
@@ -163,8 +175,9 @@ class JSONLConnector(_TopicDispatchConnector):
         self,
         in_stream: Optional[IO[str]] = None,
         out_stream: Optional[IO[str]] = None,
+        metrics=None,
     ):
-        super().__init__()
+        super().__init__(metrics=metrics)
         self._in = in_stream
         self._out = out_stream
         self._thread: Optional[threading.Thread] = None
@@ -268,8 +281,9 @@ class SocketConnector(_TopicDispatchConnector):
     server through ``nc`` unchanged.
     """
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0, listen: bool = False):
-        super().__init__()
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 listen: bool = False, metrics=None):
+        super().__init__(metrics=metrics)
         self.host = host
         self.port = port
         self.listen = listen
@@ -322,6 +336,10 @@ class SocketConnector(_TopicDispatchConnector):
     def _read_loop(self, sock: socket.socket) -> None:
         fh = sock.makefile("r", encoding="utf-8", errors="replace")
         try:
+            # A peer that dies mid-message leaves a final line without a
+            # newline; iteration still yields it, _handle_line counts it
+            # malformed (truncated JSON never parses) — then the disconnect
+            # itself is counted below. Two counters, two distinct faults.
             for line in fh:
                 if not self._running:
                     break
@@ -329,6 +347,10 @@ class SocketConnector(_TopicDispatchConnector):
         except (OSError, ValueError):
             pass  # peer gone or socket closed during shutdown
         finally:
+            if self._running:
+                # Peer-initiated EOF/reset (our own stop() closes sockets
+                # only after clearing _running): a flaky client, counted.
+                self._count("connector_peer_disconnects")
             with self._lock:
                 if sock in self._client_socks:
                     self._client_socks.remove(sock)
@@ -397,6 +419,8 @@ class SocketConnector(_TopicDispatchConnector):
                     if sock in self._client_socks:
                         self._client_socks.remove(sock)
                     self._send_locks.pop(sock, None)
+            for _ in dead:
+                self._count("connector_stalled_clients_dropped")
 
     def stop(self) -> None:
         self._running = False

@@ -1,0 +1,182 @@
+"""Fault injection at the serving boundaries (chaos layer).
+
+The serving stack has four places where the outside world can hurt it, and
+each one has a distinct observed failure mode on this box (see
+``utils/backend_probe.py`` for the round-4 outage evidence):
+
+- **connector receive** — a camera/transport glitch delivers a corrupt
+  payload, drops a message, or delivers it twice;
+- **batcher put** — a malformed frame (wrong shape, NaN garbage) reaches the
+  batch queue and must not poison the whole batch;
+- **device dispatch** — the backend fast-fails (``UNAVAILABLE`` at call
+  time: the tunnel's mode-1 outage);
+- **async readback** — a dispatched batch's device->host transfer never
+  completes (``is_ready`` stays False forever: the tunnel's mode-2 hang).
+
+``FaultInjector`` installs at all four. Faults are either **scripted**
+(``script("dispatch", "unavailable", "unavailable")`` — consumed in order,
+exactly once each: the deterministic form chaos tests assert exact counts
+against) or **randomized** (``rates={"receive": {"corrupt": 0.01}}`` —
+drawn from a seeded ``random.Random`` so a soak run is reproducible from
+its logged seed). ``injected`` counts every fault actually fired, keyed
+``"boundary:fault"``, so a test can demand metrics match injections exactly.
+
+The injector is a pure test/chaos tool: with no scripted faults and zero
+rates every hook is a cheap no-op passthrough, and production code paths
+never require one to be installed.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: boundary name -> fault kinds it understands.
+BOUNDARIES: Dict[str, tuple] = {
+    "receive": ("drop", "duplicate", "corrupt"),
+    "put": ("corrupt",),
+    "dispatch": ("unavailable",),
+    "readback": ("stuck",),
+}
+
+
+class InjectedUnavailableError(RuntimeError):
+    """Simulates the backend's fast-fail outage mode. The message carries
+    the literal ``UNAVAILABLE`` token so ``resilience.is_transient_error``
+    classifies it exactly like the real PJRT error string."""
+
+    def __init__(self, msg: str = "UNAVAILABLE: injected dispatch fault"):
+        super().__init__(msg)
+
+
+class StuckReadback:
+    """Wraps a dispatched device array whose transfer "never" completes —
+    the hang-mode outage at the readback boundary. ``is_ready()`` is False
+    forever; materializing it raises instead of blocking, so an accounting
+    bug that tries to read a stuck batch fails loudly in tests rather than
+    wedging the suite."""
+
+    def __init__(self, wrapped: Any):
+        self._wrapped = wrapped
+
+    def is_ready(self) -> bool:
+        return False
+
+    def copy_to_host_async(self) -> None:
+        pass
+
+    def block_until_ready(self):
+        raise RuntimeError("blocked forever on an injected stuck readback")
+
+    def __array__(self, dtype=None):
+        raise RuntimeError("materialized an injected stuck readback — the "
+                           "drain loop must dead-letter it at the deadline")
+
+
+class FaultInjector:
+    """Deterministic, seedable fault injection for the serving loop.
+
+    ``script(boundary, *faults)`` queues faults consumed one per boundary
+    crossing (exact-count chaos tests); ``rates`` injects probabilistically
+    from the seeded RNG (soak tests). ``disarm()`` turns every hook into a
+    passthrough — the soak harness uses it to prove liveness with clean
+    traffic after the chaos window.
+    """
+
+    def __init__(self, seed: int = 0,
+                 rates: Optional[Dict[str, Dict[str, float]]] = None):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self.rates = rates or {}
+        for boundary, fault_rates in self.rates.items():
+            unknown = set(fault_rates) - set(BOUNDARIES.get(boundary, ()))
+            if boundary not in BOUNDARIES or unknown:
+                raise ValueError(f"unknown fault(s) for {boundary!r}: "
+                                 f"{sorted(unknown) or boundary}")
+        self._scripted: Dict[str, deque] = {b: deque() for b in BOUNDARIES}
+        self.injected: Counter = Counter()
+        self.enabled = True
+
+    def script(self, boundary: str, *faults: str) -> None:
+        """Queue deterministic faults at ``boundary``, consumed in order —
+        one per crossing, exactly once each."""
+        kinds = BOUNDARIES.get(boundary)
+        if kinds is None:
+            raise ValueError(f"unknown boundary {boundary!r}")
+        for fault in faults:
+            if fault not in kinds:
+                raise ValueError(f"boundary {boundary!r} has no fault "
+                                 f"{fault!r} (valid: {kinds})")
+            self._scripted[boundary].append(fault)
+
+    def disarm(self) -> None:
+        """Every hook becomes a passthrough (scripted queues included)."""
+        self.enabled = False
+
+    def arm(self) -> None:
+        self.enabled = True
+
+    def _draw(self, boundary: str) -> Optional[str]:
+        """Next fault to fire at this crossing, or None. Scripted faults
+        take priority (and are consumed even when a rate is also set)."""
+        if not self.enabled:
+            return None
+        queue = self._scripted[boundary]
+        if queue:
+            fault = queue.popleft()
+        else:
+            fault = None
+            for kind, rate in self.rates.get(boundary, {}).items():
+                if rate > 0 and self._rng.random() < rate:
+                    fault = kind
+                    break
+        if fault is not None:
+            self.injected[f"{boundary}:{fault}"] += 1
+        return fault
+
+    # ---- boundary hooks ----
+
+    def on_receive(self, message: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Connector-receive boundary: returns the message list to actually
+        deliver — ``[]`` (dropped), ``[m, m]`` (duplicated), or a corrupted
+        payload whose frame can no longer decode."""
+        fault = self._draw("receive")
+        if fault is None:
+            return [message]
+        if fault == "drop":
+            return []
+        if fault == "duplicate":
+            return [message, message]
+        # corrupt: force the decode_frame path onto a payload whose byte
+        # count cannot match its declared dtype (5 bytes into float32) —
+        # the service must count it malformed and keep serving.
+        corrupted = dict(message)
+        corrupted["__frame__"] = "corrupt!"
+        corrupted.setdefault("shape", [1])
+        corrupted.setdefault("dtype", "float32")
+        return [corrupted]
+
+    def on_put(self, frame: np.ndarray) -> np.ndarray:
+        """Batcher-put boundary: a poisoned frame (wrong shape, NaN fill)
+        that shape/dtype validation must drop before it joins a batch."""
+        if self._draw("put") is None:
+            return frame
+        return np.full((1, 1), np.nan, np.float32)
+
+    def on_dispatch(self) -> None:
+        """Device-dispatch boundary: raises the fast-fail outage."""
+        if self._draw("dispatch") is not None:
+            raise InjectedUnavailableError()
+
+    def on_readback(self, device_array: Any) -> Any:
+        """Async-readback boundary: wraps the dispatched output in a
+        never-ready proxy (hang-mode outage)."""
+        if self._draw("readback") is None:
+            return device_array
+        return StuckReadback(device_array)
+
+    def summary(self) -> Dict[str, int]:
+        return dict(self.injected)

@@ -21,6 +21,18 @@ parallel/gallery.py for the sibling finding):
 The interactive-trainer protocol (SURVEY.md §2.1 "Interactive trainer")
 rides the same connector: an ``enroll`` command captures the next N detected
 face crops for a subject, embeds them, and installs the grown gallery.
+
+Steady-state failure handling (the round-4 outage, generalized — see
+``runtime.resilience``): a dispatch failure retries with exponential
+backoff (transient/outage-shaped errors only; a poisoned batch is abandoned
+immediately), a readback that outlives its per-batch deadline is
+dead-lettered and the loop keeps serving, and N consecutive dispatch
+failures flip the service into degraded mode with a ``STATUS_TOPIC``
+announcement (optionally probing the backend via ``utils.backend_probe``
+and invoking a CPU-fallback hook when it is dead). A crash that escapes the
+loop body sets ``loop_crashed`` for ``resilience.ServiceSupervisor`` to
+restart with the last-known-good gallery. ``runtime.faults.FaultInjector``
+installs at every one of these boundaries to make the whole story testable.
 """
 
 from __future__ import annotations
@@ -30,7 +42,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -39,6 +51,10 @@ from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
 from opencv_facerecognizer_tpu.runtime.connector import (
     MiddlewareConnector,
     decode_frame,
+)
+from opencv_facerecognizer_tpu.runtime.resilience import (
+    ResiliencePolicy,
+    is_transient_error,
 )
 from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
@@ -75,20 +91,44 @@ class RecognizerService:
         # uint8 ships frames host->device 4x cheaper (cast to f32 happens
         # in-graph); right whenever the source is 8-bit camera frames.
         transfer_dtype=np.float32,
+        # Steady-state failure handling (runtime.resilience docstring).
+        resilience: Optional[ResiliencePolicy] = None,
+        # Chaos hook (runtime.faults): installs at connector receive,
+        # batcher put, device dispatch, and async readback. None in
+        # production — every hook site is a no-op without it.
+        fault_injector=None,
+        # Degraded-mode backend check, injectable for tests. Default runs
+        # utils.backend_probe's bounded subprocess probe (allow_cpu=False:
+        # "usable" means the accelerator answers, not a CPU fallback).
+        backend_probe_fn: Optional[Callable[[], tuple]] = None,
+        # Called with this service when degraded mode finds the backend
+        # dead: the app wires its CPU re-initialization here (rebuild the
+        # pipeline on host devices) so a dead accelerator degrades the
+        # job instead of wedging it.
+        cpu_fallback: Optional[Callable[["RecognizerService"], None]] = None,
     ):
         self.pipeline = pipeline
         self.connector = connector
         self.similarity_threshold = float(similarity_threshold)
         self.subject_names = list(subject_names) if subject_names else []
         self.metrics = metrics or Metrics()
+        self.resilience = resilience or ResiliencePolicy()
+        self._faults = fault_injector
+        self._backend_probe_fn = backend_probe_fn
+        self._cpu_fallback = cpu_fallback
         if frame_shape is None:
             raise ValueError("frame_shape (H, W) is required (static device shapes)")
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
-                                    dtype=transfer_dtype)
+                                    dtype=transfer_dtype,
+                                    metrics=self.metrics,
+                                    fault_injector=fault_injector)
         self.inflight_depth = int(inflight_depth)
         self._inflight: deque = deque()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self._crashed = False
+        self._consecutive_dispatch_failures = 0
+        self._degraded = False
         # Completion counter paired with batcher.delivered_batches: a batch
         # counts as completed only once PUBLISHED (or abandoned on dispatch
         # failure), so drain() sees every popped batch through its whole
@@ -98,6 +138,14 @@ class RecognizerService:
         self._completed_batches = 0
         self._enrolment: Optional[_Enrolment] = None
         self._enrol_lock = threading.Lock()
+        # Called (no args, best-effort) after every COMMITTED gallery
+        # change — a finished enrolment, a reload_gallery swap. This is a
+        # direct callback, not a status-topic subscription, deliberately:
+        # wire connectors (JSONL/socket) publish outbound only and never
+        # dispatch their own publishes to local subscribers, so a
+        # supervisor listening on STATUS_TOPIC would never hear commits in
+        # production. ServiceSupervisor registers its checkpoint here.
+        self.commit_hooks: List[Callable[[], None]] = []
 
         # Enrolment embeds ride a FIXED-size padded chunk: one compiled
         # shape, warmed at start(), so an enroll command never triggers a
@@ -115,22 +163,46 @@ class RecognizerService:
         import jax
 
         self._embed_chunk = jax.jit(_embed_chunk)
+        # Placement override for the enrolment graph. None = default
+        # backend. rebuild_pipeline_on_cpu pins this to the CPU device it
+        # rebuilt on: the bare jit above takes uncommitted numpy inputs
+        # and would otherwise keep dispatching enrolment embeds on the
+        # dead accelerator after a CPU fallback.
+        self._embed_device = None
 
         connector.subscribe(FRAME_TOPIC, self._on_frame)
         connector.subscribe(CONTROL_TOPIC, self._on_control)
 
+    def _run_embed_chunk(self, params, crops):
+        """One fixed-size enrolment embed, honoring ``_embed_device``
+        (``jax.default_device`` participates in the jit cache key, so the
+        retargeted call compiles for — and runs on — the pinned device)."""
+        import contextlib
+
+        import jax
+
+        ctx = (jax.default_device(self._embed_device)
+               if self._embed_device is not None else contextlib.nullcontext())
+        with ctx:
+            return self._embed_chunk(params, crops)
+
     # ---- connector handlers (dispatch thread; keep cheap) ----
 
     def _on_frame(self, topic: str, message: Dict[str, Any]) -> None:
-        try:
-            frame = decode_frame(message) if "__frame__" in message else np.asarray(
-                message["frame"]
-            )
-        except Exception:
-            self.metrics.incr("frames_malformed")
-            return
-        if not self.batcher.put(frame, meta=message.get("meta")):
-            self.metrics.incr("frames_dropped")
+        # Connector-receive fault boundary: the injector may drop,
+        # duplicate, or corrupt the delivery (runtime.faults).
+        messages = ([message] if self._faults is None
+                    else self._faults.on_receive(message))
+        for msg in messages:
+            try:
+                frame = decode_frame(msg) if "__frame__" in msg else np.asarray(
+                    msg["frame"]
+                )
+            except Exception:
+                self.metrics.incr("frames_malformed")
+                continue
+            if not self.batcher.put(frame, meta=msg.get("meta")):
+                self.metrics.incr("frames_dropped")
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
         cmd = message.get("cmd")
@@ -148,6 +220,7 @@ class RecognizerService:
             self.connector.publish(STATUS_TOPIC, {"status": "stats",
                                                   **self.metrics.summary(),
                                                   **self.batcher.stats,
+                                                  "degraded": self._degraded,
                                                   "gallery_size": self.pipeline.gallery.size})
 
     # ---- lifecycle ----
@@ -157,7 +230,15 @@ class RecognizerService:
             return
         if warmup:
             self.warmup()
+        # Install the dispatch fault boundary on the pipeline AFTER warmup:
+        # the warmup compile must never consume a scripted chaos fault (or
+        # randomly fail under soak rates) — only real serving batches cross
+        # the boundary. stop() uninstalls, so a shared pipeline leaks no
+        # injector into the next service built on it.
+        if self._faults is not None:
+            self.pipeline.fault_injector = self._faults
         self._running = True
+        self._crashed = False
         self.connector.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -170,7 +251,7 @@ class RecognizerService:
                          self.batcher.dtype)
         packed = self.pipeline.recognize_batch_packed(zeros)
         chunk = np.zeros((self._enrol_chunk, *self.pipeline.face_size), np.float32)
-        emb = self._embed_chunk(self.pipeline.embed_params, chunk)
+        emb = self._run_embed_chunk(self.pipeline.embed_params, chunk)
         for arr in (packed, emb):
             arr.block_until_ready() if hasattr(arr, "block_until_ready") else None
         self.metrics.observe("warmup", time.perf_counter() - t0)
@@ -194,15 +275,55 @@ class RecognizerService:
     def stop(self) -> None:
         self._running = False
         self.batcher.close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
             self._thread = None
-        self._drain(force=True)
+        if thread is None or not thread.is_alive():
+            # Final materialize only once the loop thread is truly gone —
+            # two threads force-draining the same deque could pair one
+            # batch's results with another's metadata. A loop thread still
+            # alive here is bounded-waiting on a readback deadline and
+            # will finish its own force drain.
+            self._drain(force=True)
+        if self._faults is not None and getattr(
+                self.pipeline, "fault_injector", None) is self._faults:
+            self.pipeline.fault_injector = None
         self.connector.stop()
 
     # ---- the serving loop ----
 
+    @property
+    def loop_crashed(self) -> bool:
+        """True when an exception escaped the loop body and killed the
+        serving thread (``ServiceSupervisor`` watches this flag)."""
+        return self._crashed
+
+    def restart_loop(self) -> None:
+        """Restart a crashed serving loop (supervisor path). Re-syncs the
+        completed-batch accounting first: a crash between popping a batch
+        and publishing it would otherwise leave ``drain()`` waiting forever
+        for a completion that can no longer happen."""
+        if not self._running or self._thread is None:
+            return
+        if self._thread.is_alive():
+            return  # not actually crashed
+        self._completed_batches = (self.batcher.delivered_batches
+                                   - len(self._inflight))
+        self._crashed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
     def _loop(self) -> None:
+        try:
+            self._serve_loop()
+        except Exception:  # noqa: BLE001 — flag the crash for the supervisor
+            logging.getLogger(__name__).exception("serving loop crashed")
+            self.metrics.incr("loop_crashes")
+            self._crashed = True
+            self._publish_status({"status": "crashed"})
+
+    def _serve_loop(self) -> None:
         while self._running:
             batch = self.batcher.get_batch(block=True)
             if batch is None:
@@ -218,34 +339,174 @@ class RecognizerService:
             now_mono = time.monotonic()
             for ts in batch.enqueue_ts:
                 self.metrics.observe("queue_wait", now_mono - ts)
+            packed = self._dispatch_with_retry(frames)
+            if packed is None:
+                # Retries exhausted or the error was permanent (poisoned
+                # batch): abandoned, not published — but still completed
+                # for drain() accounting.
+                self._completed_batches += 1
+                continue
+            # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
+            # — never device compute, which is async from here).
+            t_disp = time.perf_counter()
+            self.metrics.observe("dispatch", t_disp - t0)
+            deadline = time.monotonic() + self.resilience.readback_deadline_s
+            self._inflight.append((packed, frames, metas, count, t0, t_disp,
+                                   deadline))
+            self.metrics.incr("batches_dispatched")
+            self.metrics.incr("frames_processed", count)
+            self._drain()
+        self._drain(force=True)
+
+    def _dispatch_with_retry(self, frames) -> Optional[Any]:
+        """One batch through the device, honoring the resilience policy:
+        transient failures retry with exponential backoff (draining
+        readbacks while waiting), permanent ones abandon immediately, and
+        ``degraded_after`` consecutive failed attempts publish degraded
+        mode. Returns the dispatched (async) output, or None when the
+        batch is abandoned (``batches_failed``)."""
+        policy = self.resilience
+        attempt = 0
+        while True:
             try:
                 # Packed path: ONE output array -> one D2H readback per
                 # batch (a tunneled backend charges ~100 ms per blocking
                 # readback; five separate arrays measured 5x slower).
                 packed = self.pipeline.recognize_batch_packed(frames)
                 packed.copy_to_host_async()
-            except Exception:  # noqa: BLE001 — a bad batch must not kill serving
-                logging.getLogger(__name__).exception("recognition batch failed")
-                self.metrics.incr("batches_failed")
-                self._completed_batches += 1  # abandoned, not published
+            except Exception as exc:  # noqa: BLE001 — classified below
+                self.metrics.incr("dispatch_failures")
+                self._consecutive_dispatch_failures += 1
+                if (self._consecutive_dispatch_failures >= policy.degraded_after
+                        and not self._degraded):
+                    self._enter_degraded(exc)
+                transient = is_transient_error(exc)
+                if not transient or attempt >= policy.dispatch_retries:
+                    logging.getLogger(__name__).exception(
+                        "recognition batch abandoned (%s, attempt %d)",
+                        "transient" if transient else "permanent", attempt)
+                    self.metrics.incr("batches_failed")
+                    return None
+                self.metrics.incr("dispatch_retries")
+                self._backoff_wait(policy.backoff(attempt))
+                attempt += 1
+                if not self._running:
+                    self.metrics.incr("batches_failed")
+                    return None
                 continue
-            # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
-            # — never device compute, which is async from here).
-            t_disp = time.perf_counter()
-            self.metrics.observe("dispatch", t_disp - t0)
-            self._inflight.append((packed, frames, metas, count, t0, t_disp))
-            self.metrics.incr("batches_dispatched")
-            self.metrics.incr("frames_processed", count)
+            if self._consecutive_dispatch_failures:
+                self._consecutive_dispatch_failures = 0
+            if self._degraded:
+                self._exit_degraded()
+            # Async-readback fault boundary (runtime.faults): may wrap the
+            # output in a never-ready proxy — the hang-mode outage.
+            if self._faults is not None:
+                packed = self._faults.on_readback(packed)
+            return packed
+
+    def _backoff_wait(self, seconds: float) -> None:
+        """Sleep in small slices, still draining in-flight readbacks (a
+        retry storm must not let completed batches rot past their result
+        consumers) and bailing promptly on stop()."""
+        deadline = time.monotonic() + seconds
+        while self._running and time.monotonic() < deadline:
             self._drain()
-        self._drain(force=True)
+            time.sleep(min(0.01, max(0.0, deadline - time.monotonic())))
+
+    # ---- degraded mode ----
+
+    def _enter_degraded(self, exc: BaseException) -> None:
+        self._degraded = True
+        self.metrics.incr("degraded_transitions")
+        status = {
+            "status": "degraded",
+            "consecutive_failures": self._consecutive_dispatch_failures,
+            "error": repr(exc),
+        }
+        if self.resilience.probe_backend_on_degraded:
+            usable, reason = self._probe_backend()
+            status["backend_usable"] = usable
+            status["backend_reason"] = reason
+            if not usable and self._cpu_fallback is not None:
+                try:
+                    self._cpu_fallback(self)
+                    self.metrics.incr("cpu_fallbacks")
+                    status["cpu_fallback"] = True
+                except Exception:  # noqa: BLE001 — fallback is best-effort
+                    logging.getLogger(__name__).exception("cpu fallback failed")
+                    status["cpu_fallback"] = False
+        self._publish_status(status)
+
+    def _exit_degraded(self) -> None:
+        self._degraded = False
+        self.metrics.incr("degraded_recoveries")
+        self._publish_status({"status": "recovered"})
+
+    def _publish_status(self, status: Dict[str, Any]) -> None:
+        """Status publishes run on the serving thread and subscribers are
+        arbitrary app code — a raising status consumer must degrade to a
+        logged error, never crash the loop it is reporting on."""
+        try:
+            self.connector.publish(STATUS_TOPIC, status)
+        except Exception:  # noqa: BLE001 — transport/subscriber may be down
+            logging.getLogger(__name__).exception("status publish failed")
+
+    def _probe_backend(self) -> tuple:
+        """Bounded verdict on the accelerator (never hangs): the injected
+        fn for tests, else utils.backend_probe's subprocess probe with
+        allow_cpu=False — a silent JAX CPU fallback must read as "backend
+        dead", not "healthy", or the CPU-fallback hook never fires."""
+        if self._backend_probe_fn is not None:
+            return self._backend_probe_fn()
+        from opencv_facerecognizer_tpu.utils.backend_probe import (
+            probe_for_recovery,
+        )
+
+        return probe_for_recovery(timeout_s=self.resilience.probe_timeout_s)
+
+    def _dead_letter(self, count: int) -> None:
+        """Abandon a batch whose readback outlived its deadline: counted,
+        announced, completed — never blocked on (SURVEY.md §5.3: an
+        unhealthy accelerator degrades the job, never wedges it)."""
+        self.metrics.incr("batches_dead_lettered")
+        self.metrics.incr("frames_dead_lettered", count)
+        self._completed_batches += 1
+        self._publish_status({"status": "dead_letter", "frames": count})
+
+    @staticmethod
+    def _is_ready(packed) -> bool:
+        """Non-blocking readiness; backends without ``is_ready`` report
+        ready and fall back to the blocking materialize (old behavior)."""
+        try:
+            return bool(packed.is_ready())
+        except (AttributeError, NotImplementedError):
+            return True
 
     def _drain(self, force: bool = False) -> None:
-        """Materialize finished batches; block only when over depth/forced."""
+        """Materialize finished batches. A not-ready head batch past its
+        readback deadline is dead-lettered; when over depth (or forced) the
+        wait for the head is a bounded is_ready poll capped by that same
+        deadline — never an unbounded blocking readback a hang-mode outage
+        could wedge."""
         while self._inflight:
-            packed, frames, metas, count, t0, t_disp = self._inflight[0]
-            if not (packed.is_ready() or force
-                    or len(self._inflight) > self.inflight_depth):
-                break
+            packed, frames, metas, count, t0, t_disp, deadline = self._inflight[0]
+            ready = self._is_ready(packed)
+            if not ready:
+                if time.monotonic() >= deadline:
+                    self._inflight.popleft()
+                    self._dead_letter(count)
+                    continue
+                if not (force or len(self._inflight) > self.inflight_depth):
+                    break
+                # Over depth / forced: poll until ready or deadline. The
+                # poll IS the readback wait — it lands in ready_wait below.
+                while not ready and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                    ready = self._is_ready(packed)
+                if not ready:
+                    self._inflight.popleft()
+                    self._dead_letter(count)
+                    continue
             self._inflight.popleft()
             # Materialize BEFORE stamping ready_wait: on the blocking
             # (over-depth/forced) path np.asarray is the readback itself and
@@ -336,7 +597,8 @@ class RecognizerService:
             part = crops[start : start + self._enrol_chunk]
             padded = np.zeros((self._enrol_chunk, *face_size), np.float32)
             padded[: len(part)] = part
-            emb = np.array(self._embed_chunk(self.pipeline.embed_params, padded))
+            emb = np.array(self._run_embed_chunk(self.pipeline.embed_params,
+                                                 padded))
             embeddings.append(emb[: len(part)])
         emb = np.concatenate(embeddings)
         with self._enrol_lock:
@@ -371,6 +633,7 @@ class RecognizerService:
                 "gallery_size": self.pipeline.gallery.size,
             },
         )
+        self._run_commit_hooks()
 
     # ---- reload without drop (SURVEY.md §5.3) ----
 
@@ -379,3 +642,13 @@ class RecognizerService:
         self.pipeline.gallery.swap_from(new_gallery)
         self.connector.publish(STATUS_TOPIC, {"status": "reloaded",
                                               "gallery_size": self.pipeline.gallery.size})
+        self._run_commit_hooks()
+
+    def _run_commit_hooks(self) -> None:
+        """Notify commit watchers (see ``commit_hooks``); a raising hook
+        must not kill the enrolment worker or the reload caller."""
+        for hook in list(self.commit_hooks):
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — watcher bugs stay theirs
+                logging.getLogger(__name__).exception("commit hook failed")

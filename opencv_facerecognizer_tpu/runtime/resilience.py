@@ -1,0 +1,358 @@
+"""Resilience policy + supervised serving (SURVEY.md §5.3 extended to
+steady state).
+
+``utils/backend_probe.py`` hardened *startup* against the two observed
+backend outage modes (fast-fail ``UNAVAILABLE`` and silent hang); this
+module extends that posture to the serving loop itself:
+
+- ``ResiliencePolicy`` — the retry/deadline/degraded knobs threaded through
+  ``RecognizerService``: a dispatch failure retries with exponential
+  backoff, a readback that outlives its deadline is dead-lettered (the loop
+  keeps serving), and N consecutive dispatch failures flip the service into
+  **degraded mode** (status published on ``STATUS_TOPIC``, optional bounded
+  backend probe, optional CPU-fallback hook) instead of wedging.
+- ``is_transient_error`` — classifies an exception as retryable
+  (backend/transport outage shaped) vs permanent (a poisoned batch: retrying
+  a shape error burns the retry budget for nothing).
+- ``ServiceSupervisor`` — restarts a crashed serving loop with the
+  last-known-good gallery snapshot, reusing the existing double-buffered
+  ``reload_gallery`` swap. Restart count is bounded; giving up publishes a
+  terminal status rather than flapping forever.
+
+Every transition is counted in the service's ``Metrics`` (``dispatch_
+retries``, ``batches_dead_lettered``, ``degraded_transitions``,
+``supervisor_restarts``), so chaos tests can assert fault handling exactly
+(see ``tests/test_chaos.py`` and ``scripts/chaos_soak.py``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+#: substrings (lowercased) that mark an exception as outage-shaped and
+#: therefore worth retrying. "unavailable" covers both the real PJRT
+#: fast-fail string and faults.InjectedUnavailableError; the rest are the
+#: transport/tunnel shapes seen in the round-4 outage logs.
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline exceeded",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "socket closed",
+    "resource exhausted",
+    "internal: failed to",
+)
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a backend/transport outage (retry it),
+    False for permanent errors like a shape mismatch from a poisoned batch
+    (retrying those can never succeed — abandon the batch instead)."""
+    text = f"{type(exc).__name__}: {exc}".lower()
+    return any(marker in text for marker in _TRANSIENT_MARKERS)
+
+
+@dataclass
+class ResiliencePolicy:
+    """Steady-state failure-handling knobs for ``RecognizerService``.
+
+    Defaults are serving-shaped (seconds-scale deadlines, a few retries);
+    chaos tests shrink them to keep wall time short.
+    """
+
+    #: retry attempts per batch after the first dispatch failure; the
+    #: batch is abandoned (``batches_failed``) once exhausted.
+    dispatch_retries: int = 3
+    #: exponential backoff between dispatch retries: base * mult^attempt,
+    #: capped at ``backoff_max_s``. The wait keeps draining readbacks.
+    backoff_base_s: float = 0.05
+    backoff_max_s: float = 2.0
+    backoff_multiplier: float = 2.0
+    #: a dispatched batch whose readback is not ready this long after
+    #: dispatch is dead-lettered (``batches_dead_lettered``) and the loop
+    #: moves on — the hang-mode outage must cost one deadline, not wedge
+    #: the service. Sized for a tunneled backend (~100 ms readback floor
+    #: plus multi-second H2D contention behind gallery uploads).
+    readback_deadline_s: float = 30.0
+    #: consecutive failed dispatch *attempts* (across batches) that flip
+    #: the service into degraded mode.
+    degraded_after: int = 3
+    #: on entering degraded mode, run the bounded subprocess backend probe
+    #: (``utils.backend_probe``) and attach its verdict to the status
+    #: message; a dead backend then triggers ``cpu_fallback`` when wired.
+    probe_backend_on_degraded: bool = False
+    #: deadline for that probe. None (default) defers to
+    #: ``backend_probe.probe_for_recovery``'s resolution — the
+    #: ``OCVF_RECOVERY_PROBE_TIMEOUT_S`` env var, else 15 s (shorter than
+    #: startup's 60 s: the serving loop is already failing, so a quick
+    #: verdict beats a precise one). Set explicitly to override both.
+    probe_timeout_s: Optional[float] = None
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (0-based)."""
+        return min(self.backoff_max_s,
+                   self.backoff_base_s * self.backoff_multiplier ** attempt)
+
+
+def rebuild_pipeline_on_cpu(service) -> None:
+    """The stock ``cpu_fallback`` hook: rebuild the service's recognition
+    pipeline on host CPU devices when degraded mode finds the accelerator
+    dead (``ocvf-recognize --probe-on-degraded`` wires this).
+
+    Reuses the live nets/params as-is, copies the gallery through the
+    host-mirror ``snapshot``/``load_snapshot`` path onto a fresh
+    single-CPU-device mesh (no device readback — the dead accelerator may
+    not answer one), and swaps ``service.pipeline`` between batches. The
+    first CPU batch pays an XLA compile; after that the job is degraded
+    (CPU-speed) but serving. Raises when no CPU backend exists — the
+    caller treats a failed fallback as best-effort (``cpu_fallback:
+    False`` in the degraded status)."""
+    import numpy as np
+
+    import jax
+    from jax.sharding import Mesh
+
+    from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
+    from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+
+    old = service.pipeline
+    cpu_device = jax.devices("cpu")[0]
+    cpu_mesh = Mesh(np.asarray([cpu_device]).reshape(1, 1),
+                    (DP_AXIS, TP_AXIS))
+    # default_device(cpu) for the WHOLE rebuild: gallery init and snapshot
+    # install run jnp ops whose placement would otherwise go through the
+    # default (dead) accelerator backend — hanging or raising inside the
+    # very hook that exists to escape it.
+    with jax.default_device(cpu_device):
+        gallery = ShardedGallery(capacity=old.gallery.capacity,
+                                 dim=old.gallery.dim, mesh=cpu_mesh,
+                                 store_dtype=old.gallery.store_dtype)
+        gallery.load_snapshot(*old.gallery.snapshot())
+    pipeline = RecognitionPipeline(old.detector, old.embed_net,
+                                   old.embed_params, gallery,
+                                   face_size=old.face_size, top_k=old.top_k)
+    # The chaos boundary FOLLOWS the swap — moved, not copied: an armed
+    # injector left on the abandoned pipeline would leak faults into the
+    # next service built on it (production leaves both None).
+    pipeline.fault_injector = getattr(old, "fault_injector", None)
+    old.fault_injector = None
+    service.pipeline = pipeline
+    # The enrolment embed graph must follow too: the service's jitted
+    # chunk embedder would otherwise keep dispatching on the dead
+    # accelerator (see RecognizerService._run_embed_chunk).
+    service._embed_device = cpu_device
+
+
+class ServiceSupervisor:
+    """Restart a crashed serving loop with the last-known-good gallery.
+
+    The service loop already survives per-batch failures; what it cannot
+    survive is an exception escaping the loop body itself (a connector
+    handler raising inside ``publish``, a batcher bug, ...) — the thread
+    dies and frames pile up unserved. The supervisor watches for that
+    crash flag and restarts the loop, first restoring the gallery from the
+    snapshot taken at the last ``checkpoint()`` — start, every committed
+    change (the supervisor subscribes to ``STATUS_TOPIC`` and checkpoints
+    on ``enrolled``/``reloaded``), plus any point the operator/app calls
+    it — via the existing ``reload_gallery``/``swap_from`` double-buffer
+    path. A crash mid-enrolment cannot leave a half-written gallery
+    serving, and a crash AFTER a committed enrolment rolls back only to
+    that commit, not to startup.
+
+    Restarts are bounded: after ``max_restarts`` the supervisor publishes
+    ``{"status": "supervisor_gave_up"}`` and stops intervening (a crash
+    loop almost always means a real bug, and flapping hides it).
+
+    Honest limitation — the **call-time hang**: a backend that blocks
+    forever *inside* the dispatch call itself (not the readback) cannot be
+    preempted from within the process — the serving thread is stuck in
+    native code, alive, so neither the readback deadline nor the crash
+    watchdog fires. The supervisor's stall watchdog at least SURFACES that
+    shape: frames pending with zero progress for ``stall_warn_s`` publishes
+    ``{"status": "stalled"}`` (``supervisor_stalls``), the signal a
+    deploy-level supervisor (systemd/k8s liveness) needs to restart the
+    process. In-process, prevention stays with the bounded *startup* probe
+    (``utils.backend_probe``).
+    """
+
+    def __init__(self, service, max_restarts: int = 5,
+                 poll_interval_s: float = 0.2,
+                 restart_backoff_s: float = 0.1,
+                 commit_wait_s: float = 30.0):
+        self.service = service
+        self.max_restarts = int(max_restarts)
+        self.poll_interval_s = float(poll_interval_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        #: bounded wait for async-grow staged rows to land before a
+        #: post-commit checkpoint (a snapshot taken mid-grow would MISS
+        #: the rows the commit announced); on timeout the previous
+        #: checkpoint is kept — never a partial one.
+        self.commit_wait_s = float(commit_wait_s)
+        #: frames pending with zero processing progress for this long
+        #: publishes a one-shot ``stalled`` status (see class docstring:
+        #: the call-time-hang shape can only be surfaced, not fixed,
+        #: in-process).
+        self.stall_warn_s = 60.0
+        self.restarts = 0
+        self.gave_up = False
+        self._last_processed = -1.0
+        self._last_progress_t = time.monotonic()
+        self._stall_warned = False
+        self._snapshot: Optional[Tuple] = None
+        self._subject_names: Optional[list] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # ---- lifecycle ----
+
+    def start(self, warmup: bool = True) -> None:
+        """Start the service (if not already running) and the monitor."""
+        if self._thread is not None:
+            return
+        self.service.start(warmup=warmup)
+        self.checkpoint()
+        # Every committed gallery change (a finished enrolment, a retrain
+        # reload) advances last-known-good: a later crash must roll back
+        # only half-done work, not every subject enrolled since startup.
+        # Registered as a DIRECT service hook, not a STATUS_TOPIC
+        # subscription: wire connectors publish outbound only and never
+        # dispatch their own publishes locally, so a subscription would
+        # silently never fire in production.
+        self.service.commit_hooks.append(self._on_commit)
+        self._running = True
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="service-supervisor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._on_commit in self.service.commit_hooks:
+            self.service.commit_hooks.remove(self._on_commit)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.service.stop()
+
+    def checkpoint(self) -> None:
+        """Record the current gallery + subject names as last-known-good.
+        Host-mirror copies only — no device readback (the axon backend's
+        sync-poll trap, see runtime.recognizer)."""
+        self._snapshot = self.service.pipeline.gallery.snapshot()
+        self._subject_names = list(self.service.subject_names)
+        self.service.metrics.incr("supervisor_checkpoints")
+
+    def _on_commit(self) -> None:
+        """Advance last-known-good after a committed gallery change. Runs
+        on whatever thread committed (enrolment worker, reload caller) —
+        checkpoint() only copies host mirrors, so that is cheap there.
+        Under ``async_grow`` the committing add() may have only STAGED its
+        rows; wait (bounded) for the grow to land them, and on timeout
+        keep the previous checkpoint rather than capture a snapshot that
+        silently misses the rows this commit announced."""
+        if not self._running:
+            return
+        gallery = self.service.pipeline.gallery
+        wait_ready = getattr(gallery, "wait_ready", None)
+        if wait_ready is not None and not wait_ready(timeout=self.commit_wait_s):
+            logging.getLogger(__name__).warning(
+                "post-commit checkpoint skipped: staged rows not landed "
+                "within %.0fs; keeping previous snapshot", self.commit_wait_s)
+            return
+        self.checkpoint()
+
+    # ---- the watchdog ----
+
+    def _monitor(self) -> None:
+        from opencv_facerecognizer_tpu.runtime.recognizer import STATUS_TOPIC
+
+        service = self.service
+        while self._running:
+            time.sleep(self.poll_interval_s)
+            self._check_stall(service, STATUS_TOPIC)
+            if not service.loop_crashed or not service._running:
+                continue
+            thread = service._thread
+            if thread is not None and thread.is_alive():
+                # Crash flagged but the thread is still unwinding (e.g. a
+                # slow 'crashed' status subscriber): restart_loop would
+                # no-op on the alive thread, so acting now would burn a
+                # phantom restart (and desync restarts vs loop_crashes,
+                # which the soak treats as an unsupervised crash). Wait
+                # for the thread to actually exit.
+                continue
+            if self.restarts >= self.max_restarts:
+                if not self.gave_up:
+                    self.gave_up = True
+                    service.metrics.incr("supervisor_gave_up")
+                    self._publish(STATUS_TOPIC, {
+                        "status": "supervisor_gave_up",
+                        "restarts": self.restarts,
+                    })
+                continue
+            self.restarts += 1
+            try:
+                self._restore_gallery()
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "gallery restore failed; restarting with current state")
+            service.restart_loop()
+            # Counter flips only once the restore + restart are done, so a
+            # watcher seeing it can rely on the last-known-good gallery
+            # already being live (the chaos test's synchronization point).
+            service.metrics.incr("supervisor_restarts")
+            self._publish(STATUS_TOPIC, {
+                "status": "supervisor_restart",
+                "restarts": self.restarts,
+            })
+            time.sleep(self.restart_backoff_s)
+
+    def _check_stall(self, service, status_topic: str) -> None:
+        """One-shot ``stalled`` announcement when frames are pending but
+        the loop has made no progress for ``stall_warn_s`` — the
+        call-time-hang signature a deploy-level liveness check keys on.
+        Progress is ANY batch outcome, including abandons and dead-letters:
+        a loop actively surviving a fast-fail outage (every batch retried
+        then abandoned) is degraded, not stalled — flagging it would make
+        the deploy layer kill a process that is degrading gracefully."""
+        m = service.metrics
+        processed = (m.counter("frames_processed")
+                     + m.counter("batches_failed")
+                     + m.counter("batches_dead_lettered"))
+        now = time.monotonic()
+        if processed != self._last_processed:
+            self._last_processed = processed
+            self._last_progress_t = now
+            self._stall_warned = False
+            return
+        if (not self._stall_warned
+                and service.batcher.pending > 0
+                and now - self._last_progress_t > self.stall_warn_s):
+            self._stall_warned = True
+            service.metrics.incr("supervisor_stalls")
+            self._publish(status_topic, {
+                "status": "stalled",
+                "pending_frames": service.batcher.pending,
+                "seconds_without_progress": round(now - self._last_progress_t, 1),
+            })
+
+    def _restore_gallery(self) -> None:
+        if self._snapshot is None:
+            return
+        service = self.service
+        service.pipeline.gallery.load_snapshot(*self._snapshot)
+        if self._subject_names is not None:
+            # Same in-place trim/extend rule as the gallery restore: names
+            # enrolled after the checkpoint have no committed rows anymore.
+            service.subject_names[:] = self._subject_names
+
+    def _publish(self, topic: str, message: dict) -> None:
+        try:
+            self.service.connector.publish(topic, message)
+        except Exception:  # a dead transport must not kill the watchdog
+            logging.getLogger(__name__).exception("supervisor publish failed")

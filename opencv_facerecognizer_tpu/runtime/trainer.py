@@ -221,11 +221,13 @@ class TheTrainer:
     def build_gallery(self, images: np.ndarray, labels: np.ndarray, mesh,
                       capacity: int = 0, store_dtype=np.float32):
         """Embed the enrolled set with the trained CNN and install it into a
-        ShardedGallery for the serving pipeline. ``store_dtype`` must match
-        the serving gallery's when the result is handed to
-        ``Recognizer.reload_gallery`` (``swap_from`` rejects a mismatch —
-        same-capacity snapshots of different dtypes would alias compiled
-        cache keys); pass ``jnp.bfloat16`` for the ocvf-recognize default."""
+        ShardedGallery for the serving pipeline. A ``store_dtype`` that
+        differs from the serving gallery's is fine for the
+        ``Recognizer.reload_gallery`` handoff — ``swap_from`` casts the
+        staged snapshot to the serving width at install (the default f32
+        here lands in the bf16 ocvf-recognize default without the caller
+        knowing serving's dtype; round-5 advisor). Pass ``jnp.bfloat16``
+        to skip that one extra cast+upload when you do know it."""
         from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 
         if self.model is None or not isinstance(self.model.feature, CNNEmbedding):

@@ -41,6 +41,12 @@ TIMEOUT_ENV = "OCVF_BACKEND_PROBE_TIMEOUT_S"
 # First axon init on a healthy tunnel takes ~10-20 s; 60 s separates
 # "slow init" from "hang-mode" with wide margin.
 DEFAULT_TIMEOUT_S = 60.0
+# Degraded-mode recovery probes (runtime.resilience) run on a SHORTER
+# leash: the serving loop is already failing, so a fast verdict beats a
+# precise one — 15 s still covers a healthy re-init, and a hang past it is
+# exactly the answer the caller needed.
+RECOVERY_TIMEOUT_ENV = "OCVF_RECOVERY_PROBE_TIMEOUT_S"
+DEFAULT_RECOVERY_TIMEOUT_S = 15.0
 
 # Child exit codes (anything else = init/exec raised).
 _RC_OK = 0
@@ -118,3 +124,21 @@ def probe_default_backend(
     if proc.returncode == _RC_CPU_FALLBACK:
         return False, "default backend is CPU (accelerator missing or fell back)"
     return False, f"backend init/first-op failed (probe rc={proc.returncode})"
+
+
+def probe_for_recovery(timeout_s: float | None = None,
+                       probe_source: str | None = None) -> tuple[bool, str]:
+    """Degraded-mode backend check for the serving loop (runtime.resilience):
+    same bounded subprocess probe, shorter default deadline, and
+    ``allow_cpu=False`` — after consecutive dispatch failures the question
+    is "is the ACCELERATOR alive?", and a silent JAX fallback to CPU must
+    read as dead so the service's CPU-fallback hook (an explicit,
+    announced degradation) fires instead of a silent mis-measured one."""
+    if timeout_s is None:
+        try:
+            timeout_s = float(os.environ.get(RECOVERY_TIMEOUT_ENV,
+                                             DEFAULT_RECOVERY_TIMEOUT_S))
+        except ValueError:
+            timeout_s = DEFAULT_RECOVERY_TIMEOUT_S
+    return probe_default_backend(timeout_s=timeout_s, allow_cpu=False,
+                                 probe_source=probe_source)

@@ -32,6 +32,13 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
+    def counters(self) -> Dict[str, float]:
+        """One atomic snapshot of every counter — the chaos tests compare
+        this against a FaultInjector's injected-fault ledger, so the read
+        must not interleave with concurrent incrs."""
+        with self._lock:
+            return dict(self._counters)
+
     def percentile(self, name: str, q: float) -> float:
         with self._lock:
             values = sorted(self._latencies.get(name, ()))

@@ -1,0 +1,604 @@
+"""Chaos suite: the serving loop under injected faults (runtime.faults /
+runtime.resilience).
+
+The acceptance scenario (ISSUE 1): one stuck readback, three consecutive
+UNAVAILABLE dispatches, and a corrupt frame into a running
+RecognizerService over FakeConnector — the service never deadlocks,
+dead-letters exactly the stuck batch, retries then enters degraded mode
+with a STATUS_TOPIC message, and every healthy frame submitted afterwards
+still gets a result, with metrics matching the injected fault counts
+exactly. Plus: supervisor restart with gallery restore, degraded-mode
+backend probe + CPU fallback, the fault injector's determinism contract,
+and the seed-logged chaos soak (fast deterministic variant in tier-1, the
+long randomized soak marked slow).
+"""
+
+import importlib.util
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.runtime import (
+    FakeConnector,
+    FaultInjector,
+    RecognizerService,
+    ResiliencePolicy,
+    ServiceSupervisor,
+)
+from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+from opencv_facerecognizer_tpu.runtime.faults import (
+    InjectedUnavailableError,
+    StuckReadback,
+)
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    FRAME_TOPIC,
+    RESULT_TOPIC,
+    STATUS_TOPIC,
+)
+from opencv_facerecognizer_tpu.runtime.resilience import is_transient_error
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "chaos_soak", os.path.join(REPO_ROOT, "scripts", "chaos_soak.py"))
+chaos_soak = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos_soak)
+
+FRAME_SHAPE = (64, 64)
+RNG = np.random.default_rng(11)
+
+
+def _wait(cond, timeout=20.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture(scope="module")
+def chaos_stack():
+    """Tiny untrained serving stack — chaos tests exercise control flow,
+    not recognition quality (see scripts/chaos_soak.build_stack)."""
+    return chaos_soak.build_stack(frame_shape=FRAME_SHAPE, seed=0)
+
+
+def _frame_msg(meta=None):
+    frame = RNG.uniform(0, 255, FRAME_SHAPE).astype(np.float32)
+    return {**encode_frame(frame), "meta": meta}
+
+
+def _make_service(pipe, injector=None, policy=None, **kwargs):
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=2, frame_shape=FRAME_SHAPE,
+        # Wide enough that two back-to-back injects always land in ONE
+        # batch (the acceptance assertions count whole batches).
+        flush_timeout=0.08, inflight_depth=2,
+        resilience=policy or ResiliencePolicy(
+            dispatch_retries=3, backoff_base_s=0.01, backoff_max_s=0.05,
+            readback_deadline_s=0.6, degraded_after=3,
+        ),
+        fault_injector=injector,
+        **kwargs,
+    )
+    return service, connector
+
+
+# ---------- the acceptance scenario ----------
+
+
+def test_chaos_acceptance_stuck_unavailable_corrupt(chaos_stack):
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=1)
+    service, connector = _make_service(pipe, injector)
+    metrics = service.metrics
+    service.start()
+    try:
+        # (a) one stuck readback: the whole batch must be dead-lettered at
+        # its deadline — and ONLY that batch.
+        injector.script("readback", "stuck")
+        connector.inject(FRAME_TOPIC, _frame_msg({"phase": "stuck", "i": 0}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"phase": "stuck", "i": 1}))
+        assert _wait(lambda: metrics.counter("batches_dead_lettered") >= 1), \
+            "stuck readback was never dead-lettered (loop wedged?)"
+        assert metrics.counter("batches_dead_lettered") == 1
+
+        # (b) three consecutive UNAVAILABLE dispatches: retried with
+        # backoff, degraded mode published at the third failure, then the
+        # fourth attempt succeeds and the service recovers.
+        injector.script("dispatch", "unavailable", "unavailable", "unavailable")
+        connector.inject(FRAME_TOPIC, _frame_msg({"phase": "unavail", "i": 0}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"phase": "unavail", "i": 1}))
+        assert _wait(lambda: metrics.counter("degraded_recoveries") >= 1), \
+            "service never recovered from the UNAVAILABLE burst"
+        statuses = [m["status"] for m in connector.messages(STATUS_TOPIC)]
+        assert "degraded" in statuses and "recovered" in statuses
+        degraded = next(m for m in connector.messages(STATUS_TOPIC)
+                        if m["status"] == "degraded")
+        assert degraded["consecutive_failures"] == 3
+
+        # (c) one corrupt frame: counted malformed, never batched.
+        injector.script("receive", "corrupt")
+        connector.inject(FRAME_TOPIC, _frame_msg({"phase": "corrupt"}))
+        assert _wait(lambda: metrics.counter("frames_malformed") >= 1)
+
+        # Every healthy frame submitted afterwards still gets a result.
+        n_before = len(connector.messages(RESULT_TOPIC))
+        for i in range(4):
+            connector.inject(FRAME_TOPIC, _frame_msg({"phase": "healthy", "i": i}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("phase") == "healthy"]) >= 4), \
+            "healthy frames after the fault sequence got no results"
+    finally:
+        service.stop()
+
+    # Metrics match the injected fault counts EXACTLY.
+    injected = injector.summary()
+    counters = metrics.counters()
+    assert injected == {"readback:stuck": 1, "dispatch:unavailable": 3,
+                        "receive:corrupt": 1}
+    assert counters["batches_dead_lettered"] == injected["readback:stuck"]
+    assert counters["frames_dead_lettered"] == 2  # both frames of the batch
+    assert counters["dispatch_failures"] == injected["dispatch:unavailable"]
+    assert counters["dispatch_retries"] == 3
+    assert counters.get("batches_failed", 0) == 0  # retried, never abandoned
+    assert counters["frames_malformed"] == injected["receive:corrupt"]
+    assert counters["degraded_transitions"] == 1
+    assert counters["degraded_recoveries"] == 1
+    # The unavailable-phase and healthy-phase frames all published.
+    metas = [m.get("meta") or {} for m in connector.messages(RESULT_TOPIC)]
+    assert sum(m.get("phase") == "unavail" for m in metas) == 2
+    assert sum(m.get("phase") == "healthy" for m in metas) == 4
+    assert sum(m.get("phase") == "stuck" for m in metas) == 0  # dead-lettered
+
+
+def test_receive_drop_and_duplicate(chaos_stack):
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=2)
+    service, connector = _make_service(pipe, injector)
+    service.start()
+    try:
+        injector.script("receive", "drop", "duplicate")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "dropped"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "doubled"}))
+        assert _wait(lambda: len(connector.messages(RESULT_TOPIC)) >= 2)
+    finally:
+        service.stop()
+    metas = [m.get("meta") or {} for m in connector.messages(RESULT_TOPIC)]
+    assert sum(m.get("k") == "doubled" for m in metas) == 2
+    assert sum(m.get("k") == "dropped" for m in metas) == 0
+
+
+def test_poisoned_batch_put_boundary(chaos_stack):
+    """A frame corrupted at the batcher-put boundary is dropped by shape
+    validation (counted on the shared metrics surface) and never poisons
+    its batch — peers still get results."""
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=3)
+    service, connector = _make_service(pipe, injector)
+    service.start()
+    try:
+        injector.script("put", "corrupt")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "poisoned"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "fine"}))
+        assert _wait(lambda: len(connector.messages(RESULT_TOPIC)) >= 1)
+    finally:
+        service.stop()
+    counters = service.metrics.counters()
+    assert counters["batcher_dropped_malformed"] == 1
+    assert counters["frames_dropped"] == 1  # the service-side mirror
+    metas = [m.get("meta") or {} for m in connector.messages(RESULT_TOPIC)]
+    assert sum(m.get("k") == "fine" for m in metas) == 1
+    assert sum(m.get("k") == "poisoned" for m in metas) == 0
+
+
+def test_dispatch_exhaustion_abandons_batch(chaos_stack):
+    """More consecutive UNAVAILABLEs than the retry budget: the batch is
+    abandoned (batches_failed), the loop keeps serving."""
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=4)
+    policy = ResiliencePolicy(dispatch_retries=1, backoff_base_s=0.01,
+                              backoff_max_s=0.02, readback_deadline_s=0.6,
+                              degraded_after=2)
+    service, connector = _make_service(pipe, injector, policy)
+    service.start()
+    try:
+        injector.script("dispatch", "unavailable", "unavailable")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "doomed"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "doomed"}))
+        assert _wait(lambda: service.metrics.counter("batches_failed") >= 1)
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "after"]) >= 2)
+    finally:
+        service.stop()
+    counters = service.metrics.counters()
+    assert counters["batches_failed"] == 1
+    assert counters["dispatch_failures"] == 2
+    assert counters["degraded_transitions"] == 1  # hit degraded_after=2
+
+
+# ---------- supervisor ----------
+
+
+class _CrashOnceConnector(FakeConnector):
+    """Raises from the first RESULT publish — an exception escaping the
+    loop body via a subscriber, the crash class the supervisor exists for."""
+
+    def __init__(self):
+        super().__init__()
+        self.crashes_left = 1
+
+    def publish(self, topic, message):
+        if topic == RESULT_TOPIC and self.crashes_left:
+            self.crashes_left -= 1
+            raise RuntimeError("result consumer blew up")
+        super().publish(topic, message)
+
+    inject = publish
+
+
+def test_supervisor_restarts_crashed_loop_and_restores_gallery(chaos_stack):
+    pipe, _ = chaos_stack
+    connector = _CrashOnceConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=2, frame_shape=FRAME_SHAPE,
+        flush_timeout=0.02,
+        resilience=ResiliencePolicy(readback_deadline_s=5.0),
+    )
+    supervisor = ServiceSupervisor(service, max_restarts=3,
+                                   poll_interval_s=0.05)
+    supervisor.start()
+    size_at_checkpoint = pipe.gallery.size
+    try:
+        # Rows added after the checkpoint simulate a half-done enrolment
+        # the crash interrupts; the restart must roll them back.
+        pipe.gallery.add(RNG.normal(size=(3, 16)).astype(np.float32),
+                         np.full(3, 3, np.int32))
+        assert pipe.gallery.size == size_at_checkpoint + 3
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "crash-bait"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "crash-bait"}))
+        assert _wait(lambda: service.metrics.counter("supervisor_restarts") >= 1), \
+            "supervisor never restarted the crashed loop"
+        assert pipe.gallery.size == size_at_checkpoint  # restored
+        # The restarted loop still serves.
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after-restart"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after-restart"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "after-restart"]) >= 2)
+    finally:
+        supervisor.stop()
+    assert service.metrics.counter("loop_crashes") == 1
+    assert supervisor.restarts == 1
+    assert not supervisor.gave_up
+    statuses = [m["status"] for m in connector.messages(STATUS_TOPIC)]
+    assert "crashed" in statuses and "supervisor_restart" in statuses
+
+
+def test_degraded_probe_and_cpu_fallback(chaos_stack):
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=5)
+    policy = ResiliencePolicy(dispatch_retries=3, backoff_base_s=0.01,
+                              backoff_max_s=0.02, readback_deadline_s=0.6,
+                              degraded_after=3,
+                              probe_backend_on_degraded=True)
+    fallbacks = []
+    service, connector = _make_service(
+        pipe, injector, policy,
+        backend_probe_fn=lambda: (False, "injected-dead"),
+        cpu_fallback=lambda svc: fallbacks.append(svc),
+    )
+    service.start()
+    try:
+        injector.script("dispatch", "unavailable", "unavailable", "unavailable")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        assert _wait(lambda: service.metrics.counter("degraded_recoveries") >= 1)
+    finally:
+        service.stop()
+    degraded = next(m for m in connector.messages(STATUS_TOPIC)
+                    if m["status"] == "degraded")
+    assert degraded["backend_usable"] is False
+    assert degraded["backend_reason"] == "injected-dead"
+    assert degraded["cpu_fallback"] is True
+    assert fallbacks == [service]
+    assert service.metrics.counter("cpu_fallbacks") == 1
+
+
+# ---------- fault injector contract ----------
+
+
+def test_fault_injector_scripted_order_and_counts():
+    fi = FaultInjector(seed=0)
+    fi.script("receive", "drop", "duplicate", "corrupt")
+    msg = {"__frame__": "x", "shape": [1], "dtype": "uint8", "meta": 7}
+    assert fi.on_receive(msg) == []
+    assert fi.on_receive(msg) == [msg, msg]
+    corrupted = fi.on_receive(msg)
+    assert len(corrupted) == 1 and corrupted[0]["__frame__"] != "x"
+    assert corrupted[0]["meta"] == 7  # provenance survives corruption
+    assert fi.on_receive(msg) == [msg]  # script exhausted -> passthrough
+    with pytest.raises(ValueError):
+        fi.script("dispatch", "stuck")  # wrong boundary
+    with pytest.raises(ValueError):
+        fi.script("bogus", "drop")
+    assert fi.summary() == {"receive:drop": 1, "receive:duplicate": 1,
+                            "receive:corrupt": 1}
+
+
+def test_fault_injector_seeded_rates_reproducible():
+    rates = {"dispatch": {"unavailable": 0.5}}
+    outcomes = []
+    for _ in range(2):
+        fi = FaultInjector(seed=42, rates=rates)
+        run = []
+        for _ in range(32):
+            try:
+                fi.on_dispatch()
+                run.append(False)
+            except InjectedUnavailableError:
+                run.append(True)
+        outcomes.append(run)
+    assert outcomes[0] == outcomes[1]  # same seed, same fault sequence
+    assert any(outcomes[0]) and not all(outcomes[0])
+
+
+def test_fault_injector_disarm():
+    fi = FaultInjector(seed=0, rates={"dispatch": {"unavailable": 1.0}})
+    fi.script("readback", "stuck")
+    fi.disarm()
+    fi.on_dispatch()  # no raise
+    arr = np.zeros(2)
+    assert fi.on_readback(arr) is arr
+    assert fi.summary() == {}
+    fi.arm()
+    assert isinstance(fi.on_readback(arr), StuckReadback)
+
+
+def test_stuck_readback_never_materializes_silently():
+    stuck = StuckReadback(np.zeros(3))
+    assert stuck.is_ready() is False
+    stuck.copy_to_host_async()  # no-op, no raise
+    with pytest.raises(RuntimeError, match="stuck"):
+        np.asarray(stuck)
+
+
+def test_transient_error_classification():
+    assert is_transient_error(InjectedUnavailableError())
+    assert is_transient_error(RuntimeError("UNAVAILABLE: socket closed"))
+    assert is_transient_error(ConnectionResetError("connection reset by peer"))
+    assert not is_transient_error(ValueError("shape mismatch [8, 64, 64]"))
+    assert not is_transient_error(TypeError("not an array"))
+
+
+def test_probe_for_recovery_injectable_and_bounded():
+    from opencv_facerecognizer_tpu.utils.backend_probe import probe_for_recovery
+
+    usable, reason = probe_for_recovery(
+        timeout_s=30.0, probe_source="import sys; sys.exit(0)")
+    assert usable and reason == "ok"
+    t0 = time.monotonic()
+    usable, reason = probe_for_recovery(
+        timeout_s=0.5, probe_source="import time; time.sleep(30)")
+    assert not usable and "hang-mode" in reason
+    assert time.monotonic() - t0 < 5.0  # bounded, killed at the deadline
+
+
+# ---------- chaos soak ----------
+
+
+def test_chaos_soak_fast_deterministic():
+    """Tier-1 variant: short chaos window, pinned seed — rc-0 semantics of
+    scripts/chaos_soak.py (no wedge, no unsupervised crash, accounting)."""
+    report = chaos_soak.run_soak(seconds=1.5, seed=7)
+    assert report["ok"], report["failures"]
+    assert report["seed"] == 7
+    assert report["results"] > 0
+
+
+@pytest.mark.slow
+def test_chaos_soak_long_randomized():
+    report = chaos_soak.run_soak(seconds=30.0)
+    assert report["ok"], report["failures"]
+
+
+# ---------- review-hardening: degraded-path edges ----------
+
+
+def test_status_subscriber_raising_never_crashes_loop(chaos_stack):
+    """Degraded/recovered/dead-letter statuses publish from the serving
+    thread into arbitrary app subscribers — one that raises must cost a
+    logged error, not the serving loop."""
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=6)
+    service, connector = _make_service(pipe, injector)
+
+    def angry_subscriber(topic, message):
+        raise RuntimeError("status consumer blew up")
+
+    connector.subscribe(STATUS_TOPIC, angry_subscriber)
+    service.start()
+    try:
+        # Both degraded entry and recovery publish through the subscriber.
+        injector.script("dispatch", "unavailable", "unavailable", "unavailable")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        assert _wait(lambda: service.metrics.counter("degraded_recoveries") >= 1)
+        # ...and a dead-letter announcement too.
+        injector.script("readback", "stuck")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "y"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "y"}))
+        assert _wait(lambda: service.metrics.counter("batches_dead_lettered") >= 1)
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "alive"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "alive"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "alive"]) >= 2)
+    finally:
+        service.stop()
+    assert service.metrics.counter("loop_crashes") == 0
+
+
+def test_cpu_fallback_rebuilds_pipeline_and_keeps_serving(chaos_stack):
+    """The stock rebuild_pipeline_on_cpu hook (what ocvf-recognize wires
+    for --probe-on-degraded): a dead-backend verdict swaps in a pipeline
+    on a single host CPU device with the gallery copied through the
+    host-mirror snapshot path, and serving continues on it."""
+    from opencv_facerecognizer_tpu.runtime.resilience import (
+        rebuild_pipeline_on_cpu,
+    )
+
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=8)
+    policy = ResiliencePolicy(dispatch_retries=3, backoff_base_s=0.01,
+                              backoff_max_s=0.02, readback_deadline_s=0.6,
+                              degraded_after=3,
+                              probe_backend_on_degraded=True)
+    service, connector = _make_service(
+        pipe, injector, policy,
+        backend_probe_fn=lambda: (False, "injected-dead"),
+        cpu_fallback=rebuild_pipeline_on_cpu,
+    )
+    old_pipe = service.pipeline
+    old_size = old_pipe.gallery.size
+    service.start()
+    try:
+        injector.script("dispatch", "unavailable", "unavailable", "unavailable")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "x"}))
+        assert _wait(lambda: service.metrics.counter("cpu_fallbacks") >= 1)
+        # The swap is visible and serving continues on the new pipeline.
+        assert service.pipeline is not old_pipe
+        assert service.pipeline.gallery.mesh.size == 1
+        assert service.pipeline.gallery.size == old_size
+        # The injector MOVED with the swap (an armed one left behind would
+        # leak faults into the next service built on the shared pipeline).
+        assert old_pipe.fault_injector is None
+        assert service.pipeline.fault_injector is injector
+        # The enrolment embed graph follows to the fallback device too.
+        assert service._embed_device is not None
+        chunk = np.zeros((service._enrol_chunk, *service.pipeline.face_size),
+                         np.float32)
+        emb = np.asarray(service._run_embed_chunk(
+            service.pipeline.embed_params, chunk))
+        assert emb.shape[0] == service._enrol_chunk
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "after"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "after"]) >= 2, timeout=60)
+    finally:
+        service.stop()
+    degraded = next(m for m in connector.messages(STATUS_TOPIC)
+                    if m["status"] == "degraded")
+    assert degraded["cpu_fallback"] is True
+    assert service.metrics.counter("loop_crashes") == 0
+
+
+def test_supervisor_recheckpoints_on_committed_changes(chaos_stack):
+    """A committed enrolment/reload advances last-known-good: a crash
+    afterwards must restore the post-commit gallery, not roll back every
+    subject enrolled since startup."""
+    pipe, _ = chaos_stack
+    connector = _CrashOnceConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=2, frame_shape=FRAME_SHAPE,
+        flush_timeout=0.02,
+        resilience=ResiliencePolicy(readback_deadline_s=5.0),
+    )
+    supervisor = ServiceSupervisor(service, max_restarts=3,
+                                   poll_interval_s=0.05)
+    supervisor.start()
+    base_size = pipe.gallery.size
+    try:
+        # Commit rows exactly as _finish_enrolment does: gallery change,
+        # then the service's commit hooks fire (direct callback — wire
+        # connectors never dispatch their own publishes locally, so this
+        # must NOT depend on a status subscription).
+        checkpoints = service.metrics.counter("supervisor_checkpoints")
+        pipe.gallery.add(RNG.normal(size=(2, 16)).astype(np.float32),
+                         np.full(2, 3, np.int32))
+        service._run_commit_hooks()
+        assert _wait(lambda: service.metrics.counter("supervisor_checkpoints")
+                     > checkpoints)
+        # Crash the loop AFTER the commit checkpoint (first RESULT publish
+        # raises): restore must keep the enrolled rows.
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "crash-bait"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "crash-bait"}))
+        assert _wait(lambda: service.metrics.counter("supervisor_restarts") >= 1)
+        assert pipe.gallery.size == base_size + 2
+    finally:
+        supervisor.stop()
+
+
+def test_supervisor_stall_watchdog_surfaces_no_progress(chaos_stack):
+    """Call-time-hang surfacing: frames pending with zero processing
+    progress past stall_warn_s publishes a one-shot 'stalled' status —
+    the deploy-level liveness signal (the shape cannot be fixed
+    in-process; see ServiceSupervisor docstring)."""
+    pipe, _ = chaos_stack
+    connector = FakeConnector()
+    service = RecognizerService(pipe, connector, batch_size=2,
+                                frame_shape=FRAME_SHAPE, flush_timeout=0.02)
+    supervisor = ServiceSupervisor(service)
+    supervisor.stall_warn_s = 0.1
+    # Loop never started: queued frames can make no progress — the stall
+    # signature, without needing a real native-code hang.
+    service.batcher.put(np.zeros(FRAME_SHAPE, np.float32))
+    supervisor._check_stall(service, STATUS_TOPIC)  # baselines progress
+    time.sleep(0.15)
+    supervisor._check_stall(service, STATUS_TOPIC)
+    assert service.metrics.counter("supervisor_stalls") == 1
+    stalled = [m for m in connector.messages(STATUS_TOPIC)
+               if m["status"] == "stalled"]
+    assert len(stalled) == 1 and stalled[0]["pending_frames"] == 1
+    # One-shot: no repeat warning while still stalled.
+    supervisor._check_stall(service, STATUS_TOPIC)
+    assert service.metrics.counter("supervisor_stalls") == 1
+    # An abandoned batch IS progress: a loop surviving a fast-fail outage
+    # (dispatch fails, batch abandoned) is degraded, not stalled.
+    service.metrics.incr("batches_failed")
+    supervisor._check_stall(service, STATUS_TOPIC)  # progress: re-arms
+    time.sleep(0.15)
+    service.metrics.incr("batches_failed")
+    supervisor._check_stall(service, STATUS_TOPIC)  # still advancing
+    assert service.metrics.counter("supervisor_stalls") == 1
+
+
+def test_supervisor_waits_for_crashed_thread_to_exit(chaos_stack):
+    """A crash flag raised while the serving thread is still unwinding
+    (slow 'crashed'-status subscriber) must not burn phantom restarts:
+    restart_loop would no-op on the alive thread, desyncing restarts vs
+    loop_crashes — the soak's unsupervised-crash signature."""
+    pipe, _ = chaos_stack
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=2, frame_shape=FRAME_SHAPE,
+        flush_timeout=0.02,
+        resilience=ResiliencePolicy(readback_deadline_s=5.0),
+    )
+    supervisor = ServiceSupervisor(service, max_restarts=3,
+                                   poll_interval_s=0.05)
+    supervisor.start()
+    try:
+        service._crashed = True  # flag up, thread alive and healthy
+        time.sleep(0.4)  # several monitor polls
+        assert supervisor.restarts == 0
+        assert service.metrics.counter("supervisor_restarts") == 0
+        service._crashed = False
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "fine"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "fine"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "fine"]) >= 2)
+    finally:
+        supervisor.stop()

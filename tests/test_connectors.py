@@ -398,3 +398,101 @@ def test_gallery_concurrent_adds_lose_no_rows():
     labels = gal.data.labels
     counts = {i: int((np.asarray(labels) == i).sum()) for i in range(8)}
     assert all(v == 2 for v in counts.values()), counts
+
+
+# ---------- transport failure paths on the shared metrics surface ----------
+# (ISSUE 1: failure-path coverage asserted via utils.metrics.Metrics — the
+# one ledger the serving stats consumer reads — not per-transport attrs.)
+
+
+def test_jsonl_garbage_and_truncated_lines_counted_on_metrics():
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    lines = (
+        "not json at all\n"
+        '{"topic": "t", "data": {"trunc":\n'  # truncated mid-object
+        '{"no_topic_key": 1}\n'               # parses, wrong schema
+        '{"topic": "t", "data": {"k": 1}}\n'  # the one healthy line
+    )
+    c = JSONLConnector(io.StringIO(lines), io.StringIO(), metrics=m)
+    got = []
+    c.subscribe("t", lambda t, msg: got.append(msg))
+    c.start()
+    assert c.eof.wait(timeout=5.0)
+    c.stop()
+    assert got == [{"k": 1}]
+    assert c.malformed_lines == 3
+    assert m.counter("connector_malformed_lines") == 3
+
+
+def test_socket_peer_disconnect_mid_message_counted():
+    """A peer that dies mid-message: the unterminated final line counts
+    malformed (truncated JSON never parses) and the disconnect itself is
+    counted — two counters, two distinct faults — while the server keeps
+    serving other clients."""
+    import socket as socket_mod
+
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    server = SocketConnector(listen=True, metrics=m)
+    received = []
+    server.subscribe("frames", lambda t, msg: received.append(msg))
+    server.start()
+    healthy = None
+    try:
+        flaky = socket_mod.create_connection(("127.0.0.1", server.port))
+        flaky.sendall(b'{"topic": "frames", "data": {"seq": 1}}\n')
+        # Mid-message death: half a JSON object, no newline, then gone.
+        flaky.sendall(b'{"topic": "frames", "data": {"seq":')
+        flaky.close()
+
+        deadline = time.monotonic() + 5
+        while (m.counter("connector_peer_disconnects") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert received == [{"seq": 1}]
+        assert m.counter("connector_malformed_lines") == 1
+        assert m.counter("connector_peer_disconnects") == 1
+
+        # Still serving: a healthy client round-trips after the flake.
+        healthy = SocketConnector(port=server.port)
+        healthy.start()
+        healthy.publish("frames", {"seq": 2})
+        deadline = time.monotonic() + 5
+        while len(received) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received[-1] == {"seq": 2}
+    finally:
+        # Server first: its own stop() clears _running before closing
+        # sockets, so tearing down the healthy client afterwards must not
+        # read as another peer flake.
+        server.stop()
+        if healthy is not None:
+            healthy.stop()
+    assert m.counter("connector_peer_disconnects") == 1
+
+
+def test_batcher_drop_counters_on_metrics():
+    """FrameBatcher.put drops land on the shared Metrics: malformed frames
+    (wrong shape / non-numeric dtype) and freshness-overflow evictions."""
+    from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    b = FrameBatcher(batch_size=4, frame_shape=(8, 8), flush_timeout=0.01,
+                     max_pending=2, metrics=m)
+    assert b.put(np.zeros((8, 8), np.float32))
+    assert not b.put(np.zeros((4, 4), np.float32))        # wrong shape
+    assert not b.put(np.zeros((8, 8, 3), np.float32))     # wrong rank
+    assert not b.put(np.array([["x"] * 8] * 8))           # non-numeric
+    assert m.counter("batcher_dropped_malformed") == 3
+    assert b.put(np.ones((8, 8), np.float32))
+    assert b.put(np.full((8, 8), 2.0, np.float32))        # evicts oldest
+    assert m.counter("batcher_dropped_overflow") == 1
+    time.sleep(0.02)  # past flush_timeout: the partial batch is flushable
+    batch = b.get_batch(block=False)
+    assert batch is not None and batch.count == 2
+    np.testing.assert_array_equal(batch.frames[0], np.ones((8, 8)))
+    b.close()

@@ -267,7 +267,10 @@ def test_initialize_multihost_env_and_args(monkeypatch):
     calls = []
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda **kw: calls.append(kw))
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    # raising=False: jax < 0.5 has no is_initialized — the attr is created
+    # here and mesh._distributed_is_initialized picks it up via getattr.
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False,
+                        raising=False)
     # env-var path
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
@@ -283,7 +286,8 @@ def test_initialize_multihost_env_and_args(monkeypatch):
     assert calls[-1] == {"coordinator_address": None,
                          "num_processes": 8, "process_id": 3}
     # already-initialized short circuit
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True,
+                        raising=False)
     n = len(calls)
     assert mesh_mod.initialize_multihost() is True
     assert len(calls) == n
@@ -636,3 +640,128 @@ def test_grow_evicts_tiers_older_than_previous():
     # serving still correct at the new tier
     out = pipe.recognize_batch(frames)
     assert np.asarray(out.labels).shape == (2, 2, 1)
+
+
+def test_gallery_async_grow_copies_staged_labels():
+    """The staged path must copy LABELS too, not just embeddings: asarray
+    of an int32 input is a no-copy view, and the worker splices seconds
+    after add() returns — a caller reusing its label buffer would enroll
+    wrong identities (round-5 advisor)."""
+    import threading
+
+    mesh = make_mesh(tp=2)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh, async_grow=True)
+    hold = threading.Event()
+    g.prewarm_hooks.append(lambda cap: hold.wait(5))
+    g.add(RNG.normal(size=(8, 4)).astype(np.float32),
+          np.arange(8, dtype=np.int32))
+    label_buf = np.arange(8, 12, dtype=np.int32)  # int32: asarray is a view
+    g.add(RNG.normal(size=(4, 4)).astype(np.float32), label_buf)
+    label_buf[:] = 99  # caller reuses its buffer while the grow is held
+    hold.set()
+    assert g.wait_ready(timeout=30)
+    assert g.size == 12
+    np.testing.assert_array_equal(np.asarray(g.labels)[8:12],
+                                  np.arange(8, 12))
+
+
+def test_pace_chunk_per_chunk_deadline_and_timeout_flag():
+    """_pace_chunk (the chunked-upload pacer): a chunk that never lands
+    gives up at ITS deadline and records info['chunk_pacing_timeout'] so
+    grow artifacts surface the degraded (unpaced) window; a ready chunk
+    paces clean; a backend without is_ready stops pacing silently."""
+    import time as _time
+
+    class _Never:
+        def is_ready(self):
+            return False
+
+    class _Ready:
+        def is_ready(self):
+            return True
+
+    info = {}
+    t0 = _time.monotonic()
+    assert not ShardedGallery._pace_chunk(_Never(), _time.monotonic() + 0.1,
+                                          info=info)
+    assert info.get("chunk_pacing_timeout") is True
+    assert _time.monotonic() - t0 < 5.0  # per-chunk deadline, not residency's
+    info = {}
+    assert ShardedGallery._pace_chunk(_Ready(), _time.monotonic() + 0.1,
+                                      info=info)
+    assert "chunk_pacing_timeout" not in info
+    # cancelled wait: returns immediately (doomed snapshot), no flag
+    assert ShardedGallery._pace_chunk(_Never(), _time.monotonic() + 10.0,
+                                      cancel=lambda: True, info=info)
+    assert "chunk_pacing_timeout" not in info
+    # no is_ready: pacing impossible, not degraded — no flag
+    assert not ShardedGallery._pace_chunk(object(), _time.monotonic() + 10.0,
+                                          info=info)
+    assert "chunk_pacing_timeout" not in info
+
+
+def test_gallery_swap_from_casts_store_dtype():
+    """A store_dtype mismatch on swap_from is CAST at install, not
+    rejected: the documented retrain -> reload_gallery handoff stages at
+    the trainer's f32 default while serving defaults to bf16 (round-5
+    advisor). The installed snapshot carries the SERVING gallery's dtype,
+    so compiled cache keys (capacity-keyed) never alias."""
+    mesh = make_mesh(tp=4)
+    serving = ShardedGallery(capacity=16, dim=8, mesh=mesh,
+                             store_dtype=jnp.bfloat16)
+    staged = ShardedGallery(capacity=16, dim=8, mesh=mesh)  # f32 default
+    emb = _unit(RNG.normal(size=(6, 8)).astype(np.float32))
+    staged.add(emb, np.full(6, 3, np.int32))
+    serving.swap_from(staged)
+    assert serving.size == 6
+    assert serving.data.embeddings.dtype == jnp.bfloat16
+    labels, sims, _ = (np.asarray(v) for v in serving.match(emb[:2], k=1))
+    np.testing.assert_array_equal(labels[:, 0], [3, 3])
+    assert (sims[:, 0] > 0.99).all()
+
+
+def test_gallery_load_snapshot_restores_last_known_good():
+    """load_snapshot (the supervisor's restore path): rows added after the
+    snapshot are rolled back, the host mirrors are private copies of the
+    snapshot arrays, and any in-flight async grow is invalidated."""
+    mesh = make_mesh(tp=8)
+    g = ShardedGallery(capacity=8, dim=4, mesh=mesh)
+    emb = _unit(RNG.normal(size=(4, 4)).astype(np.float32))
+    g.add(emb, np.arange(4, dtype=np.int32))
+    snap = g.snapshot()
+    g.add(_unit(RNG.normal(size=(3, 4)).astype(np.float32)),
+          np.full(3, 9, np.int32))
+    assert g.size == 7
+    g.load_snapshot(*snap)
+    assert g.size == 4
+    labels, _, _ = (np.asarray(v) for v in g.match(emb[:2], k=1))
+    np.testing.assert_array_equal(labels[:, 0], [0, 1])
+    # restored mirrors are private: mutating the snapshot can't reach them
+    snap[0][:] = 0.0
+    assert np.linalg.norm(g._host_emb[:4]) > 0
+
+
+def test_chunked_upload_stops_pacing_after_first_timeout():
+    """Hang-mode bound: once one chunk's pacing deadline expires, the
+    remaining chunks are NOT paced — the total stall is one chunk
+    deadline, not chunks * deadline (the final residency wait still gates
+    the publish)."""
+    import jax
+
+    mesh = make_mesh(dp=1, tp=1, devices=jax.devices()[:1])
+    g = ShardedGallery(capacity=32, dim=16, mesh=mesh, async_grow=True)
+    g.CHUNK_UPLOAD_BYTES = 1024  # several chunks at 96 rows
+    calls = []
+
+    def never_ready_pacer(buf, deadline, cancel=None, info=None):
+        calls.append(deadline)
+        if info is not None:
+            info["chunk_pacing_timeout"] = True
+        return False  # every paced chunk "times out"
+
+    g._pace_chunk = never_ready_pacer  # instance attr shadows the static
+    info = {}
+    emb = RNG.normal(size=(96, 16)).astype(np.float32)  # 6 chunks of 16 rows
+    g._chunked_emb_put(emb, info=info)
+    assert len(calls) == 1  # paced once, then gave up for the remainder
+    assert info["chunk_pacing_timeout"] is True

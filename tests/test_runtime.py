@@ -366,9 +366,11 @@ def test_trainer_cnn_gallery_handoff():
     emb = np.array(trainer.model.feature.extract(X[:8]))
     labels, sims, _ = (np.asarray(v) for v in gallery.match(emb, k=1))
     assert (labels[:, 0] == y[:8]).mean() >= 0.9
-    # store_dtype passthrough: a retraining handoff must be able to match
-    # the serving gallery's dtype, or reload_gallery's swap_from rejects it
-    # (the ocvf-recognize default is bf16).
+    # store_dtype handoff: build_gallery defaults to f32 while the
+    # ocvf-recognize serving default is bf16 — swap_from casts the staged
+    # snapshot to the serving width at install (round-5 advisor), so the
+    # documented retrain -> reload_gallery handoff works without the
+    # trainer knowing serving's dtype.
     import jax.numpy as jnp
 
     serving = trainer.build_gallery(X, y, make_mesh(tp=8),
@@ -377,9 +379,13 @@ def test_trainer_cnn_gallery_handoff():
     staged = trainer.build_gallery(X, y, make_mesh(tp=8),
                                    capacity=serving.capacity,
                                    store_dtype=jnp.bfloat16)
-    serving.swap_from(staged)  # must not raise (dtype + capacity match)
-    with pytest.raises(ValueError):
-        serving.swap_from(gallery)  # f32 into bf16: guarded
+    serving.swap_from(staged)  # dtype + capacity match: plain ref swap
+    assert serving.data.embeddings.dtype == jnp.bfloat16
+    serving.swap_from(gallery)  # f32 default into bf16 serving: cast
+    assert serving.data.embeddings.dtype == jnp.bfloat16
+    assert serving.size == gallery.size
+    labels2, _, _ = (np.asarray(v) for v in serving.match(emb, k=1))
+    assert (labels2[:, 0] == y[:8]).mean() >= 0.9
 
 
 def test_trainer_rejects_unknown_model_and_field():
