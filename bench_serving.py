@@ -20,6 +20,14 @@ latency still rises with queueing on top of the tunnel's readback floor
 records a per-frame decomposition separating queue-wait, device dispatch,
 readback, and publish.
 
+The artifact now also carries an ``overlap_comparison`` section — the same
+offered-load ladder driven through the legacy inline-poll serving loop and
+through the overlapped pipeline (readback worker + continuous batching +
+bucketed dispatch) — and ``--smoke`` runs a deterministic fake-backend
+variant (``run_smoke``) that emulates the tunnel's sync-poll floor on CPU
+and writes BENCH_SERVING_smoke.json (also invokable as
+``scripts/bench_serving.py --smoke``).
+
 Run:  PYTHONPATH=. python bench_serving.py [--rates 50 200 500]
 """
 
@@ -79,9 +87,13 @@ def build_pipeline(frame_hw=(256, 256), gallery_size=1024):
     return pipeline, frames
 
 
-def make_service(pipeline, frame_hw, batch_size, flush_ms, inflight_depth):
+def make_service(pipeline, frame_hw, batch_size, flush_ms, inflight_depth,
+                 readback_worker=True, target_latency_ms=None,
+                 bucket_sizes=None):
     from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
-    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        DEFAULT_BUCKET_SIZES, RecognizerService,
+    )
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
     connector = FakeConnector()
@@ -89,6 +101,11 @@ def make_service(pipeline, frame_hw, batch_size, flush_ms, inflight_depth):
         pipeline, connector, batch_size=batch_size, frame_shape=frame_hw,
         flush_timeout=flush_ms / 1e3, inflight_depth=inflight_depth,
         similarity_threshold=0.0, metrics=Metrics(),
+        readback_worker=readback_worker,
+        target_latency_s=(None if target_latency_ms is None
+                          else target_latency_ms / 1e3),
+        bucket_sizes=(DEFAULT_BUCKET_SIZES if bucket_sizes is None
+                      else bucket_sizes),
     )
     return service, connector
 
@@ -188,13 +205,17 @@ def measure_dispatch_quote(pipeline, frames, batch_size, n=20):
 
 def run_mode(pipeline, frames, frame_hw, *, name, batch_size, flush_ms,
              inflight_depth, rates, duration_s, device_ms_quote=None,
-             dispatch_ms_quote=None):
+             dispatch_ms_quote=None, readback_worker=True,
+             target_latency_ms=None, bucket_sizes=None):
     """Drive one serving configuration over the offered rates; fresh
     metrics per rate so each row's decomposition covers that rate only."""
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
     service, connector = make_service(pipeline, frame_hw, batch_size,
-                                      flush_ms, inflight_depth)
+                                      flush_ms, inflight_depth,
+                                      readback_worker=readback_worker,
+                                      target_latency_ms=target_latency_ms,
+                                      bucket_sizes=bucket_sizes)
     service.start(warmup=True)
     rows = []
     try:
@@ -237,9 +258,77 @@ def run_mode(pipeline, frames, frame_hw, *, name, batch_size, flush_ms,
     return {
         "config": {"batch_size": batch_size, "flush_ms": flush_ms,
                    "inflight_depth": inflight_depth,
-                   "frame": list(frame_hw), "duration_s": duration_s},
+                   "frame": list(frame_hw), "duration_s": duration_s,
+                   "readback_worker": readback_worker,
+                   "target_latency_ms": target_latency_ms},
         "rates": rows,
     }
+
+
+# ---- deterministic smoke (fake instant backend; no hardware, no training) ----
+
+
+def run_smoke(out_path="BENCH_SERVING_smoke.json", frames_n=160,
+              rate_hz=200.0, batch_size=8, frame_hw=(64, 64),
+              sync_poll_floor_s=0.1, compute_s=0.002,
+              modes=("overlapped", "legacy_poll"), write=True):
+    """Fast, deterministic serving-loop perf check over the fake instant
+    backend (``runtime.fakes.InstantPipeline``): the "device" completes a
+    batch in ``compute_s`` but charges ``sync_poll_floor_s`` on every
+    ``is_ready`` call — the tunneled backend's ~100 ms sync-poll readback
+    floor, reproduced on CPU. The legacy inline-drain path pays that floor
+    on the serving thread; the overlapped readback worker blocks on the
+    array instead and never polls a healthy readback, so its ``ready_wait``
+    p50 must sit far below the floor with zero drops (the tier-1 perf-smoke
+    assertion, tests/test_serving_perf.py). Writes a machine-readable
+    artifact to ``out_path``.
+    """
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    frames = [np.zeros(frame_hw, np.float32)]
+    duration_s = frames_n / rate_hz
+    results = {}
+    for mode in modes:
+        worker = mode == "overlapped"
+        pipeline = InstantPipeline(frame_hw, compute_s=compute_s,
+                                   sync_poll_floor_s=sync_poll_floor_s)
+        connector = FakeConnector()
+        service = RecognizerService(
+            pipeline, connector, batch_size=batch_size, frame_shape=frame_hw,
+            flush_timeout=0.05, inflight_depth=4, similarity_threshold=0.0,
+            metrics=Metrics(), readback_worker=worker,
+            target_latency_s=0.03 if worker else None,
+        )
+        service.start(warmup=False)  # the fake backend has nothing to compile
+        try:
+            stats = drive_rate(service, connector, frames, rate_hz, duration_s)
+        finally:
+            service.drain(timeout=60.0)
+            service.stop()
+        stats["batches"] = int(service.metrics.counter("batches_dispatched"))
+        results[mode] = stats
+    artifact = {
+        "note": ("fake instant backend (runtime.fakes.InstantPipeline): "
+                 f"compute {compute_s * 1e3:g} ms/batch, is_ready sync-poll "
+                 f"cost {sync_poll_floor_s * 1e3:g} ms — the tunnel's "
+                 "readback floor emulated on CPU. 'overlapped' = readback "
+                 "worker (event-driven block) + continuous batching; "
+                 "'legacy_poll' = the pre-worker inline is_ready drain. "
+                 "ready_wait_p50_ms carries the floor in legacy mode only."),
+        "config": {"frames": frames_n, "offered_hz": rate_hz,
+                   "batch_size": batch_size, "frame": list(frame_hw),
+                   "sync_poll_floor_ms": sync_poll_floor_s * 1e3,
+                   "compute_ms": compute_s * 1e3},
+        "modes": results,
+    }
+    if write:
+        with open(out_path, "w") as fh:
+            json.dump(artifact, fh, indent=2)
+        print(f"wrote {out_path}", file=sys.stderr)
+    return artifact
 
 
 def main(argv=None):
@@ -255,7 +344,30 @@ def main(argv=None):
     parser.add_argument("--latency-rates", type=float, nargs="+",
                         default=[25.0, 50.0])
     parser.add_argument("--skip-latency-mode", action="store_true")
+    parser.add_argument("--compare-rates", type=float, nargs="+",
+                        default=[25.0],
+                        help="offered rates for the legacy-vs-overlapped "
+                             "before/after section")
+    parser.add_argument("--skip-compare", action="store_true")
+    parser.add_argument("--smoke", action="store_true",
+                        help="deterministic serving-loop smoke over the fake "
+                             "instant backend only (no hardware, no detector "
+                             "training); writes BENCH_SERVING_smoke.json and "
+                             "exits")
     args = parser.parse_args(argv)
+
+    if args.smoke:
+        artifact = run_smoke()
+        legacy = artifact["modes"].get("legacy_poll", {})
+        overlap = artifact["modes"].get("overlapped", {})
+        print(json.dumps({
+            "legacy_e2e_p50_ms": legacy.get("e2e_p50_ms"),
+            "overlapped_e2e_p50_ms": overlap.get("e2e_p50_ms"),
+            "overlapped_ready_wait_p50_ms": overlap.get(
+                "decomposition_ms", {}).get("ready_wait_p50_ms"),
+            "overlapped_dropped": overlap.get("dropped_frames"),
+        }))
+        return 0
 
     import jax
 
@@ -286,6 +398,59 @@ def main(argv=None):
         batch_size=args.batch_size, flush_ms=args.flush_ms,
         inflight_depth=4, rates=args.rates, duration_s=args.duration,
     )
+    if not args.skip_compare:
+        # Before/after on the SAME offered-load ladder: "legacy" is the
+        # pre-worker serving loop (inline is_ready drain on the serving
+        # thread, fixed flush window, no dispatch buckets); "overlapped"
+        # is the event-driven readback worker + continuous batching
+        # (adaptive deadline against a 50 ms target) + the bucket ladder.
+        # queue_wait + ready_wait in each row's decomposition_ms show
+        # where the difference lands.
+        legacy = run_mode(
+            pipeline, frames, frame_hw, name="compare/legacy",
+            batch_size=args.batch_size, flush_ms=args.flush_ms,
+            inflight_depth=4, rates=args.compare_rates,
+            duration_s=args.duration, readback_worker=False,
+            bucket_sizes=(),
+        )
+        overlapped = run_mode(
+            pipeline, frames, frame_hw, name="compare/overlapped",
+            batch_size=args.batch_size, flush_ms=args.flush_ms,
+            inflight_depth=4, rates=args.compare_rates,
+            duration_s=args.duration, readback_worker=True,
+            target_latency_ms=50.0,
+        )
+        speedups = {}
+        for before, after in zip(legacy["rates"], overlapped["rates"]):
+            b, a = before.get("e2e_p50_ms"), after.get("e2e_p50_ms")
+            if b and a:
+                speedups[str(before["offered_hz"])] = round(b / a, 2)
+        sections["overlap_comparison"] = {
+            "note": ("same offered-load ladder; legacy = inline poll drain "
+                     "+ fixed flush, overlapped = readback worker + "
+                     "adaptive-deadline continuous batching + bucketed "
+                     "dispatch. Caveat for CPU-backend runs: the device "
+                     "itself saturates (ready_wait is real compute), so "
+                     "e2e stays compute-bound for BOTH modes and the win "
+                     "shows up as completed-frame throughput and "
+                     "queue_wait instead; the overlap_comparison_smoke "
+                     "section isolates the serving-loop overheads "
+                     "deterministically with the tunnel's ~100 ms "
+                     "sync-poll floor emulated."),
+            "legacy_poll": legacy,
+            "overlapped": overlapped,
+            "e2e_p50_speedup": speedups,
+        }
+        # The deterministic loop-overhead comparison (fake instant backend
+        # with the tunnel's sync-poll floor emulated): same artifact, so
+        # the before/after verdict travels with the hardware rows.
+        smoke = run_smoke(write=True)
+        s_legacy = smoke["modes"].get("legacy_poll", {})
+        s_over = smoke["modes"].get("overlapped", {})
+        if s_legacy.get("e2e_p50_ms") and s_over.get("e2e_p50_ms"):
+            smoke["e2e_p50_speedup"] = round(
+                s_legacy["e2e_p50_ms"] / s_over["e2e_p50_ms"], 2)
+        sections["overlap_comparison_smoke"] = smoke
     if not args.skip_latency_mode:
         # Latency mode (VERDICT round-2 item #3): small batches, short
         # flush, shallow in-flight queue — the configuration an operator

@@ -55,7 +55,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "flip after scripts/bench_sepblock.py measures a "
                         "win on your chip)")
     p.add_argument("--batch-size", type=int, default=8)
-    p.add_argument("--flush-ms", type=float, default=30.0)
+    p.add_argument("--flush-ms", type=float, default=30.0,
+                   help="max age of the oldest buffered frame before a "
+                        "partial batch flushes; with --target-latency-ms "
+                        "this is the CAP of the adaptive deadline")
+    # ---- overlapped serving pipeline (runtime.recognizer docstring) ----
+    p.add_argument("--target-latency-ms", type=float, default=None,
+                   help="continuous-batching latency target: a partial "
+                        "batch waits only target minus the EWMA of the "
+                        "measured downstream service time (clamped to "
+                        "[2 ms, --flush-ms]) instead of the fixed flush "
+                        "window — trickle load stops paying the full "
+                        "--flush-ms of batching delay")
+    p.add_argument("--bucket-sizes", type=int, nargs="+",
+                   default=[8, 32, 128], metavar="B",
+                   help="dispatch bucket ladder: a partial batch is sliced "
+                        "to the smallest bucket >= its real frame count "
+                        "(every bucket is compiled at warmup, so partial "
+                        "batches never recompile); 0 disables slicing")
+    p.add_argument("--no-readback-worker", action="store_true",
+                   help="fall back to the pre-worker serving loop that "
+                        "drains readbacks inline with is_ready polling "
+                        "(the two --*-poll-ms knobs) instead of the "
+                        "event-driven readback worker thread")
+    p.add_argument("--readback-poll-ms", type=float, default=5.0,
+                   help="fallback-path poll interval while waiting out an "
+                        "over-depth/forced readback (only used with "
+                        "--no-readback-worker, or for a proxy readback "
+                        "that cannot be blocked on)")
+    p.add_argument("--drain-poll-ms", type=float, default=50.0,
+                   help="completion-wait tick: how often drain() and the "
+                        "fallback path re-check for finished work")
     p.add_argument("--transfer-uint8", action="store_true",
                    help="buffer and ship frames host->device as uint8 "
                         "(4x less transfer volume; cast to f32 happens on "
@@ -215,6 +245,12 @@ def main(argv=None) -> int:
         subject_names=names,
         metrics=metrics,
         transfer_dtype=np.uint8 if args.transfer_uint8 else np.float32,
+        readback_worker=not args.no_readback_worker,
+        readback_poll_s=args.readback_poll_ms / 1e3,
+        drain_poll_s=args.drain_poll_ms / 1e3,
+        bucket_sizes=tuple(b for b in args.bucket_sizes if b > 0),
+        target_latency_s=(None if args.target_latency_ms is None
+                          else args.target_latency_ms / 1e3),
         resilience=ResiliencePolicy(
             dispatch_retries=args.dispatch_retries,
             readback_deadline_s=args.readback_deadline,

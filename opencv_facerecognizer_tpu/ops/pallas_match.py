@@ -65,20 +65,26 @@ def _match_kernel(q_ref, g_ref, valid_ref, vals_ref, idx_ref, *, k: int,
 
     cand_vals = jnp.concatenate([vals_ref[:], s], axis=1)  # [BQ, k+BN]
     cand_idx = jnp.concatenate([idx_ref[:], col], axis=1)
-    pos = jax.lax.broadcasted_iota(jnp.int32, cand_vals.shape, 1)
     new_vals, new_idx = [], []
     for _ in range(k):  # k is small and static: unrolled VPU max-extracts
         best = jnp.max(cand_vals, axis=1, keepdims=True)  # [BQ, 1]
-        am = jnp.argmax(cand_vals, axis=1)  # [BQ]
-        hit = pos == am[:, None]  # first-max one-hot
-        best_idx = jnp.sum(jnp.where(hit, cand_idx, 0), axis=1,
-                           keepdims=True)  # [BQ, 1]
-        # Sentinel from the VALUE, never from argmax tie-breaking: when all
-        # remaining candidates are masked (-1e30), the compiled TPU argmax
-        # picks an unspecified position (measured: a real column index,
-        # where interpret mode picked 0) — so a slot whose best is the mask
-        # value must emit index -1 explicitly. Real sims are cosine-scale;
-        # half the mask magnitude separates them unambiguously.
+        # Deterministic tie-breaking: among candidates at the max value,
+        # take the LOWEST gallery index (the running accumulator carries
+        # earlier tiles' global indices, so this holds across the whole
+        # streamed gallery and matches lax.top_k / a stable argsort — the
+        # compiled TPU argmax used before picked an unspecified tied
+        # position, measured as idx-parity 0.69 vs XLA on tie-heavy
+        # galleries with |sim diff| exactly 0).
+        masked_idx = jnp.where(cand_vals == best, cand_idx,
+                               jnp.int32(2**31 - 1))
+        best_idx = jnp.min(masked_idx, axis=1, keepdims=True)  # [BQ, 1]
+        hit = (cand_vals == best) & (cand_idx == best_idx)
+        # Sentinel from the VALUE, never from tie-breaking: when all
+        # remaining candidates are masked (-1e30), the winner above is
+        # whatever index rode the mask value — so a slot whose best is the
+        # mask value must emit index -1 explicitly. Real sims are
+        # cosine-scale; half the mask magnitude separates them
+        # unambiguously.
         best_idx = jnp.where(best > NEG_INF * 0.5, best_idx, -1)
         new_vals.append(best)
         new_idx.append(best_idx)
@@ -96,7 +102,9 @@ def streaming_match_topk(q, g, valid, *, k: int = 1, block_q: int = 128,
 
     q [Q, D] float; g [N, D] float; valid [N] bool/0-1 mask.
     Returns (sims [Q, k] f32, indices [Q, k] int32); invalid rows never
-    surface. When fewer than k valid rows exist, the empty slots carry
+    surface. Equal similarities break toward the LOWEST gallery index —
+    the same order as ``lax.top_k`` and a stable argsort — so parity with
+    the XLA matcher is exact even on tie-heavy (duplicate-row) galleries. When fewer than k valid rows exist, the empty slots carry
     sim -1e30 and the explicit sentinel index **-1** (derived from the
     value in-kernel, so it holds in compiled mode too) — callers gathering
     labels must mask ``idx < 0`` (see ``parallel.gallery``). Q and N are

@@ -253,6 +253,25 @@ class RecognitionPipeline:
             frames,
         )
 
+    def prewarm_batch_shapes(self, batch_sizes, frame_shape,
+                             dtype=np.float32) -> int:
+        """Compile the packed serving step for every dispatch-bucket size
+        up front (RecognizerService.warmup calls this with its bucket
+        ladder): the whole point of the fixed ladder is that a partial
+        batch sliced to ANY bucket finds a warm executable in
+        ``_packed_cache`` instead of paying a mid-serving XLA compile.
+        Each size is executed once on zero frames and blocked on, exactly
+        like ``prewarm_capacity`` does for future gallery tiers. Returns
+        the number of sizes compiled."""
+        built = 0
+        for b in sorted({int(x) for x in batch_sizes}):
+            zeros = np.zeros((b, *tuple(frame_shape)), dtype)
+            out = self.recognize_batch_packed(zeros)
+            if hasattr(out, "block_until_ready"):
+                out.block_until_ready()
+            built += 1
+        return built
+
     def prewarm_capacity(self, capacity: int) -> None:
         """Compile this pipeline's step(s) for a FUTURE gallery capacity.
 

@@ -6,13 +6,34 @@ one real concurrency point, so it is small, locked, and directly tested).
 Semantics:
 - ``put`` validates shape/dtype and drops malformed frames (SURVEY.md §5.3
   graceful skip) — a camera glitch must not poison a whole batch.
-- ``get_batch`` blocks until ``batch_size`` frames are buffered OR
-  ``flush_timeout`` has elapsed since the oldest undelivered frame, then
-  returns a zero-padded [B, H, W] batch plus the metadata list and real
-  count. Fixed B keeps XLA from recompiling (static shapes); padding lanes
-  are dead weight the TPU shrugs off.
+- ``get_batch`` implements **continuous batching**: it blocks until
+  ``batch_size`` frames are buffered OR the oldest undelivered frame's age
+  reaches the current flush deadline, then returns a zero-padded [B, H, W]
+  batch plus the metadata list and real count. The deadline is either the
+  fixed ``flush_timeout`` (legacy mode) or, with ``target_latency_s`` set,
+  **adaptive**: the remaining per-frame latency budget after subtracting an
+  EWMA of the downstream service time the consumer reports via
+  ``report_service_time`` — under trickle load a batch waits only as long
+  as the end-to-end target can afford, never a fixed window. Fixed B keeps
+  XLA from recompiling (static shapes); padding lanes are dead weight the
+  TPU shrugs off (partial batches can additionally be *sliced* down to a
+  bucket ladder by the consumer — see RecognizerService).
 - Bounded queue: beyond ``max_pending`` the OLDEST frames drop first — a
   live recognizer wants fresh frames, not a growing latency debt.
+- **Buffer pool**: the [B, H, W] staging array a batch rides in can be
+  handed back via ``recycle`` once the consumer is done with it (after the
+  batch's readback completed — the host-side analog of a donated input
+  buffer). Steady-state batching then does zero per-batch allocations;
+  consumers that never recycle just get a fresh array each time, exactly
+  the old behavior. A recycled buffer's padding lanes are re-zeroed before
+  reuse.
+
+Coalescing stats ride the shared ``Metrics`` surface so tests can reconcile
+them exactly: ``batcher_frames_offered`` (every ``put`` attempt) equals
+frames batched + malformed drops + overflow drops + closed drops + pending.
+``batcher_batches_size`` / ``batcher_batches_deadline`` split batches by
+what triggered the flush; ``batcher_flush_deadline_ms`` is a gauge of the
+current (possibly adaptive) deadline.
 """
 
 from __future__ import annotations
@@ -44,13 +65,27 @@ class FrameBatcher:
         flush_timeout: float = 0.05,
         max_pending: int = 256,
         dtype=np.float32,
-        # Shared Metrics mirror of the drop counters (None = stats-only):
-        # the chaos/connector tests assert drops through ONE metrics
-        # surface instead of poking per-component attributes.
+        # Shared Metrics mirror of the drop/coalescing counters (None =
+        # stats-only): the chaos/connector/batching tests assert through
+        # ONE metrics surface instead of poking per-component attributes.
         metrics=None,
         # Chaos hook (runtime.faults): may poison a frame before the
         # shape/dtype validation that must then drop it.
         fault_injector=None,
+        # Continuous-batching target: when set, the flush deadline adapts
+        # to ``target_latency_s - EWMA(downstream service time)`` instead
+        # of the fixed flush_timeout (which then acts as the CAP). The
+        # consumer feeds the EWMA via report_service_time after each
+        # batch completes end-to-end.
+        target_latency_s: Optional[float] = None,
+        # Floor of the adaptive deadline: even with no latency budget left
+        # a flush waits this long so back-to-back frames still coalesce.
+        min_deadline_s: float = 0.002,
+        # EWMA smoothing for the reported service time.
+        service_time_alpha: float = 0.2,
+        # Staging buffers kept for reuse (recycle); ~inflight_depth + the
+        # batch being formed is plenty.
+        buffer_pool_size: int = 8,
     ):
         self.batch_size = int(batch_size)
         self.frame_shape = tuple(frame_shape)
@@ -61,18 +96,29 @@ class FrameBatcher:
         self.dtype = np.dtype(dtype)
         self.metrics = metrics
         self._faults = fault_injector
+        self.target_latency_s = (None if target_latency_s is None
+                                 else float(target_latency_s))
+        self.min_deadline_s = float(min_deadline_s)
+        self._alpha = float(service_time_alpha)
+        self._service_time_ewma: Optional[float] = None
+        self._pool_cap = int(buffer_pool_size)
+        self._buffer_pool: List[np.ndarray] = []
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._frames: deque = deque()
         self._dropped_malformed = 0
         self._dropped_overflow = 0
         self._delivered = 0
+        self._batches_size = 0
+        self._batches_deadline = 0
         self._closed = False
 
     # ---- producer side ----
 
     def put(self, frame: np.ndarray, meta: Any = None) -> bool:
         """Enqueue one frame; returns False when dropped (malformed/closed)."""
+        if self.metrics is not None:
+            self.metrics.incr("batcher_frames_offered")
         if self._faults is not None:
             frame = self._faults.on_put(frame)
         frame = np.asarray(frame)
@@ -84,6 +130,8 @@ class FrameBatcher:
             return False
         with self._not_empty:
             if self._closed:
+                if self.metrics is not None:
+                    self.metrics.incr("batcher_dropped_closed")
                 return False
             if len(self._frames) >= self.max_pending:
                 self._frames.popleft()  # drop oldest: freshness over backlog
@@ -106,6 +154,49 @@ class FrameBatcher:
             self._closed = True
             self._not_empty.notify_all()
 
+    # ---- adaptive deadline (continuous batching) ----
+
+    def report_service_time(self, seconds: float) -> None:
+        """Feed one batch's downstream time (pop -> published) into the
+        EWMA the adaptive flush deadline subtracts from the latency target.
+        Cheap and lock-free on purpose: a float store is atomic in CPython,
+        and the deadline only needs a recent estimate, not a serialized
+        one."""
+        if seconds < 0:
+            return
+        prev = self._service_time_ewma
+        self._service_time_ewma = (seconds if prev is None
+                                   else prev + self._alpha * (seconds - prev))
+
+    def current_flush_deadline(self) -> float:
+        """Seconds the oldest frame may age before a partial batch flushes.
+        Fixed ``flush_timeout`` without a latency target; with one, the
+        remaining budget after the estimated downstream service time,
+        clamped to [min_deadline_s, flush_timeout]."""
+        if self.target_latency_s is None:
+            return self.flush_timeout
+        est = self._service_time_ewma or 0.0
+        deadline = min(self.flush_timeout,
+                       max(self.min_deadline_s, self.target_latency_s - est))
+        if self.metrics is not None:
+            self.metrics.set_gauge("batcher_flush_deadline_ms", deadline * 1e3)
+        return deadline
+
+    # ---- buffer pool (host-side donated staging) ----
+
+    def recycle(self, buf: np.ndarray) -> None:
+        """Return a batch's staging array for reuse once the consumer is
+        completely done with it (readback finished, no views kept — crops
+        must be copied out first). Wrong shape/dtype or a full pool just
+        drops it; never an error."""
+        if (not isinstance(buf, np.ndarray)
+                or buf.shape != (self.batch_size, *self.frame_shape)
+                or buf.dtype != self.dtype):
+            return
+        with self._lock:
+            if len(self._buffer_pool) < self._pool_cap:
+                self._buffer_pool.append(buf)
+
     # ---- consumer side ----
 
     def get_batch(self, block: bool = True) -> Optional[Batch]:
@@ -117,12 +208,13 @@ class FrameBatcher:
                 if n >= self.batch_size:
                     break
                 if n > 0:
+                    deadline = self.current_flush_deadline()
                     age = time.monotonic() - self._frames[0][2]
-                    if age >= self.flush_timeout:
+                    if age >= deadline:
                         break
                     if not block:
                         return None
-                    self._not_empty.wait(timeout=self.flush_timeout - age)
+                    self._not_empty.wait(timeout=deadline - age)
                     continue
                 if self._closed:
                     return None
@@ -130,17 +222,33 @@ class FrameBatcher:
                     return None
                 self._not_empty.wait(timeout=self.flush_timeout)
                 if not self._frames:
-                    # Idle tick: give the caller a turn (the serving loop
-                    # drains its in-flight readback queue on None).
+                    # Idle tick: give the caller a turn (the fallback
+                    # serving loop drains its in-flight queue on None).
                     return None
             count = min(len(self._frames), self.batch_size)
+            full = count >= self.batch_size
             items = [self._frames.popleft() for _ in range(count)]
             # Counted under the lock, atomically with the pop: consumers
             # (RecognizerService.drain) compare this against their own
             # completion count, so a popped-but-not-yet-dispatched batch is
             # never invisible to both ``pending`` and the in-flight queue.
             self._delivered += 1
-        frames = np.zeros((self.batch_size, *self.frame_shape), dtype=self.dtype)
+            if full:
+                self._batches_size += 1
+            else:
+                self._batches_deadline += 1
+            buf = self._buffer_pool.pop() if self._buffer_pool else None
+        if self.metrics is not None:
+            self.metrics.incr("batcher_batches_size" if full
+                              else "batcher_batches_deadline")
+            self.metrics.incr("batcher_frames_batched", count)
+            if buf is not None:
+                self.metrics.incr("batcher_buffer_reuse")
+        if buf is None:
+            frames = np.zeros((self.batch_size, *self.frame_shape), dtype=self.dtype)
+        else:
+            frames = buf
+            frames[count:] = 0  # re-zero a reused buffer's padding lanes
         metas: List[Any] = [None] * self.batch_size
         enqueue_ts: List[float] = []
         for i, (frame, meta, ts) in enumerate(items):
@@ -168,4 +276,6 @@ class FrameBatcher:
                 "pending": len(self._frames),
                 "dropped_malformed": self._dropped_malformed,
                 "dropped_overflow": self._dropped_overflow,
+                "batches_size": self._batches_size,
+                "batches_deadline": self._batches_deadline,
             }

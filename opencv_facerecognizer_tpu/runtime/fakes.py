@@ -1,0 +1,104 @@
+"""Fake serving backends for deterministic perf tests and smoke benches.
+
+``InstantPipeline`` stands in for ``RecognitionPipeline`` in front of
+``RecognizerService``: dispatch returns immediately with a packed result
+array whose "device" behavior is scripted — optionally a simulated compute
+delay before readiness, and optionally a **sync-poll cost** charged on
+every ``is_ready`` call (the tunneled backend's ~100 ms readback floor,
+reproduced on CPU). That makes the serving loop's host-side overheads —
+batching delay, poll sleeps vs event-driven readback, publish — measurable
+in isolation, fast, and deterministic: the tier-1 perf smoke asserts the
+overlapped readback worker keeps ``ready_wait`` off the poll floor without
+needing real hardware (see ``bench_serving.run_smoke`` and
+``tests/test_serving_perf.py``).
+
+No recognition happens: every frame comes back with zero detected faces,
+which is exactly what the loop-perf surfaces need (results still publish
+per frame, so end-to-end latency is real).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class FakePacked:
+    """A packed-result device array stand-in with scripted readiness.
+
+    ``is_ready`` reports completion of the simulated compute (charging
+    ``poll_cost_s`` per call — the sync-poll floor); ``block_until_ready``
+    sleeps exactly the remaining compute time (the event-driven wait);
+    ``__array__`` materializes after blocking.
+    """
+
+    def __init__(self, arr: np.ndarray, ready_at: float,
+                 poll_cost_s: float = 0.0):
+        self._arr = arr
+        self._ready_at = ready_at
+        self._poll_cost_s = float(poll_cost_s)
+
+    def is_ready(self) -> bool:
+        if self._poll_cost_s > 0.0:
+            time.sleep(self._poll_cost_s)
+        return time.monotonic() >= self._ready_at
+
+    def block_until_ready(self) -> "FakePacked":
+        delay = self._ready_at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        return self
+
+    def copy_to_host_async(self) -> None:
+        pass
+
+    def __array__(self, dtype=None):
+        self.block_until_ready()
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+
+class _GalleryStub:
+    size = 0
+    grow_count = 0
+
+
+class InstantPipeline:
+    """Drop-in pipeline for RecognizerService with scripted device timing.
+
+    ``compute_s`` — seconds after dispatch until the batch's readback is
+    ready (simulated device compute + D2H). ``sync_poll_floor_s`` — cost
+    charged on EVERY ``is_ready`` call, emulating the tunneled backend's
+    sync-poll readback floor: the legacy inline-drain path pays it on the
+    serving thread per check, while the readback worker's event-driven
+    ``block_until_ready`` never does.
+    """
+
+    def __init__(self, frame_shape: Tuple[int, int], top_k: int = 1,
+                 max_faces: int = 2, compute_s: float = 0.0,
+                 sync_poll_floor_s: float = 0.0):
+        self.frame_shape = tuple(frame_shape)
+        self.top_k = int(top_k)
+        self.max_faces = int(max_faces)
+        self.compute_s = float(compute_s)
+        self.sync_poll_floor_s = float(sync_poll_floor_s)
+        self.face_size = (8, 8)
+        self.gallery = _GalleryStub()
+        self.fault_injector = None
+        self.dispatches = 0
+        #: batch dimension of every dispatch, in order — lets tests assert
+        #: the service's bucket ladder sliced partial batches as designed.
+        self.batch_sizes_seen: list = []
+
+    def recognize_batch_packed(self, frames) -> FakePacked:
+        if self.fault_injector is not None:
+            self.fault_injector.on_dispatch()
+        self.dispatches += 1
+        b = int(np.asarray(frames).shape[0])
+        self.batch_sizes_seen.append(b)
+        # pack_result layout: boxes(4) | det_score | valid | labels(k) |
+        # sims(k); valid=0 everywhere -> zero faces per frame.
+        packed = np.zeros((b, self.max_faces, 6 + 2 * self.top_k), np.float32)
+        return FakePacked(packed, time.monotonic() + self.compute_s,
+                          poll_cost_s=self.sync_poll_floor_s)

@@ -11,7 +11,10 @@ each one has a distinct observed failure mode on this box (see
 - **device dispatch** — the backend fast-fails (``UNAVAILABLE`` at call
   time: the tunnel's mode-1 outage);
 - **async readback** — a dispatched batch's device->host transfer never
-  completes (``is_ready`` stays False forever: the tunnel's mode-2 hang).
+  completes (``stuck``: ``is_ready`` stays False forever, the tunnel's
+  mode-2 hang) or completes late (``slow``: ready only after
+  ``slow_readback_s`` — the congested-but-alive shape the overlapped
+  readback worker must pipeline behind, not stall on).
 
 ``FaultInjector`` installs at all four. Faults are either **scripted**
 (``script("dispatch", "unavailable", "unavailable")`` — consumed in order,
@@ -29,6 +32,7 @@ never require one to be installed.
 from __future__ import annotations
 
 import random
+import time
 from collections import Counter, deque
 from typing import Any, Dict, List, Optional
 
@@ -39,7 +43,7 @@ BOUNDARIES: Dict[str, tuple] = {
     "receive": ("drop", "duplicate", "corrupt"),
     "put": ("corrupt",),
     "dispatch": ("unavailable",),
-    "readback": ("stuck",),
+    "readback": ("stuck", "slow"),
 }
 
 
@@ -76,6 +80,40 @@ class StuckReadback:
                            "drain loop must dead-letter it at the deadline")
 
 
+class SlowReadback:
+    """Wraps a dispatched device array whose transfer completes only after
+    ``delay_s`` — the degraded-but-alive readback shape (a congested
+    tunnel, not an outage). ``is_ready`` turns True at the deadline;
+    ``block_until_ready`` sleeps out the remainder (so the event-driven
+    readback worker waits exactly the injected delay); materializing
+    blocks the same way. Lets tests pin pipelining behavior — batches
+    dispatched behind a slow head must still overlap — with deterministic
+    timing and no real device. (``runtime.fakes.FakePacked`` is the
+    sibling shape for whole-pipeline fakes; this one wraps a REAL
+    dispatched array, so the chaos layer stays free of test-fake
+    imports.)"""
+
+    def __init__(self, wrapped: Any, delay_s: float):
+        self._wrapped = wrapped
+        self._ready_at = time.monotonic() + float(delay_s)
+
+    def is_ready(self) -> bool:
+        return time.monotonic() >= self._ready_at
+
+    def copy_to_host_async(self) -> None:
+        pass
+
+    def block_until_ready(self):
+        delay = self._ready_at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        return self._wrapped
+
+    def __array__(self, dtype=None):
+        self.block_until_ready()
+        return np.asarray(self._wrapped, dtype=dtype)
+
+
 class FaultInjector:
     """Deterministic, seedable fault injection for the serving loop.
 
@@ -87,9 +125,12 @@ class FaultInjector:
     """
 
     def __init__(self, seed: int = 0,
-                 rates: Optional[Dict[str, Dict[str, float]]] = None):
+                 rates: Optional[Dict[str, Dict[str, float]]] = None,
+                 slow_readback_s: float = 0.05):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
+        #: injected transfer latency of a ``readback: slow`` fault.
+        self.slow_readback_s = float(slow_readback_s)
         self.rates = rates or {}
         for boundary, fault_rates in self.rates.items():
             unknown = set(fault_rates) - set(BOUNDARIES.get(boundary, ()))
@@ -173,9 +214,14 @@ class FaultInjector:
 
     def on_readback(self, device_array: Any) -> Any:
         """Async-readback boundary: wraps the dispatched output in a
-        never-ready proxy (hang-mode outage)."""
-        if self._draw("readback") is None:
+        never-ready proxy (``stuck`` — the hang-mode outage) or a
+        delayed-ready one (``slow`` — ``slow_readback_s`` of injected
+        transfer latency)."""
+        fault = self._draw("readback")
+        if fault is None:
             return device_array
+        if fault == "slow":
+            return SlowReadback(device_array, self.slow_readback_s)
         return StuckReadback(device_array)
 
     def summary(self) -> Dict[str, int]:

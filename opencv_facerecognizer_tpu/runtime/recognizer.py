@@ -2,18 +2,37 @@
 (SURVEY.md §3.3: "enqueue frame -> batcher -> one sharded
 detect->align->embed->match call per batch").
 
-Flow: connector frames -> FrameBatcher -> RecognitionPipeline (one fused
-device call per batch) -> async-readback queue -> result messages on the
-connector.
+Flow: connector frames -> FrameBatcher (continuous batching) ->
+RecognitionPipeline (one fused device call per batch, sliced to a bucket
+of the dispatch ladder) -> in-flight queue -> **readback worker** -> result
+messages on the connector.
 
-Two hard-won design points (both measured on this box, see
-parallel/gallery.py for the sibling finding):
-- **Never block on device results in the loop.** On the axon backend the
-  first synchronous device->host readback drops the process into a ~100 ms
-  poll mode. The service therefore dispatches a batch, calls
-  ``copy_to_host_async`` on the outputs, parks them in an in-flight queue,
-  and only materializes results whose transfer already completed
-  (``is_ready``) — the host pipeline SURVEY.md §7 called for.
+Three hard-won design points (see parallel/gallery.py for a sibling
+finding):
+
+- **The serving loop never waits on device results.** On the axon backend
+  the first synchronous device->host readback drops the process into a
+  ~100 ms poll mode, and even ``is_ready`` polling quantizes the loop to
+  that floor. The service therefore dispatches a batch, calls
+  ``copy_to_host_async`` on the output, parks it in the in-flight queue,
+  and a dedicated **readback worker thread** blocks on each batch's device
+  array (event-driven ``block_until_ready``, via a sacrificial blocker
+  thread so the wait stays bounded by the per-batch deadline) and runs the
+  publish path. Dispatch, D2H, and publish overlap; ``inflight_depth``
+  slots actually pipeline. The pre-worker inline path survives as
+  ``readback_worker=False`` (the fallback non-threaded mode) with its
+  two poll sleeps promoted to the named knobs ``readback_poll_s`` /
+  ``drain_poll_s``.
+- **Bucketed dispatch cache**: a partial batch is sliced down to the
+  smallest size in a fixed ``bucket_sizes`` ladder (default 8/32/128,
+  filtered to the mesh's dp divisibility and capped at ``batch_size``)
+  instead of always padding to the full batch. Every ladder size is
+  compiled at ``warmup()``, so partial batches never trigger recompiles,
+  and the staging array each batch rides in is recycled back to the
+  batcher's buffer pool once its readback completes (the host-side analog
+  of a donated input buffer: steady-state dispatch does zero per-batch
+  allocations. True XLA buffer donation does not apply here — the inputs
+  are host numpy arrays, which jit copies rather than aliases).
 - **Reload without drop** (SURVEY.md §5.3): retraining builds a NEW gallery
   (or pipeline) off-thread; ``reload_gallery`` swaps the reference between
   batches. In-flight batches keep the arrays they captured.
@@ -26,13 +45,16 @@ Steady-state failure handling (the round-4 outage, generalized — see
 ``runtime.resilience``): a dispatch failure retries with exponential
 backoff (transient/outage-shaped errors only; a poisoned batch is abandoned
 immediately), a readback that outlives its per-batch deadline is
-dead-lettered and the loop keeps serving, and N consecutive dispatch
-failures flip the service into degraded mode with a ``STATUS_TOPIC``
-announcement (optionally probing the backend via ``utils.backend_probe``
-and invoking a CPU-fallback hook when it is dead). A crash that escapes the
-loop body sets ``loop_crashed`` for ``resilience.ServiceSupervisor`` to
-restart with the last-known-good gallery. ``runtime.faults.FaultInjector``
-installs at every one of these boundaries to make the whole story testable.
+dead-lettered **by the readback worker** and the loop keeps serving, and N
+consecutive dispatch failures flip the service into degraded mode with a
+``STATUS_TOPIC`` announcement (optionally probing the backend via
+``utils.backend_probe`` and invoking a CPU-fallback hook when it is dead).
+A crash that escapes either serving-side thread (the dispatch loop or the
+readback worker) sets ``loop_crashed`` for ``resilience.ServiceSupervisor``
+to restart with the last-known-good gallery; each crash path settles its
+own batch accounting first, so ``drain()`` stays solvable after a restart.
+``runtime.faults.FaultInjector`` installs at every one of these boundaries
+to make the whole story testable.
 """
 
 from __future__ import annotations
@@ -42,7 +64,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -63,12 +85,74 @@ RESULT_TOPIC = "ocvfacerec/results"
 CONTROL_TOPIC = "ocvfacerec/control"
 STATUS_TOPIC = "ocvfacerec/status"
 
+#: Fallback-path readback poll: with ``readback_worker=False`` the inline
+#: drain waits for an over-depth/forced head batch by sleeping this long
+#: between ``is_ready`` checks (the threaded worker never polls a healthy
+#: readback — it blocks on the array). Also the worker's bounded-poll
+#: interval for a proxy that refuses to block (injected stuck readback).
+FALLBACK_READBACK_POLL_S = 0.005
+#: Completion-wait tick: ``drain()``'s condition re-check interval, and the
+#: upper bound between liveness re-checks of the worker's condition waits.
+#: Only the fallback non-threaded path actually sleeps this blindly.
+FALLBACK_DRAIN_POLL_S = 0.05
+#: Dispatch bucket ladder (capped at ``batch_size``, filtered to the mesh's
+#: dp divisibility): a partial batch is sliced to the smallest bucket >= its
+#: real frame count, so light traffic pays small-batch compute without ever
+#: compiling a new shape mid-serving.
+DEFAULT_BUCKET_SIZES = (8, 32, 128)
+
 
 @dataclass
 class _Enrolment:
     subject_name: str
     needed: int
     crops: List[np.ndarray] = field(default_factory=list)
+
+
+class _ReadbackBlocker:
+    """One daemon helper thread that performs the potentially-unbounded
+    ``block_until_ready`` so the readback worker's wait on a batch can be
+    bounded by that batch's deadline. ``block`` returns ``"ready"`` (the
+    array's transfer completed), ``"raised"`` (blocking raised — an
+    injected never-ready proxy, or a failed computation), or ``"timeout"``
+    (deadline passed while still blocked). After a timeout the helper may
+    be wedged in native code — the hang-mode outage — so the caller must
+    abandon this instance and build a fresh one; the abandoned daemon
+    thread parks forever on its own (now unreachable) condition variable.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._pending: Any = None
+        self._done = threading.Event()
+        self._ok = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="ocvf-readback-blocker")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._pending is None:
+                    self._cv.wait()
+                arr = self._pending
+            try:
+                arr.block_until_ready()
+                self._ok = True
+            except Exception:  # noqa: BLE001 — classified by the caller
+                self._ok = False
+            with self._cv:
+                self._pending = None
+            self._done.set()
+
+    def block(self, arr: Any, timeout: float) -> str:
+        self._done.clear()
+        with self._cv:
+            self._pending = arr
+            self._cv.notify()
+        if not self._done.wait(timeout=max(0.0, timeout)):
+            return "timeout"
+        return "ready" if self._ok else "raised"
 
 
 class RecognizerService:
@@ -79,11 +163,12 @@ class RecognizerService:
         batch_size: int = 8,
         frame_shape: Optional[tuple] = None,
         flush_timeout: float = 0.05,
-        # Backpressure knob: beyond this many undrained batches the loop
-        # BLOCKS on the oldest readback before dispatching more. Keep it
-        # shallow — each in-flight batch is a full device round-trip of
-        # latency debt (~300 ms on a tunneled backend); a deep queue turns
-        # into seconds of backlog while the batcher keeps accepting frames.
+        # Backpressure knob: beyond this many undrained batches the dispatch
+        # loop waits for the readback worker to free a slot before popping
+        # more. Keep it shallow — each in-flight batch is a full device
+        # round-trip of latency debt (~300 ms on a tunneled backend); a deep
+        # queue turns into seconds of backlog while the batcher keeps
+        # accepting frames.
         inflight_depth: int = 4,
         similarity_threshold: float = 0.3,
         subject_names: Optional[List[str]] = None,
@@ -106,6 +191,22 @@ class RecognizerService:
         # pipeline on host devices) so a dead accelerator degrades the
         # job instead of wedging it.
         cpu_fallback: Optional[Callable[["RecognizerService"], None]] = None,
+        # False selects the pre-worker inline drain (poll-based) path: the
+        # serving loop itself materializes readbacks between dispatches,
+        # sleeping on the two named knobs below. Kept as the fallback for
+        # backends/hosts where a second Python thread is unwanted, and as
+        # the measurable "before" of bench_serving.py's comparison.
+        readback_worker: bool = True,
+        # Fallback-path poll knobs (module docstring; exposed as
+        # ``ocvf-recognize --readback-poll-ms / --drain-poll-ms``).
+        readback_poll_s: float = FALLBACK_READBACK_POLL_S,
+        drain_poll_s: float = FALLBACK_DRAIN_POLL_S,
+        # Dispatch bucket ladder (None/() disables slicing: every batch
+        # dispatches at the full padded batch_size, the old behavior).
+        bucket_sizes: Optional[Sequence[int]] = DEFAULT_BUCKET_SIZES,
+        # Continuous-batching latency target, forwarded to the batcher's
+        # adaptive flush deadline (None keeps the fixed flush_timeout).
+        target_latency_s: Optional[float] = None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -116,14 +217,27 @@ class RecognizerService:
         self._faults = fault_injector
         self._backend_probe_fn = backend_probe_fn
         self._cpu_fallback = cpu_fallback
+        self._use_worker = bool(readback_worker)
+        self._readback_poll_s = float(readback_poll_s)
+        self._drain_poll_s = float(drain_poll_s)
         if frame_shape is None:
             raise ValueError("frame_shape (H, W) is required (static device shapes)")
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
                                     dtype=transfer_dtype,
                                     metrics=self.metrics,
-                                    fault_injector=fault_injector)
+                                    fault_injector=fault_injector,
+                                    target_latency_s=target_latency_s)
         self.inflight_depth = int(inflight_depth)
+        self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
+                                                        int(batch_size))
         self._inflight: deque = deque()
+        # One condition variable guards the in-flight queue AND the
+        # completion counter: the dispatch loop appends + waits for slots,
+        # the readback worker pops + notifies, drain() waits on it instead
+        # of a blind sleep.
+        self._inflight_cv = threading.Condition()
+        self._blocker: Optional[_ReadbackBlocker] = None
+        self._worker: Optional[threading.Thread] = None
         self._thread: Optional[threading.Thread] = None
         self._running = False
         self._crashed = False
@@ -131,7 +245,8 @@ class RecognizerService:
         self._degraded = False
         # Completion counter paired with batcher.delivered_batches: a batch
         # counts as completed only once PUBLISHED (or abandoned on dispatch
-        # failure), so drain() sees every popped batch through its whole
+        # failure / dead-lettered / lost to a crash — every exit settles
+        # it), so drain() sees every popped batch through its whole
         # lifetime — there is no window where a batch in hand is invisible
         # (round-2 advisor #3: a bare _dispatching flag had one between
         # get_batch() and the flag write).
@@ -172,6 +287,32 @@ class RecognizerService:
 
         connector.subscribe(FRAME_TOPIC, self._on_frame)
         connector.subscribe(CONTROL_TOPIC, self._on_control)
+
+    def _build_bucket_ladder(self, bucket_sizes, batch_size: int) -> List[int]:
+        """Ascending dispatch sizes, always ending at ``batch_size``. Only
+        ladder entries the mesh can shard (divisible by every dp axis the
+        pipeline dispatches over) survive the filter."""
+        divisor = 1
+        try:
+            from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS
+
+            for mesh in (getattr(getattr(self.pipeline, "gallery", None),
+                                 "mesh", None),
+                         getattr(self.pipeline, "mesh_a", None)):
+                if mesh is not None:
+                    divisor = max(divisor, int(mesh.shape[DP_AXIS]))
+        except Exception:  # noqa: BLE001 — stub pipelines have no mesh
+            divisor = 1
+        ladder = {int(b) for b in (bucket_sizes or ())
+                  if 0 < int(b) < batch_size and int(b) % divisor == 0}
+        ladder.add(batch_size)
+        return sorted(ladder)
+
+    def _pick_bucket(self, count: int) -> int:
+        for b in self._bucket_ladder:
+            if count <= b:
+                return b
+        return self.batcher.batch_size
 
     def _run_embed_chunk(self, params, crops):
         """One fixed-size enrolment embed, honoring ``_embed_device``
@@ -240,51 +381,81 @@ class RecognizerService:
         self._running = True
         self._crashed = False
         self.connector.start()
+        if self._use_worker:
+            self._blocker = _ReadbackBlocker()
+            self._worker = threading.Thread(target=self._readback_thread,
+                                            daemon=True,
+                                            name="ocvf-readback-worker")
+            self._worker.start()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def warmup(self) -> None:
         """Compile the serving + enrolment graphs before frames arrive, so
-        the first batch and the first enroll command pay no compile stall."""
+        the first batch and the first enroll command pay no compile stall.
+        Every bucket of the dispatch ladder is compiled — a partial batch
+        at any ladder size must never hit a mid-serving XLA compile."""
         t0 = time.perf_counter()
-        zeros = np.zeros((self.batcher.batch_size, *self.batcher.frame_shape),
-                         self.batcher.dtype)
-        packed = self.pipeline.recognize_batch_packed(zeros)
+        prewarm = getattr(self.pipeline, "prewarm_batch_shapes", None)
+        if prewarm is not None:
+            prewarm(self._bucket_ladder, self.batcher.frame_shape,
+                    self.batcher.dtype)
+        else:
+            # Pipelines without the helper (e.g. TwoStagePipeline) still
+            # get every ladder size executed once.
+            for bucket in self._bucket_ladder:
+                zeros = np.zeros((bucket, *self.batcher.frame_shape),
+                                 self.batcher.dtype)
+                out = self.pipeline.recognize_batch_packed(zeros)
+                if hasattr(out, "block_until_ready"):
+                    out.block_until_ready()
         chunk = np.zeros((self._enrol_chunk, *self.pipeline.face_size), np.float32)
         emb = self._run_embed_chunk(self.pipeline.embed_params, chunk)
-        for arr in (packed, emb):
-            arr.block_until_ready() if hasattr(arr, "block_until_ready") else None
+        if hasattr(emb, "block_until_ready"):
+            emb.block_until_ready()
         self.metrics.observe("warmup", time.perf_counter() - t0)
 
     def drain(self, timeout: float = 120.0) -> bool:
         """Block until every accepted frame has been batched, computed, AND
         published (or timeout). Call at end-of-stream BEFORE stop() —
         stop() tears the loop down promptly and discards whatever is still
-        queued, which is right for Ctrl-C but wrong for a finite stream."""
+        queued, which is right for Ctrl-C but wrong for a finite stream.
+        Event-driven against the completion condition variable; the wait
+        tick only bounds how often the batcher's pending count re-checks."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            # delivered == completed covers popped-but-undispatched batches,
-            # the in-flight queue, AND publish-in-progress (completed is
-            # bumped only after _publish returns).
-            if (self.batcher.pending == 0
-                    and self.batcher.delivered_batches == self._completed_batches):
-                return True
-            time.sleep(0.05)
+        with self._inflight_cv:
+            while time.monotonic() < deadline:
+                # delivered == completed covers popped-but-undispatched
+                # batches, the in-flight queue, AND publish-in-progress
+                # (completed is bumped only after _publish returns).
+                if (self.batcher.pending == 0
+                        and self.batcher.delivered_batches == self._completed_batches):
+                    return True
+                self._inflight_cv.wait(timeout=self._drain_poll_s)
         return False
 
     def stop(self) -> None:
         self._running = False
         self.batcher.close()
+        with self._inflight_cv:
+            self._inflight_cv.notify_all()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=5.0)
             self._thread = None
-        if thread is None or not thread.is_alive():
-            # Final materialize only once the loop thread is truly gone —
-            # two threads force-draining the same deque could pair one
-            # batch's results with another's metadata. A loop thread still
-            # alive here is bounded-waiting on a readback deadline and
-            # will finish its own force drain.
+        worker = self._worker
+        if worker is not None:
+            # The worker finishes the remaining in-flight batches itself
+            # (each wait bounded by that batch's readback deadline), then
+            # exits; a worker still alive after the join is bounded-waiting
+            # on a deadline and will finish its own drain.
+            worker.join(timeout=5.0)
+            self._worker = None
+        if (not self._use_worker
+                and (thread is None or not thread.is_alive())):
+            # Fallback path: final materialize only once the loop thread is
+            # truly gone — two threads force-draining the same deque could
+            # pair one batch's results with another's metadata.
             self._drain(force=True)
         if self._faults is not None and getattr(
                 self.pipeline, "fault_injector", None) is self._faults:
@@ -295,24 +466,47 @@ class RecognizerService:
 
     @property
     def loop_crashed(self) -> bool:
-        """True when an exception escaped the loop body and killed the
-        serving thread (``ServiceSupervisor`` watches this flag)."""
+        """True when an exception escaped a serving-side thread (the
+        dispatch loop or the readback worker) and killed it
+        (``ServiceSupervisor`` watches this flag)."""
         return self._crashed
 
+    def restart_pending(self) -> bool:
+        """True when the crash flag is up AND a serving-side thread has
+        actually exited — i.e. ``restart_loop`` would act rather than
+        no-op. The supervisor polls this instead of inspecting threads:
+        a flag raised while the thread is still unwinding (slow 'crashed'
+        status subscriber) must not burn phantom restarts."""
+        if not self._crashed or not self._running:
+            return False
+        if self._thread is not None and not self._thread.is_alive():
+            return True
+        return (self._use_worker and self._worker is not None
+                and not self._worker.is_alive())
+
     def restart_loop(self) -> None:
-        """Restart a crashed serving loop (supervisor path). Re-syncs the
-        completed-batch accounting first: a crash between popping a batch
-        and publishing it would otherwise leave ``drain()`` waiting forever
-        for a completion that can no longer happen."""
+        """Restart crashed serving-side threads (supervisor path): whichever
+        of the dispatch loop / readback worker died is respawned; a thread
+        still alive is left untouched. Batch accounting needs no resync —
+        every crash path settles its own popped batch before propagating
+        (see ``_serve_one`` / ``_readback_loop``)."""
         if not self._running or self._thread is None:
             return
-        if self._thread.is_alive():
+        serve_dead = not self._thread.is_alive()
+        worker_dead = (self._use_worker and self._worker is not None
+                       and not self._worker.is_alive())
+        if not serve_dead and not worker_dead:
             return  # not actually crashed
-        self._completed_batches = (self.batcher.delivered_batches
-                                   - len(self._inflight))
         self._crashed = False
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        if worker_dead:
+            self._blocker = _ReadbackBlocker()
+            self._worker = threading.Thread(target=self._readback_thread,
+                                            daemon=True,
+                                            name="ocvf-readback-worker")
+            self._worker.start()
+        if serve_dead:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
 
     def _loop(self) -> None:
         try:
@@ -329,42 +523,86 @@ class RecognizerService:
             if batch is None:
                 if not self._running:
                     break
-                self._drain()
+                if not self._use_worker:
+                    self._drain()
                 continue
-            frames, metas, count = batch.frames, batch.metas, batch.count
-            t0 = time.perf_counter()
-            # Queue-wait: frame enqueue -> batch pop. The batching-delay
-            # term of the end-to-end latency decomposition (flush window +
-            # waiting for batch_size peers), measured per frame.
-            now_mono = time.monotonic()
-            for ts in batch.enqueue_ts:
-                self.metrics.observe("queue_wait", now_mono - ts)
-            packed = self._dispatch_with_retry(frames)
+            self._serve_one(batch)
+        if not self._use_worker:
+            self._drain(force=True)
+
+    def _serve_one(self, batch) -> None:
+        frames, metas, count = batch.frames, batch.metas, batch.count
+        t0 = time.perf_counter()
+        # Queue-wait: frame enqueue -> batch pop. The batching-delay
+        # term of the end-to-end latency decomposition (continuous-batching
+        # deadline + waiting for batch_size peers), measured per frame.
+        now_mono = time.monotonic()
+        for ts in batch.enqueue_ts:
+            self.metrics.observe("queue_wait", now_mono - ts)
+        accounted = False
+        try:
+            # Bucketed dispatch: slice the padded staging array down to the
+            # smallest warmed ladder size that fits the real frames — a
+            # view, not a copy, so steady state allocates nothing.
+            bucket = self._pick_bucket(count)
+            view = frames[:bucket] if bucket < len(frames) else frames
+            packed = self._dispatch_with_retry(view)
             if packed is None:
                 # Retries exhausted or the error was permanent (poisoned
                 # batch): abandoned, not published — but still completed
                 # for drain() accounting.
-                self._completed_batches += 1
-                continue
+                self._mark_completed()
+                accounted = True
+                self.batcher.recycle(frames)
+                return
             # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
             # — never device compute, which is async from here).
             t_disp = time.perf_counter()
             self.metrics.observe("dispatch", t_disp - t0)
             deadline = time.monotonic() + self.resilience.readback_deadline_s
-            self._inflight.append((packed, frames, metas, count, t0, t_disp,
-                                   deadline))
-            self.metrics.incr("batches_dispatched")
-            self.metrics.incr("frames_processed", count)
+            with self._inflight_cv:
+                self._inflight.append((packed, frames, metas, count, t0,
+                                       t_disp, deadline))
+                accounted = True
+                self._inflight_cv.notify_all()
+        except BaseException:
+            if not accounted:
+                # The popped batch dies with this crash; settle it so
+                # drain()'s delivered==completed stays solvable after the
+                # supervisor restarts the loop.
+                self._mark_completed()
+            raise
+        self.metrics.incr("batches_dispatched")
+        self.metrics.incr("frames_processed", count)
+        if bucket < self.batcher.batch_size:
+            self.metrics.incr("batches_bucketed")
+        if self._use_worker:
+            # Backpressure: beyond inflight_depth undrained batches, wait
+            # for the readback worker to free a slot (it notifies the cv on
+            # every pop) before popping more frames. The timeout only
+            # bounds liveness re-checks (stop), never paces a healthy
+            # pipeline. Deliberately NOT escaped on a worker crash: parking
+            # here keeps the in-flight queue bounded until the supervisor
+            # respawns the worker (or stop() clears _running).
+            with self._inflight_cv:
+                while (self._running
+                       and len(self._inflight) > self.inflight_depth):
+                    self._inflight_cv.wait(timeout=self._drain_poll_s)
+        else:
             self._drain()
-        self._drain(force=True)
+
+    def _mark_completed(self, n: int = 1) -> None:
+        with self._inflight_cv:
+            self._completed_batches += n
+            self._inflight_cv.notify_all()
 
     def _dispatch_with_retry(self, frames) -> Optional[Any]:
         """One batch through the device, honoring the resilience policy:
-        transient failures retry with exponential backoff (draining
-        readbacks while waiting), permanent ones abandon immediately, and
-        ``degraded_after`` consecutive failed attempts publish degraded
-        mode. Returns the dispatched (async) output, or None when the
-        batch is abandoned (``batches_failed``)."""
+        transient failures retry with exponential backoff (the readback
+        worker keeps draining while we wait), permanent ones abandon
+        immediately, and ``degraded_after`` consecutive failed attempts
+        publish degraded mode. Returns the dispatched (async) output, or
+        None when the batch is abandoned (``batches_failed``)."""
         policy = self.resilience
         attempt = 0
         while True:
@@ -405,12 +643,14 @@ class RecognizerService:
             return packed
 
     def _backoff_wait(self, seconds: float) -> None:
-        """Sleep in small slices, still draining in-flight readbacks (a
-        retry storm must not let completed batches rot past their result
-        consumers) and bailing promptly on stop()."""
+        """Sleep in small slices, bailing promptly on stop(). On the
+        fallback path this also drains in-flight readbacks (a retry storm
+        must not let completed batches rot past their result consumers);
+        with the worker the drain happens concurrently anyway."""
         deadline = time.monotonic() + seconds
         while self._running and time.monotonic() < deadline:
-            self._drain()
+            if not self._use_worker:
+                self._drain()
             time.sleep(min(0.01, max(0.0, deadline - time.monotonic())))
 
     # ---- degraded mode ----
@@ -440,10 +680,16 @@ class RecognizerService:
     def _exit_degraded(self) -> None:
         self._degraded = False
         self.metrics.incr("degraded_recoveries")
-        self._publish_status({"status": "recovered"})
+        status = {"status": "recovered"}
+        if self._embed_device is not None:
+            # "Recovered" only in the sense that dispatches succeed again —
+            # on the CPU-fallback pipeline, not the accelerator. Deploy
+            # tooling must keep treating the job as degraded-capacity.
+            status["on_cpu_fallback"] = True
+        self._publish_status(status)
 
     def _publish_status(self, status: Dict[str, Any]) -> None:
-        """Status publishes run on the serving thread and subscribers are
+        """Status publishes run on serving-side threads and subscribers are
         arbitrary app code — a raising status consumer must degrade to a
         logged error, never crash the loop it is reporting on."""
         try:
@@ -470,22 +716,115 @@ class RecognizerService:
         unhealthy accelerator degrades the job, never wedges it)."""
         self.metrics.incr("batches_dead_lettered")
         self.metrics.incr("frames_dead_lettered", count)
-        self._completed_batches += 1
+        self._mark_completed()
         self._publish_status({"status": "dead_letter", "frames": count})
 
     @staticmethod
     def _is_ready(packed) -> bool:
         """Non-blocking readiness; backends without ``is_ready`` report
-        ready and fall back to the blocking materialize (old behavior)."""
+        ready and fall back to the blocking materialize (old behavior).
+        A RAISING is_ready (outage surfacing at the readback side) also
+        reports ready: the materialize then surfaces the error where
+        ``_complete_head`` dead-letters it instead of crashing a thread."""
         try:
             return bool(packed.is_ready())
         except (AttributeError, NotImplementedError):
             return True
+        except Exception:  # noqa: BLE001 — outage-shaped; classify at materialize
+            return True
+
+    # ---- the readback worker (threaded path) ----
+
+    def _readback_thread(self) -> None:
+        try:
+            self._readback_loop()
+        except Exception:  # noqa: BLE001 — flag the crash for the supervisor
+            logging.getLogger(__name__).exception("readback worker crashed")
+            self.metrics.incr("loop_crashes")
+            self._crashed = True
+            self._publish_status({"status": "crashed"})
+
+    def _readback_loop(self) -> None:
+        """Drain the in-flight queue in dispatch order: block on each
+        batch's device array (bounded by its readback deadline), then
+        materialize + publish. Runs until stopped AND the queue is empty,
+        so stop() after drain() loses nothing. The entry stays at the head
+        of the deque while we wait — the backpressure slot is only freed
+        (cv notified) once its batch's device round-trip actually ended."""
+        while True:
+            with self._inflight_cv:
+                while self._running and not self._inflight:
+                    self._inflight_cv.wait(timeout=self._drain_poll_s)
+                if not self._inflight:
+                    if not self._running:
+                        return
+                    continue
+                packed, frames, metas, count, t0, t_disp, deadline = \
+                    self._inflight[0]
+            try:
+                ready = self._await_ready(packed, deadline)
+            except Exception:  # noqa: BLE001 — outage at the readback side
+                # A transient backend error surfacing here must cost this
+                # batch, not the worker thread (a crash loop would burn
+                # the supervisor's bounded restarts on an outage the
+                # dispatch side survives via retry/degraded mode).
+                logging.getLogger(__name__).exception("readback wait failed")
+                self.metrics.incr("readback_errors")
+                ready = False
+            with self._inflight_cv:
+                self._inflight.popleft()
+                self._inflight_cv.notify_all()
+            if not ready:
+                # Do NOT recycle the staging buffer: the batch's device
+                # round-trip never completed, so the backend's async H2D
+                # read of this exact host array may still be pending —
+                # reusing it would race the outage we just survived. The
+                # pool refills from completed batches.
+                self._dead_letter(count)
+                continue
+            self._complete_head(packed, frames, metas, count, t0, t_disp)
+
+    def _await_ready(self, packed, deadline: float) -> bool:
+        """Wait for one batch's transfer, bounded by its deadline. Returns
+        False when the deadline won (caller dead-letters). Event-driven:
+        the sacrificial blocker thread performs ``block_until_ready`` so a
+        hang-mode outage costs one abandoned daemon thread, not a wedged
+        worker — and a healthy readback never pays an ``is_ready`` poll
+        (the tunnel charges ~100 ms per sync-poll)."""
+        if not hasattr(packed, "block_until_ready"):
+            return True  # plain host value (already materialized)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return self._is_ready(packed)
+        blocker = self._blocker
+        if blocker is None:
+            blocker = self._blocker = _ReadbackBlocker()
+        outcome = blocker.block(packed, remaining)
+        if outcome == "ready":
+            return True
+        if outcome == "timeout":
+            # The blocker may be wedged in native code on the hung array —
+            # abandon it; the next batch gets a fresh one.
+            self._blocker = _ReadbackBlocker()
+            return False
+        # "raised": either a proxy that refuses to block (the injected
+        # stuck readback raises instead of hanging the suite) or a failed
+        # computation (ready-with-error). Bounded is_ready polling sorts
+        # them out: never-ready dead-letters at the deadline; a failed
+        # computation reports ready and materializes its error upstream.
+        while self._running and time.monotonic() < deadline:
+            if self._is_ready(packed):
+                return True
+            time.sleep(self._readback_poll_s)
+        return self._is_ready(packed)
+
+    # ---- the inline drain (fallback non-threaded path) ----
 
     def _drain(self, force: bool = False) -> None:
-        """Materialize finished batches. A not-ready head batch past its
-        readback deadline is dead-lettered; when over depth (or forced) the
-        wait for the head is a bounded is_ready poll capped by that same
+        """Materialize finished batches inline (``readback_worker=False``).
+        A not-ready head batch past its readback deadline is dead-lettered;
+        when over depth (or forced) the wait for the head is a bounded
+        ``is_ready`` poll (tick: ``readback_poll_s``) capped by that same
         deadline — never an unbounded blocking readback a hang-mode outage
         could wedge."""
         while self._inflight:
@@ -493,7 +832,10 @@ class RecognizerService:
             ready = self._is_ready(packed)
             if not ready:
                 if time.monotonic() >= deadline:
-                    self._inflight.popleft()
+                    # No recycle: the incomplete round-trip may still hold
+                    # an async read on this staging buffer (see the worker
+                    # path's dead-letter note).
+                    self._pop_inflight_head()
                     self._dead_letter(count)
                     continue
                 if not (force or len(self._inflight) > self.inflight_depth):
@@ -501,30 +843,64 @@ class RecognizerService:
                 # Over depth / forced: poll until ready or deadline. The
                 # poll IS the readback wait — it lands in ready_wait below.
                 while not ready and time.monotonic() < deadline:
-                    time.sleep(0.005)
+                    time.sleep(self._readback_poll_s)
                     ready = self._is_ready(packed)
                 if not ready:
-                    self._inflight.popleft()
-                    self._dead_letter(count)
+                    self._pop_inflight_head()
+                    self._dead_letter(count)  # no recycle: see above
                     continue
-            self._inflight.popleft()
-            # Materialize BEFORE stamping ready_wait: on the blocking
-            # (over-depth/forced) path np.asarray is the readback itself and
-            # must land in ready_wait, not in publish.
+            self._pop_inflight_head()
+            self._complete_head(packed, frames, metas, count, t0, t_disp)
+
+    def _complete_head(self, packed, frames, metas, count, t0, t_disp) -> None:
+        """Materialize + publish one POPPED batch and settle its accounting
+        — the shared tail of the readback worker and the fallback drain
+        (the two paths must stay behaviorally identical apart from
+        scheduling; bench_serving's overlap_comparison relies on it).
+
+        Three invariants live here, once:
+        - a materialize failure (an outage error riding the result array)
+          dead-letters the batch (``readback_errors``) instead of crashing
+          the thread — the readback-side mirror of the dispatch retry
+          classification;
+        - ``ready_wait`` is stamped AFTER ``np.asarray``: on the blocking
+          (over-depth/forced) fallback path the conversion IS the readback
+          (the tunneled backend's ~100 ms sync-poll floor lands in this
+          term — compare bench.py's chained-diff device ms/batch to see
+          how much is tunnel vs chip), and it must never leak into
+          'publish';
+        - a crash escaping the publish path still settles
+          ``_completed_batches`` first, so drain() stays solvable after
+          the supervisor restarts the thread.
+        """
+        try:
             arr = np.asarray(packed)
-            # dispatch-END -> readback-complete (measured from t_disp, so
-            # the host dispatch segment is not double-counted with the
-            # 'dispatch' metric): device compute + D2H readback + the drain
-            # loop's polling slack (on the tunneled backend the ~100 ms
-            # sync-poll readback floor lands in THIS term — compare against
-            # bench.py's chained-diff device ms/batch to see how much is
-            # tunnel vs chip).
-            self.metrics.observe("ready_wait", time.perf_counter() - t_disp)
-            t_pub = time.perf_counter()
+        except Exception:  # noqa: BLE001 — outage error carried by the array
+            logging.getLogger(__name__).exception(
+                "readback materialize failed")
+            self.metrics.incr("readback_errors")
+            self._dead_letter(count)  # completed++, no recycle (see above)
+            return
+        self.metrics.observe("ready_wait", time.perf_counter() - t_disp)
+        t_pub = time.perf_counter()
+        try:
             self._publish(arr, frames, metas, count)
-            self._completed_batches += 1
-            self.metrics.observe("publish", time.perf_counter() - t_pub)
-            self.metrics.observe("batch_latency", time.perf_counter() - t0)
+        except BaseException:
+            self._mark_completed()
+            raise
+        self._mark_completed()
+        now = time.perf_counter()
+        self.metrics.observe("publish", now - t_pub)
+        self.metrics.observe("batch_latency", now - t0)
+        # Feed the continuous batcher's adaptive deadline with the
+        # realized downstream time (pop -> published).
+        self.batcher.report_service_time(now - t0)
+        self.batcher.recycle(frames)
+
+    def _pop_inflight_head(self) -> None:
+        with self._inflight_cv:
+            self._inflight.popleft()
+            self._inflight_cv.notify_all()
 
     def _publish(self, packed, frames, metas, count) -> None:
         from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
@@ -574,11 +950,13 @@ class RecognizerService:
         x0, x1 = max(0, x0), min(w, x1)
         if y1 - y0 < 4 or x1 - x0 < 4:
             return
-        enrolment.crops.append(frame[y0:y1, x0:x1])
+        # COPY, not a view: the frame lives in a pooled staging buffer that
+        # is recycled (and overwritten) as soon as this batch completes.
+        enrolment.crops.append(frame[y0:y1, x0:x1].copy())
         if len(enrolment.crops) >= enrolment.needed:
             with self._enrol_lock:
                 self._enrolment = None
-            # Off the serving thread: the embed + gallery install must not
+            # Off the serving threads: the embed + gallery install must not
             # stall frame batches (reload-without-drop, SURVEY.md §5.3).
             threading.Thread(
                 target=self._finish_enrolment, args=(enrolment,), daemon=True

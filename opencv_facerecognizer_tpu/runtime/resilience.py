@@ -277,14 +277,14 @@ class ServiceSupervisor:
             self._check_stall(service, STATUS_TOPIC)
             if not service.loop_crashed or not service._running:
                 continue
-            thread = service._thread
-            if thread is not None and thread.is_alive():
-                # Crash flagged but the thread is still unwinding (e.g. a
+            if not service.restart_pending():
+                # Crash flagged but every serving-side thread (dispatch
+                # loop AND readback worker) is still unwinding (e.g. a
                 # slow 'crashed' status subscriber): restart_loop would
-                # no-op on the alive thread, so acting now would burn a
+                # no-op on the alive threads, so acting now would burn a
                 # phantom restart (and desync restarts vs loop_crashes,
                 # which the soak treats as an unsupervised crash). Wait
-                # for the thread to actually exit.
+                # for a thread to actually exit.
                 continue
             if self.restarts >= self.max_restarts:
                 if not self.gave_up:

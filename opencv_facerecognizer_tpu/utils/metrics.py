@@ -12,11 +12,13 @@ from typing import Dict, IO, Optional
 
 
 class Metrics:
-    """Thread-safe counters + bounded latency windows + optional JSONL sink."""
+    """Thread-safe counters + gauges + bounded latency windows + optional
+    JSONL sink."""
 
     def __init__(self, sink: Optional[IO[str]] = None, window: int = 512):
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
         self._latencies: Dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
         self._sink = sink
 
@@ -27,6 +29,16 @@ class Metrics:
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
             self._latencies[name].append(seconds)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Last-write-wins instantaneous value (e.g. the batcher's current
+        adaptive flush deadline) — reported as-is in ``summary``."""
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge(self, name: str, default: float = float("nan")) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
 
     def counter(self, name: str) -> float:
         with self._lock:
@@ -59,6 +71,7 @@ class Metrics:
     def summary(self) -> Dict[str, float]:
         with self._lock:
             out = dict(self._counters)
+            out.update(self._gauges)
             for name, values in self._latencies.items():
                 if values:
                     ordered = sorted(values)

@@ -1,0 +1,16 @@
+"""Thin forwarder so serving benches live with the other measurement
+entrypoints: ``python scripts/bench_serving.py [--smoke] ...`` runs the
+repo-root ``bench_serving.py`` (which owns the artifact format — see its
+docstring for the sections and the smoke contract)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_serving import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -226,6 +226,66 @@ def test_dispatch_exhaustion_abandons_batch(chaos_stack):
     assert counters["degraded_transitions"] == 1  # hit degraded_after=2
 
 
+def test_slow_readbacks_pipeline_through_worker(chaos_stack):
+    """Injected slow readbacks (delayed-ready, not stuck) must neither
+    dead-letter nor serialize the loop: the readback worker waits them out
+    event-driven while the dispatch loop keeps feeding the in-flight
+    queue, and every frame still publishes exactly once."""
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=11, slow_readback_s=0.15)
+    service, connector = _make_service(pipe, injector)
+    service.start()
+    try:
+        injector.script("readback", "slow", "slow", "slow")
+        t0 = time.monotonic()
+        for i in range(6):  # three 2-frame batches, all slow
+            connector.inject(FRAME_TOPIC, _frame_msg({"k": "slow", "i": i}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "slow"]) >= 6)
+        elapsed = time.monotonic() - t0
+    finally:
+        service.stop()
+    assert injector.summary() == {"readback:slow": 3}
+    counters = service.metrics.counters()
+    assert counters.get("batches_dead_lettered", 0) == 0
+    assert counters["batches_dispatched"] >= 3
+    # Overlap check: three 150 ms readbacks served well under 3 x 150 ms
+    # plus slack would only hold if they pipelined; allow generous CI
+    # headroom while still ruling out full serialization with the 80 ms
+    # batch window on top (serialized would be >= ~0.7 s).
+    assert elapsed < 3 * 0.15 + 0.35, elapsed
+
+
+def test_fallback_inline_path_preserves_fault_semantics(chaos_stack):
+    """readback_worker=False (the pre-worker inline poll drain, now the
+    documented fallback mode with named poll knobs) must keep the same
+    fault semantics: a stuck readback dead-letters at its deadline and
+    healthy traffic afterwards still serves."""
+    pipe, _ = chaos_stack
+    injector = FaultInjector(seed=12)
+    service, connector = _make_service(pipe, injector,
+                                       readback_worker=False,
+                                       readback_poll_s=0.002)
+    service.start()
+    try:
+        injector.script("readback", "stuck")
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "stuck"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "stuck"}))
+        assert _wait(lambda: service.metrics.counter(
+            "batches_dead_lettered") >= 1)
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "ok"}))
+        connector.inject(FRAME_TOPIC, _frame_msg({"k": "ok"}))
+        assert _wait(lambda: len(
+            [m for m in connector.messages(RESULT_TOPIC)
+             if (m.get("meta") or {}).get("k") == "ok"]) >= 2)
+    finally:
+        service.stop()
+    assert service._worker is None  # truly the non-threaded path
+    metas = [m.get("meta") or {} for m in connector.messages(RESULT_TOPIC)]
+    assert sum(m.get("k") == "stuck" for m in metas) == 0
+
+
 # ---------- supervisor ----------
 
 

@@ -115,6 +115,206 @@ def test_batcher_close_unblocks():
     assert done == [None]
 
 
+# ---------- continuous batching ----------
+
+
+def test_batcher_flushes_early_at_size_threshold():
+    """A full batch forms the moment batch_size frames are buffered — no
+    flush-window wait even with a huge deadline cap."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    b = FrameBatcher(batch_size=4, frame_shape=(8, 8), flush_timeout=10.0,
+                     metrics=m, target_latency_s=5.0)
+    for i in range(4):
+        b.put(np.full((8, 8), i, np.float32), meta=i)
+    t0 = time.monotonic()
+    batch = b.get_batch()
+    assert time.monotonic() - t0 < 1.0
+    assert batch.count == 4
+    assert m.counter("batcher_batches_size") == 1
+    assert m.counter("batcher_batches_deadline") == 0
+    assert b.stats["batches_size"] == 1
+
+
+def test_batcher_adaptive_deadline_under_trickle():
+    """Under trickle load (fewer than batch_size frames) a batch waits up
+    to the ADAPTIVE deadline: target latency minus the reported downstream
+    service time, clamped to [min_deadline, flush_timeout] — never the full
+    fixed flush window."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    b = FrameBatcher(batch_size=8, frame_shape=(4, 4), flush_timeout=5.0,
+                     metrics=m, target_latency_s=0.2)
+    # No service estimate yet: full budget, capped by flush_timeout.
+    assert abs(b.current_flush_deadline() - 0.2) < 1e-9
+    b.report_service_time(0.15)  # EWMA seeds at the first report
+    assert abs(b.current_flush_deadline() - 0.05) < 1e-6
+    # Budget exhausted -> the floor, not zero (back-to-back frames still
+    # coalesce) and never a negative wait.
+    b.report_service_time(0.5)
+    for _ in range(40):
+        b.report_service_time(0.5)
+    assert b.current_flush_deadline() == b.min_deadline_s
+    # The gauge mirrors the current deadline on the shared surface.
+    assert m.gauge("batcher_flush_deadline_ms") == b.min_deadline_s * 1e3
+    # A trickle frame flushes at ~the deadline, not at flush_timeout.
+    b.put(np.zeros((4, 4), np.float32), meta="lone")
+    t0 = time.monotonic()
+    batch = b.get_batch()
+    waited = time.monotonic() - t0
+    assert batch.count == 1 and batch.metas[0] == "lone"
+    assert waited < 1.0  # far below the 5 s fixed window
+    assert m.counter("batcher_batches_deadline") == 1
+
+
+def test_batcher_coalescing_stats_match_frames_offered():
+    """Every offered frame is accounted for on the shared Metrics surface:
+    offered == batched + malformed + overflow + closed + still pending."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    b = FrameBatcher(batch_size=2, frame_shape=(4, 4), flush_timeout=0.01,
+                     max_pending=4, metrics=m)
+    b.put(np.ones((9, 9), np.float32))  # malformed
+    for i in range(6):  # 4 fit, 2 force overflow drops of the oldest
+        b.put(np.full((4, 4), i, np.float32), meta=i)
+    batches = []
+    while True:
+        out = b.get_batch(block=False)
+        if out is None:
+            break
+        batches.append(out)
+    b.close()
+    b.put(np.zeros((4, 4), np.float32))  # dropped: closed
+    batched = sum(bt.count for bt in batches)
+    c = m.counters()
+    assert c["batcher_frames_offered"] == 8
+    assert c["batcher_frames_batched"] == batched == 4
+    assert c["batcher_dropped_malformed"] == 1
+    assert c["batcher_dropped_overflow"] == 2
+    assert c["batcher_dropped_closed"] == 1
+    assert b.pending == 0
+    assert (c["batcher_frames_batched"] + c["batcher_dropped_malformed"]
+            + c["batcher_dropped_overflow"] + c["batcher_dropped_closed"]
+            == c["batcher_frames_offered"])
+
+
+def test_batcher_buffer_pool_recycles_staging_arrays():
+    """A recycled staging array is reused by a later batch (zero per-batch
+    allocations in steady state) with its padding lanes re-zeroed; wrong
+    shapes are silently refused."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    b = FrameBatcher(batch_size=4, frame_shape=(4, 4), flush_timeout=0.01,
+                     metrics=m)
+    for i in range(4):
+        b.put(np.full((4, 4), 7.0, np.float32), meta=i)
+    first = b.get_batch()
+    b.recycle(first.frames)
+    b.recycle(np.zeros((2, 4, 4), np.float32))  # wrong shape: ignored
+    b.put(np.full((4, 4), 1.0, np.float32), meta="x")
+    second = b.get_batch()  # partial: deadline flush
+    assert second.frames is first.frames  # the pooled buffer came back
+    assert second.count == 1
+    np.testing.assert_allclose(second.frames[1:], 0.0)  # padding re-zeroed
+    assert m.counter("batcher_buffer_reuse") == 1
+
+
+# ---------- overlapped serving pipeline (fake instant backend) ----------
+
+
+def _instant_service(batch_size=8, frame_hw=(16, 16), **kwargs):
+    from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+
+    pipeline = InstantPipeline(frame_hw)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipeline, connector, batch_size=batch_size, frame_shape=frame_hw,
+        flush_timeout=0.05, similarity_threshold=0.0, **kwargs,
+    )
+    return pipeline, service, connector
+
+
+def test_service_bucketed_dispatch_slices_partial_batches():
+    """A partial batch dispatches at the smallest bucket >= its real frame
+    count — never the full padded batch_size — and the slice is a view of
+    the pooled staging array (no per-batch copy)."""
+    pipeline, service, connector = _instant_service(
+        batch_size=32, bucket_sizes=(8, 32))
+    service.start(warmup=False)
+    try:
+        for i in range(3):
+            connector.inject(FRAME_TOPIC,
+                             {"frame": np.zeros((16, 16), np.float32),
+                              "meta": {"i": i}})
+        deadline = time.monotonic() + 10
+        while (len(connector.messages(RESULT_TOPIC)) < 3
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        assert service.drain(timeout=10.0)
+        service.stop()
+    assert len(connector.messages(RESULT_TOPIC)) == 3
+    assert pipeline.batch_sizes_seen == [8]  # 3 frames -> bucket 8, once
+    assert service.metrics.counter("batches_bucketed") == 1
+
+
+def test_service_continuous_batching_stats_and_zero_drops():
+    """Full-rate traffic forms size-triggered batches; the trailing partial
+    flushes at the adaptive deadline; nothing drops and every offered frame
+    reconciles on the metrics surface."""
+    _, service, connector = _instant_service(
+        batch_size=4, target_latency_s=0.05)
+    service.start(warmup=False)
+    n = 10  # 2 full batches + a partial of 2
+    try:
+        for i in range(n):
+            connector.inject(FRAME_TOPIC,
+                             {"frame": np.zeros((16, 16), np.float32),
+                              "meta": {"i": i}})
+        deadline = time.monotonic() + 10
+        while (len(connector.messages(RESULT_TOPIC)) < n
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        assert service.drain(timeout=10.0)
+        service.stop()
+    assert len(connector.messages(RESULT_TOPIC)) == n
+    c = service.metrics.counters()
+    assert c["batcher_frames_offered"] == n
+    assert c["batcher_frames_batched"] == n
+    assert c.get("batcher_dropped_overflow", 0) == 0
+    assert c["batcher_batches_size"] >= 2
+    assert c["batcher_batches_deadline"] >= 1
+    assert c["frames_processed"] == n
+
+
+def test_service_fallback_inline_drain_still_serves():
+    """readback_worker=False selects the pre-worker inline poll path (the
+    named fallback knobs) — it must still serve end to end."""
+    _, service, connector = _instant_service(
+        batch_size=4, readback_worker=False, readback_poll_s=0.001,
+        drain_poll_s=0.01)
+    service.start(warmup=False)
+    try:
+        for i in range(8):
+            connector.inject(FRAME_TOPIC,
+                             {"frame": np.zeros((16, 16), np.float32),
+                              "meta": {"i": i}})
+        deadline = time.monotonic() + 10
+        while (len(connector.messages(RESULT_TOPIC)) < 8
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+    finally:
+        assert service.drain(timeout=10.0)
+        service.stop()
+    assert len(connector.messages(RESULT_TOPIC)) == 8
+    assert service._worker is None  # no readback worker thread was spawned
+
+
 # ---------- connectors ----------
 
 
