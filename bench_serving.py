@@ -26,7 +26,10 @@ through the overlapped pipeline (readback worker + continuous batching +
 bucketed dispatch) — and ``--smoke`` runs a deterministic fake-backend
 variant (``run_smoke``) that emulates the tunnel's sync-poll floor on CPU
 and writes BENCH_SERVING_smoke.json (also invokable as
-``scripts/bench_serving.py --smoke``).
+``scripts/bench_serving.py --smoke``), now with an ``overload_sweep``
+section (``run_overload_sweep``): a 1x/2x/4x offered-load ladder against
+a deterministic capacity wall with the admission/brownout/shedding stack
+armed, recording per-priority completion and sheds by reason.
 
 Run:  PYTHONPATH=. python bench_serving.py [--rates 50 200 500]
 """
@@ -331,6 +334,103 @@ def run_smoke(out_path="BENCH_SERVING_smoke.json", frames_n=160,
     return artifact
 
 
+def run_overload_sweep(multipliers=(1.0, 2.0, 4.0), seconds=3.0,
+                       batch_size=8, frame_hw=(32, 32), dispatch_s=0.04):
+    """Offered-load ladder against a capacity-limited fake backend
+    (``InstantPipeline(dispatch_s=...)``: hard capacity = batch_size /
+    dispatch_s frames/s) with the full overload-protection stack armed —
+    admission bound, priority shedding, brownout, stale drops. Per
+    multiplier: interactive vs bulk completion, explicit sheds by reason,
+    interactive e2e percentiles, and the admission-ledger remainder
+    (must be 0 after the drain). Deterministic: no randomness, no
+    hardware — the overload-sweep section of BENCH_SERVING_smoke.json."""
+    from opencv_facerecognizer_tpu.runtime.fakes import build_overload_stack
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC, STATUS_TOPIC,
+    )
+
+    capacity_fps = batch_size / dispatch_s
+    frame = np.zeros(frame_hw, np.float32)
+    rows = []
+    for mult in multipliers:
+        # The canonical overload harness — shared with chaos_soak's
+        # --scenario overload, so this sweep and the soak's pass criteria
+        # describe the exact same configuration.
+        pipeline, service, connector = build_overload_stack(
+            frame_shape=frame_hw, batch_size=batch_size,
+            dispatch_s=dispatch_s)
+        send_t, done_t = {}, {}
+        lock = threading.Lock()
+
+        def on_result(topic, message, done_t=done_t, lock=lock):
+            seq = (message.get("meta") or {}).get("seq")
+            if seq is not None:
+                with lock:
+                    done_t.setdefault(seq, time.monotonic())
+
+        connector.subscribe(RESULT_TOPIC, on_result)
+        max_brownout = {"level": 0}
+        connector.subscribe(
+            STATUS_TOPIC,
+            lambda t, m: max_brownout.__setitem__(
+                "level", max(max_brownout["level"], m.get("level", 0)))
+            if m.get("status") == "brownout" else None)
+        service.start(warmup=False)
+        interactive, bulk = [], []
+        try:
+            interval = 1.0 / (mult * capacity_fps)
+            end = time.monotonic() + seconds
+            seq = 0
+            while time.monotonic() < end:
+                pri = "interactive" if seq % 5 == 0 else "bulk"
+                send_t[seq] = time.monotonic()
+                connector.inject(FRAME_TOPIC, {
+                    "frame": frame, "priority": pri,
+                    "meta": {"seq": seq, "pri": pri}})
+                (interactive if pri == "interactive" else bulk).append(seq)
+                seq += 1
+                time.sleep(interval)
+            service.drain(timeout=30.0)
+        finally:
+            service.stop()
+        lat_i = np.asarray([done_t[s] - send_t[s]
+                            for s in interactive if s in done_t])
+        ledger = service.ledger()
+        row = {
+            "offered_multiplier": mult,
+            "offered_hz": round(mult * capacity_fps, 1),
+            "interactive_offered": len(interactive),
+            "interactive_completed": int(len(lat_i)),
+            "bulk_offered": len(bulk),
+            "bulk_completed": sum(1 for s in bulk if s in done_t),
+            "rejected": {k: int(v) for k, v in service.metrics
+                         .counters_with_prefix("frames_rejected_").items()},
+            "drops_by_reason": {k: int(v)
+                                for k, v in ledger["drops_by_reason"].items()},
+            "max_brownout_level": max_brownout["level"],
+            "ledger_in_system_after_drain": ledger["in_system"],
+        }
+        if len(lat_i):
+            row["interactive_e2e_p50_ms"] = round(
+                float(np.percentile(lat_i, 50)) * 1e3, 1)
+            row["interactive_e2e_p99_ms"] = round(
+                float(np.percentile(lat_i, 99)) * 1e3, 1)
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+    return {
+        "note": ("offered-load ladder vs a deterministic capacity wall "
+                 f"({capacity_fps:g} frames/s: InstantPipeline dispatch_s="
+                 f"{dispatch_s:g}, batch {batch_size}) with admission bound "
+                 "24, brownout at 50 ms queue-wait EWMA, stale shed at "
+                 "250 ms. Above 1x, bulk is shed with explicit reasons "
+                 "while interactive completion and latency hold; the "
+                 "admission ledger remainder is 0 after every drain."),
+        "config": {"batch_size": batch_size, "dispatch_s": dispatch_s,
+                   "capacity_fps": capacity_fps, "seconds": seconds},
+        "rows": rows,
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--rates", type=float, nargs="+",
@@ -357,15 +457,28 @@ def main(argv=None):
     args = parser.parse_args(argv)
 
     if args.smoke:
-        artifact = run_smoke()
+        artifact = run_smoke(write=False)
+        artifact["overload_sweep"] = run_overload_sweep()
+        with open("BENCH_SERVING_smoke.json", "w") as fh:
+            json.dump(artifact, fh, indent=2)
+        print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
         legacy = artifact["modes"].get("legacy_poll", {})
         overlap = artifact["modes"].get("overlapped", {})
+        sweep_4x = next((r for r in artifact["overload_sweep"]["rows"]
+                         if r["offered_multiplier"] == 4.0), {})
         print(json.dumps({
             "legacy_e2e_p50_ms": legacy.get("e2e_p50_ms"),
             "overlapped_e2e_p50_ms": overlap.get("e2e_p50_ms"),
             "overlapped_ready_wait_p50_ms": overlap.get(
                 "decomposition_ms", {}).get("ready_wait_p50_ms"),
             "overlapped_dropped": overlap.get("dropped_frames"),
+            "overload_4x_interactive_completed": sweep_4x.get(
+                "interactive_completed"),
+            "overload_4x_interactive_p99_ms": sweep_4x.get(
+                "interactive_e2e_p99_ms"),
+            "overload_4x_bulk_shed": (
+                sweep_4x.get("bulk_offered", 0)
+                - sweep_4x.get("bulk_completed", 0)),
         }))
         return 0
 

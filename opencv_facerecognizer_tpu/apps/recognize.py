@@ -133,6 +133,37 @@ def build_parser() -> argparse.ArgumentParser:
                         "that kills the serving loop is restarted with "
                         "the last-known-good gallery snapshot (bounded "
                         "restarts)")
+    # ---- overload protection (runtime.admission / README section) ----
+    p.add_argument("--max-inflight-frames", type=int, default=0,
+                   help="admission bound: reject new frames (explicit "
+                        "'rejected' status, reason=overload) once this "
+                        "many admitted frames are still in the system; "
+                        "bulk-priority frames are rejected at 75%% of the "
+                        "bound so interactive traffic keeps headroom. "
+                        "0 = unbounded")
+    p.add_argument("--rate-limit-fps", type=float, default=0.0,
+                   help="per-topic token-bucket rate limit (frames/s, "
+                        "burst = 1 s of rate): producers above it get "
+                        "explicit 'rejected' statuses (reason=rate_limit) "
+                        "instead of silently displacing queued frames. "
+                        "0 = off")
+    p.add_argument("--brownout-queue-wait-ms", type=float, default=0.0,
+                   help="brownout threshold: when the queue-wait EWMA "
+                        "crosses this, degrade work per frame (level 1: "
+                        "skip-shed half the bulk frames; level 2: shed "
+                        "all bulk + cap the dispatch ladder at its "
+                        "smallest bucket), announced on the status topic "
+                        "with a brownout_level gauge and automatic "
+                        "hysteresis recovery. 0 = off")
+    p.add_argument("--shed-stale-after-ms", type=float, default=0.0,
+                   help="freshness bound: a queued frame older than this "
+                        "is shed (reason=stale) instead of wasting a "
+                        "dispatch slot. 0 = off")
+    p.add_argument("--dead-letter-journal", metavar="PATH",
+                   help="append dead-lettered/shed frame metadata + "
+                        "reason to this bounded rotating JSONL journal "
+                        "(replayable: python -m opencv_facerecognizer_tpu"
+                        ".runtime.journal PATH)")
     return p
 
 
@@ -219,14 +250,28 @@ def main(argv=None) -> int:
     from opencv_facerecognizer_tpu.runtime.recognizer import (
         FRAME_TOPIC, RESULT_TOPIC, RecognizerService,
     )
+    from opencv_facerecognizer_tpu.runtime.admission import AdmissionController
+    from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
     from opencv_facerecognizer_tpu.runtime.resilience import (
-        ResiliencePolicy, ServiceSupervisor, rebuild_pipeline_on_cpu,
+        BrownoutPolicy, ResiliencePolicy, ServiceSupervisor,
+        rebuild_pipeline_on_cpu,
     )
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
     pipeline, names = _load_stack(args)
     metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
     metrics = Metrics(sink=metrics_sink)
+
+    admission = None
+    if args.max_inflight_frames > 0 or args.rate_limit_fps > 0:
+        admission = AdmissionController(
+            max_inflight_frames=args.max_inflight_frames or None,
+            rate_limit_fps=args.rate_limit_fps or None,
+        )
+    brownout = (BrownoutPolicy(queue_wait_s=args.brownout_queue_wait_ms / 1e3)
+                if args.brownout_queue_wait_ms > 0 else None)
+    journal = (DeadLetterJournal(args.dead_letter_journal, metrics=metrics)
+               if args.dead_letter_journal else None)
 
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)
@@ -251,6 +296,11 @@ def main(argv=None) -> int:
         bucket_sizes=tuple(b for b in args.bucket_sizes if b > 0),
         target_latency_s=(None if args.target_latency_ms is None
                           else args.target_latency_ms / 1e3),
+        admission=admission,
+        brownout=brownout,
+        dead_letter_journal=journal,
+        shed_stale_after_s=(args.shed_stale_after_ms / 1e3
+                            if args.shed_stale_after_ms > 0 else None),
         resilience=ResiliencePolicy(
             dispatch_retries=args.dispatch_retries,
             readback_deadline_s=args.readback_deadline,
@@ -333,6 +383,11 @@ def main(argv=None) -> int:
         summary = metrics.summary()
         if summary:
             print(f"metrics: {summary}", file=sys.stderr)
+        ledger = service.ledger()
+        if ledger["admitted"]:
+            print(f"admission ledger: {ledger}", file=sys.stderr)
+        if journal is not None:
+            journal.close()
         if metrics_sink:
             metrics_sink.close()
     return 0

@@ -6,6 +6,13 @@ deliberately separate (SURVEY.md §5.8): collectives ride ICI inside jitted
 graphs; frames and results ride a pluggable ``MiddlewareConnector``.
 """
 
+from opencv_facerecognizer_tpu.runtime.admission import (
+    PRIORITY_BULK,
+    PRIORITY_INTERACTIVE,
+    AdmissionController,
+    TokenBucket,
+    parse_priority,
+)
 from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
 from opencv_facerecognizer_tpu.runtime.connector import (
     FakeConnector,
@@ -13,21 +20,30 @@ from opencv_facerecognizer_tpu.runtime.connector import (
     MiddlewareConnector,
 )
 from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
+from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
 from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
 from opencv_facerecognizer_tpu.runtime.resilience import (
+    BrownoutPolicy,
     ResiliencePolicy,
     ServiceSupervisor,
 )
 from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer
 
 __all__ = [
+    "AdmissionController",
+    "BrownoutPolicy",
+    "DeadLetterJournal",
     "FakeConnector",
     "FaultInjector",
     "FrameBatcher",
     "JSONLConnector",
     "MiddlewareConnector",
+    "PRIORITY_BULK",
+    "PRIORITY_INTERACTIVE",
     "RecognizerService",
     "ResiliencePolicy",
     "ServiceSupervisor",
     "TheTrainer",
+    "TokenBucket",
+    "parse_priority",
 ]

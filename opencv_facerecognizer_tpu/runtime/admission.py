@@ -1,0 +1,162 @@
+"""Admission control at the connector-receive boundary (overload layer §1).
+
+PR 1 made the serving loop survive its *backend*; this module protects it
+from its *clients*. The batcher's only native defense against a traffic
+flood is silently dropping the oldest frames — no backpressure signal, no
+priority, no per-reason ledger. ``AdmissionController`` sits in front of
+the batcher (``RecognizerService._on_frame`` consults it before decoding a
+frame) and rejects EXPLICITLY, cheaply, and before any work is spent:
+
+- **token-bucket rate limit** per topic (``rate_limit_fps``; burst =
+  ``burst_factor`` seconds of rate): a producer exceeding its rate gets a
+  ``rejected`` status with reason ``rate_limit`` instead of a silent drop;
+- **bounded intake** (``max_inflight_frames``): when the number of frames
+  inside the system (admitted − completed − dropped, read from the
+  service's admission ledger) reaches the bound, new low-priority frames
+  are rejected with reason ``overload``; interactive frames get a small
+  headroom slice (``interactive_reserve``) so bulk traffic cannot starve
+  them out of the front door.
+
+Frames carry an optional ``priority`` field — ``"interactive"`` (the
+default: a user is waiting on this frame) or ``"bulk"`` (enroll/backfill
+traffic that tolerates shedding). ``parse_priority`` maps the wire forms
+onto the numeric scale used everywhere downstream: smaller = more
+important, ``PRIORITY_INTERACTIVE`` (0) < ``PRIORITY_BULK`` (1).
+
+Rejections are counted per reason (``frames_rejected_<reason>``) on the
+shared Metrics surface; they happen BEFORE admission, so they live outside
+the admission ledger (``admitted == completed + Σ drops_by_reason``) by
+design — a rejected frame never entered the system.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Union
+
+#: numeric priority scale: smaller = more important. The wire forms are
+#: the strings below; ints pass through (clamped non-negative).
+PRIORITY_INTERACTIVE = 0
+PRIORITY_BULK = 1
+
+_PRIORITY_NAMES = {
+    "interactive": PRIORITY_INTERACTIVE,
+    "bulk": PRIORITY_BULK,
+    "enroll": PRIORITY_BULK,
+}
+
+
+def parse_priority(value) -> int:
+    """Wire ``priority`` field -> numeric priority. Unknown/missing values
+    default to interactive (rejecting a frame because its producer spelled
+    the priority wrong would be worse than serving it eagerly)."""
+    if value is None:
+        return PRIORITY_INTERACTIVE
+    if isinstance(value, str):
+        return _PRIORITY_NAMES.get(value.lower(), PRIORITY_INTERACTIVE)
+    try:
+        return max(0, int(value))
+    except (TypeError, ValueError):
+        return PRIORITY_INTERACTIVE
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/second, capacity ``burst``.
+
+    Thread-safe; ``try_acquire`` never blocks (admission must stay cheap —
+    it runs on the connector's dispatch thread for every offered frame).
+    """
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+
+class AdmissionController:
+    """Per-topic rate limits + a bounded intake, consulted per frame.
+
+    ``admit(topic, priority)`` returns ``None`` to admit or a rejection
+    reason string (``"rate_limit"`` / ``"overload"``). The caller counts
+    and announces the rejection; this object only decides.
+
+    ``rate_limit_fps`` is a scalar (applied to every topic seen) or a
+    ``{topic: fps}`` dict; ``0``/``None`` disables the rate limit for that
+    topic. ``max_inflight_frames`` bounds admitted-but-unfinished frames,
+    read through ``inflight_fn`` (the service wires its admission-ledger
+    ``frames_in_system``); ``0``/``None`` disables the bound.
+
+    Priority-aware headroom: bulk frames are rejected once in-flight
+    reaches ``max_inflight_frames * (1 - interactive_reserve)`` — the
+    reserved slice keeps the front door open for interactive frames while
+    a bulk flood is being shed.
+    """
+
+    def __init__(
+        self,
+        max_inflight_frames: Optional[int] = None,
+        rate_limit_fps: Union[None, float, Dict[str, float]] = None,
+        burst_seconds: float = 1.0,
+        interactive_reserve: float = 0.25,
+        inflight_fn: Optional[Callable[[], float]] = None,
+    ):
+        self.max_inflight_frames = (None if not max_inflight_frames
+                                    else int(max_inflight_frames))
+        if rate_limit_fps is None or isinstance(rate_limit_fps, dict):
+            self._rate_cfg: Optional[Dict[str, float]] = rate_limit_fps
+            self._default_rate: Optional[float] = None
+        else:
+            self._rate_cfg = None
+            self._default_rate = float(rate_limit_fps) or None
+        self.burst_seconds = float(burst_seconds)
+        self.interactive_reserve = min(0.9, max(0.0, float(interactive_reserve)))
+        self.inflight_fn = inflight_fn
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+        # Immutable after __init__: lets the per-frame admit path skip the
+        # bucket lock entirely in the common bound-only configuration.
+        self._any_rate = bool(self._rate_cfg) or self._default_rate is not None
+
+    def _bucket_for(self, topic: str) -> Optional[TokenBucket]:
+        if not self._any_rate:
+            return None  # no rate configured anywhere: stay lock-free
+        with self._lock:
+            bucket = self._buckets.get(topic)
+            if bucket is None:
+                if self._rate_cfg is not None:
+                    rate = self._rate_cfg.get(topic)
+                else:
+                    rate = self._default_rate
+                if not rate or rate <= 0:
+                    return None
+                bucket = TokenBucket(rate, burst=rate * self.burst_seconds)
+                self._buckets[topic] = bucket
+            return bucket
+
+    def admit(self, topic: str, priority: int = PRIORITY_INTERACTIVE
+              ) -> Optional[str]:
+        """None = admitted; otherwise the rejection reason."""
+        bucket = self._bucket_for(topic)
+        if bucket is not None and not bucket.try_acquire():
+            return "rate_limit"
+        if self.max_inflight_frames and self.inflight_fn is not None:
+            bound = self.max_inflight_frames
+            if priority > PRIORITY_INTERACTIVE:
+                bound = bound * (1.0 - self.interactive_reserve)
+            if self.inflight_fn() >= bound:
+                return "overload"
+        return None

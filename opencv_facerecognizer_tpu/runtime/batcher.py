@@ -18,8 +18,21 @@ Semantics:
   XLA from recompiling (static shapes); padding lanes are dead weight the
   TPU shrugs off (partial batches can additionally be *sliced* down to a
   bucket ladder by the consumer — see RecognizerService).
-- Bounded queue: beyond ``max_pending`` the OLDEST frames drop first — a
-  live recognizer wants fresh frames, not a growing latency debt.
+- Bounded queue with **priority-aware shedding**: beyond ``max_pending`` a
+  victim is evicted in preference order — already-stale frames first (queue
+  age past ``stale_after_s``), then the lowest-priority class (bulk before
+  interactive), oldest within a class. An incoming frame less important
+  than everything queued is itself the victim (rejected). Without
+  priorities or a stale bound this degrades exactly to the old
+  drop-oldest-first rule: a live recognizer wants fresh frames, not a
+  growing latency debt.
+- **Deadline-aware dispatch**: with ``stale_after_s`` set, ``get_batch``
+  discards frames whose queue age already exceeds it BEFORE forming a
+  batch — a frame that has blown its latency budget must not waste a
+  dispatch slot that a fresh frame could use (``batcher_dropped_stale``).
+- Every drop is observable twice: per-reason counters on the shared
+  Metrics surface, and (when ``drop_log`` is wired) the dropped frames'
+  metadata handed to the service's dead-letter journal.
 - **Buffer pool**: the [B, H, W] staging array a batch rides in can be
   handed back via ``recycle`` once the consumer is done with it (after the
   batch's readback completed — the host-side analog of a donated input
@@ -30,7 +43,8 @@ Semantics:
 
 Coalescing stats ride the shared ``Metrics`` surface so tests can reconcile
 them exactly: ``batcher_frames_offered`` (every ``put`` attempt) equals
-frames batched + malformed drops + overflow drops + closed drops + pending.
+frames batched + malformed drops + overflow drops + stale drops + closed
+drops + pending.
 ``batcher_batches_size`` / ``batcher_batches_deadline`` split batches by
 what triggered the flush; ``batcher_flush_deadline_ms`` is a gauge of the
 current (possibly adaptive) deadline.
@@ -86,6 +100,15 @@ class FrameBatcher:
         # Staging buffers kept for reuse (recycle); ~inflight_depth + the
         # batch being formed is plenty.
         buffer_pool_size: int = 8,
+        # Freshness bound (seconds): a queued frame older than this is shed
+        # (reason ``stale``) — preferentially at overflow-eviction time, and
+        # always before it can consume a dispatch slot. None disables.
+        stale_after_s: Optional[float] = None,
+        # Drop observer: called OUTSIDE the lock as ``drop_log(reason,
+        # entries)`` with entries = [{"meta", "enqueue_ts", "priority"}]
+        # for overflow/stale sheds (the service wires its dead-letter
+        # journal here). None = counters only.
+        drop_log=None,
     ):
         self.batch_size = int(batch_size)
         self.frame_shape = tuple(frame_shape)
@@ -103,11 +126,15 @@ class FrameBatcher:
         self._service_time_ewma: Optional[float] = None
         self._pool_cap = int(buffer_pool_size)
         self._buffer_pool: List[np.ndarray] = []
+        self.stale_after_s = (None if stale_after_s is None
+                              else float(stale_after_s))
+        self._drop_log = drop_log
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._frames: deque = deque()
         self._dropped_malformed = 0
         self._dropped_overflow = 0
+        self._dropped_stale = 0
         self._delivered = 0
         self._batches_size = 0
         self._batches_deadline = 0
@@ -115,8 +142,9 @@ class FrameBatcher:
 
     # ---- producer side ----
 
-    def put(self, frame: np.ndarray, meta: Any = None) -> bool:
-        """Enqueue one frame; returns False when dropped (malformed/closed)."""
+    def put(self, frame: np.ndarray, meta: Any = None, priority: int = 0) -> bool:
+        """Enqueue one frame (smaller ``priority`` = more important);
+        returns False when dropped (malformed/closed/rejected-at-overflow)."""
         if self.metrics is not None:
             self.metrics.incr("batcher_frames_offered")
         if self._faults is not None:
@@ -128,26 +156,84 @@ class FrameBatcher:
             if self.metrics is not None:
                 self.metrics.incr("batcher_dropped_malformed")
             return False
+        dropped = None  # (reason, entry) settled outside the lock
+        accepted = True
         with self._not_empty:
             if self._closed:
                 if self.metrics is not None:
                     self.metrics.incr("batcher_dropped_closed")
                 return False
             if len(self._frames) >= self.max_pending:
-                self._frames.popleft()  # drop oldest: freshness over backlog
+                dropped = self._evict_for(int(priority))
+                accepted = dropped is not None
+            if accepted:
+                if np.issubdtype(self.dtype, np.integer) and not np.issubdtype(
+                        frame.dtype, np.integer):
+                    # A bare astype would WRAP out-of-range floats (-3.0 ->
+                    # 253) — clip to the integer range instead (producers may
+                    # send slight out-of-[0,255] values from preprocessing
+                    # headroom).
+                    info = np.iinfo(self.dtype)
+                    frame = np.clip(frame, info.min, info.max)
+                self._frames.append((frame.astype(self.dtype), meta,
+                                     time.monotonic(), int(priority)))
+                self._not_empty.notify()
+        if not accepted:
+            # The incoming frame was the least important thing in sight:
+            # IT is the overflow victim, not a queued frame.
+            with self._lock:
                 self._dropped_overflow += 1
-                if self.metrics is not None:
-                    self.metrics.incr("batcher_dropped_overflow")
-            if np.issubdtype(self.dtype, np.integer) and not np.issubdtype(
-                    frame.dtype, np.integer):
-                # A bare astype would WRAP out-of-range floats (-3.0 -> 253)
-                # — clip to the integer range instead (producers may send
-                # slight out-of-[0,255] values from preprocessing headroom).
-                info = np.iinfo(self.dtype)
-                frame = np.clip(frame, info.min, info.max)
-            self._frames.append((frame.astype(self.dtype), meta, time.monotonic()))
-            self._not_empty.notify()
+            if self.metrics is not None:
+                self.metrics.incr("batcher_dropped_overflow")
+            self._log_drop("overflow", [(meta, None, int(priority))])
+            return False
+        if dropped is not None:
+            reason, entry = dropped
+            if self.metrics is not None:
+                self.metrics.incr(f"batcher_dropped_{reason}")
+            self._log_drop(reason, [entry])
         return True
+
+    def _evict_for(self, incoming_priority: int):
+        """Caller holds the lock; the queue is full. Pick and remove the
+        overflow victim: the oldest already-stale frame if any, else the
+        oldest frame of the least-important queued class — but only when
+        that class is at least as unimportant as the incoming frame.
+        Returns ``(reason, (meta, enqueue_ts, priority))`` for the evicted
+        frame, or None when the INCOMING frame should be rejected instead
+        (everything queued outranks it)."""
+        if self.stale_after_s is not None and self._frames:
+            # Only the head can be stale: enqueue stamps are nondecreasing,
+            # so staleness is a deque prefix (same fact _shed_stale uses) —
+            # no O(max_pending) scan on the per-put overflow path.
+            _f, meta, ts, pri = self._frames[0]
+            if time.monotonic() - ts > self.stale_after_s:
+                self._frames.popleft()
+                self._dropped_stale += 1
+                return "stale", (meta, ts, pri)
+        victim_idx, victim_pri = None, -1
+        for idx, (_f, _meta, _ts, pri) in enumerate(self._frames):
+            if pri > victim_pri:  # strictly-greater keeps the OLDEST of a class
+                victim_idx, victim_pri = idx, pri
+        if victim_pri < incoming_priority:
+            return None  # incoming is the least important: reject it
+        _f, meta, ts, pri = self._frames[victim_idx]
+        del self._frames[victim_idx]
+        self._dropped_overflow += 1
+        return "overflow", (meta, ts, pri)
+
+    def _log_drop(self, reason: str, items) -> None:
+        """Hand dropped frames' metadata to the drop observer (journal).
+        Called OUTSIDE the queue lock; a raising observer is its own bug
+        and must not poison the producer thread."""
+        if self._drop_log is None:
+            return
+        entries = [{"meta": meta, "enqueue_ts": ts, "priority": pri}
+                   for meta, ts, pri in items]
+        try:
+            self._drop_log(reason, entries)
+        except Exception:  # noqa: BLE001 — observer bugs stay theirs
+            pass
 
     def close(self) -> None:
         with self._not_empty:
@@ -201,43 +287,21 @@ class FrameBatcher:
 
     def get_batch(self, block: bool = True) -> Optional[Batch]:
         """Next ``Batch`` or None when closed and drained (or when
-        non-blocking and nothing is flushable)."""
-        with self._not_empty:
-            while True:
-                n = len(self._frames)
-                if n >= self.batch_size:
-                    break
-                if n > 0:
-                    deadline = self.current_flush_deadline()
-                    age = time.monotonic() - self._frames[0][2]
-                    if age >= deadline:
-                        break
-                    if not block:
-                        return None
-                    self._not_empty.wait(timeout=deadline - age)
-                    continue
-                if self._closed:
-                    return None
-                if not block:
-                    return None
-                self._not_empty.wait(timeout=self.flush_timeout)
-                if not self._frames:
-                    # Idle tick: give the caller a turn (the fallback
-                    # serving loop drains its in-flight queue on None).
-                    return None
-            count = min(len(self._frames), self.batch_size)
-            full = count >= self.batch_size
-            items = [self._frames.popleft() for _ in range(count)]
-            # Counted under the lock, atomically with the pop: consumers
-            # (RecognizerService.drain) compare this against their own
-            # completion count, so a popped-but-not-yet-dispatched batch is
-            # never invisible to both ``pending`` and the in-flight queue.
-            self._delivered += 1
-            if full:
-                self._batches_size += 1
-            else:
-                self._batches_deadline += 1
-            buf = self._buffer_pool.pop() if self._buffer_pool else None
+        non-blocking and nothing is flushable). With ``stale_after_s``
+        set, frames that outlived their freshness bound while queued are
+        shed here — counted, journaled, and never dispatched."""
+        stale: List[tuple] = []
+        try:
+            with self._not_empty:
+                popped = self._pop_batch_locked(block, stale)
+        finally:
+            if stale:
+                if self.metrics is not None:
+                    self.metrics.incr("batcher_dropped_stale", len(stale))
+                self._log_drop("stale", stale)
+        if popped is None:
+            return None
+        items, count, full, buf = popped
         if self.metrics is not None:
             self.metrics.incr("batcher_batches_size" if full
                               else "batcher_batches_deadline")
@@ -251,11 +315,64 @@ class FrameBatcher:
             frames[count:] = 0  # re-zero a reused buffer's padding lanes
         metas: List[Any] = [None] * self.batch_size
         enqueue_ts: List[float] = []
-        for i, (frame, meta, ts) in enumerate(items):
+        for i, (frame, meta, ts, _pri) in enumerate(items):
             frames[i] = frame
             metas[i] = meta
             enqueue_ts.append(ts)
         return Batch(frames, metas, count, enqueue_ts)
+
+    def _shed_stale(self, collector: List[tuple]) -> None:
+        """Caller holds the lock. Frames are FIFO by enqueue time, so
+        staleness is always a prefix of the deque."""
+        if self.stale_after_s is None:
+            return
+        now = time.monotonic()
+        while self._frames and now - self._frames[0][2] > self.stale_after_s:
+            _frame, meta, ts, pri = self._frames.popleft()
+            self._dropped_stale += 1
+            collector.append((meta, ts, pri))
+
+    def _pop_batch_locked(self, block: bool, stale: List[tuple]):
+        """Caller holds the lock: the wait/flush decision + the pop.
+        Returns ``(items, count, full, pooled_buf)`` or None (closed /
+        nothing flushable / idle tick)."""
+        while True:
+            self._shed_stale(stale)
+            n = len(self._frames)
+            if n >= self.batch_size:
+                break
+            if n > 0:
+                deadline = self.current_flush_deadline()
+                age = time.monotonic() - self._frames[0][2]
+                if age >= deadline:
+                    break
+                if not block:
+                    return None
+                self._not_empty.wait(timeout=deadline - age)
+                continue
+            if self._closed:
+                return None
+            if not block:
+                return None
+            self._not_empty.wait(timeout=self.flush_timeout)
+            if not self._frames:
+                # Idle tick: give the caller a turn (the fallback
+                # serving loop drains its in-flight queue on None).
+                return None
+        count = min(len(self._frames), self.batch_size)
+        full = count >= self.batch_size
+        items = [self._frames.popleft() for _ in range(count)]
+        # Counted under the lock, atomically with the pop: consumers
+        # (RecognizerService.drain) compare this against their own
+        # completion count, so a popped-but-not-yet-dispatched batch is
+        # never invisible to both ``pending`` and the in-flight queue.
+        self._delivered += 1
+        if full:
+            self._batches_size += 1
+        else:
+            self._batches_deadline += 1
+        buf = self._buffer_pool.pop() if self._buffer_pool else None
+        return items, count, full, buf
 
     @property
     def pending(self) -> int:
@@ -276,6 +393,7 @@ class FrameBatcher:
                 "pending": len(self._frames),
                 "dropped_malformed": self._dropped_malformed,
                 "dropped_overflow": self._dropped_overflow,
+                "dropped_stale": self._dropped_stale,
                 "batches_size": self._batches_size,
                 "batches_deadline": self._batches_deadline,
             }

@@ -279,14 +279,35 @@ class SocketConnector(_TopicDispatchConnector):
     connects out to ``(host, port)``. Either end speaks the exact
     JSONLConnector wire format, so a JSONL client can talk to a socket
     server through ``nc`` unchanged.
+
+    Client mode survives server blips: a peer-initiated disconnect redials
+    with bounded exponential backoff (``reconnect_attempts`` consecutive
+    tries, ``reconnect_backoff_base_s`` doubling up to
+    ``reconnect_backoff_max_s``; successes counted as
+    ``connector_reconnects``). ``eof`` fires only once the budget is
+    exhausted — not on the first blip, which previously killed the client
+    connector permanently.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 listen: bool = False, metrics=None):
+                 listen: bool = False, metrics=None,
+                 reconnect_attempts: int = 8,
+                 reconnect_backoff_base_s: float = 0.05,
+                 reconnect_backoff_max_s: float = 2.0):
         super().__init__(metrics=metrics)
         self.host = host
         self.port = port
         self.listen = listen
+        # Client-mode reconnect (bounded exponential backoff): a server
+        # blip used to permanently kill the client connector — the read
+        # loop ended, ``eof`` fired, and nothing ever dialed again. Now a
+        # peer-initiated disconnect retries the connection up to
+        # ``reconnect_attempts`` consecutive times (counted as
+        # ``connector_reconnects`` on success), and ``eof`` fires only
+        # once the budget is exhausted (or stop() is called). 0 disables.
+        self.reconnect_attempts = max(0, int(reconnect_attempts))
+        self.reconnect_backoff_base_s = float(reconnect_backoff_base_s)
+        self.reconnect_backoff_max_s = float(reconnect_backoff_max_s)
         # Per-SOCKET send locks: interleaved partial writes from concurrent
         # publishes would splice two JSON lines into one corrupt frame, but
         # one stalled client (full TCP buffer) must not wedge publishes to
@@ -312,9 +333,16 @@ class SocketConnector(_TopicDispatchConnector):
             accept_thread.start()
             self._threads.append(accept_thread)
         else:
+            # The FIRST connect stays synchronous (and raising): a server
+            # that was never there is a configuration error the caller
+            # should see immediately, unlike a mid-session blip.
             sock = socket.create_connection((self.host, self.port), timeout=10.0)
             sock.settimeout(None)
-            self._attach(sock)
+            self._register(sock)
+            thread = threading.Thread(target=self._client_loop, args=(sock,),
+                                      daemon=True)
+            thread.start()
+            self._threads.append(thread)
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -325,15 +353,34 @@ class SocketConnector(_TopicDispatchConnector):
             self._attach(sock)
         self.eof.set()
 
-    def _attach(self, sock: socket.socket) -> None:
+    def _register(self, sock: socket.socket) -> bool:
+        """Track a live socket for publish/teardown. Checked against
+        ``_running`` UNDER the lock: stop() clears the registry under the
+        same lock after flipping the flag, so a socket that registers here
+        is guaranteed to be seen (and closed) by stop() — a reconnect
+        completing concurrently with stop() must not leak a live
+        connection past it. Returns False (socket closed) after stop."""
         with self._lock:
+            if not self._running:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return False
             self._client_socks.append(sock)
             self._send_locks[sock] = threading.Lock()
+            return True
+
+    def _attach(self, sock: socket.socket) -> None:
+        if not self._register(sock):
+            return
         thread = threading.Thread(target=self._read_loop, args=(sock,), daemon=True)
         thread.start()
         self._threads.append(thread)
 
-    def _read_loop(self, sock: socket.socket) -> None:
+    def _read_sock(self, sock: socket.socket) -> None:
+        """Read one socket until it dies or stop(): dispatch lines, count
+        a peer-initiated disconnect, and deregister the socket."""
         fh = sock.makefile("r", encoding="utf-8", errors="replace")
         try:
             # A peer that dies mid-message leaves a final line without a
@@ -349,15 +396,71 @@ class SocketConnector(_TopicDispatchConnector):
         finally:
             if self._running:
                 # Peer-initiated EOF/reset (our own stop() closes sockets
-                # only after clearing _running): a flaky client, counted.
+                # only after clearing _running): a flaky peer, counted.
                 self._count("connector_peer_disconnects")
             with self._lock:
                 if sock in self._client_socks:
                     self._client_socks.remove(sock)
                 self._send_locks.pop(sock, None)
-                remaining = len(self._client_socks)
-            if not self.listen or (not self._running and remaining == 0):
-                self.eof.set()
+
+    def _read_loop(self, sock: socket.socket) -> None:
+        """Server-side per-client reader."""
+        self._read_sock(sock)
+        with self._lock:
+            remaining = len(self._client_socks)
+        if not self._running and remaining == 0:
+            self.eof.set()
+
+    def _client_loop(self, sock: socket.socket) -> None:
+        """Client-side reader + reconnect supervisor: read until the
+        connection dies, then redial with bounded exponential backoff.
+        ``eof`` fires only when the reconnect budget is exhausted (the
+        transport is genuinely gone) or stop() ends the session."""
+        while True:
+            self._read_sock(sock)
+            if not self._running or self.reconnect_attempts <= 0:
+                break
+            sock = self._reconnect_with_backoff()
+            if sock is None:
+                break
+        self.eof.set()
+
+    def _reconnect_with_backoff(self) -> Optional[socket.socket]:
+        """Up to ``reconnect_attempts`` redials, exponential backoff
+        between them; sleeps in slices so stop() is honored promptly.
+        Returns the registered socket, or None when the budget is spent."""
+        for attempt in range(self.reconnect_attempts):
+            delay = min(self.reconnect_backoff_max_s,
+                        self.reconnect_backoff_base_s * 2 ** attempt)
+            deadline = time.monotonic() + delay
+            while self._running and time.monotonic() < deadline:
+                time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+            if not self._running:
+                return None
+            try:
+                sock = socket.create_connection((self.host, self.port),
+                                                timeout=10.0)
+            except OSError:
+                self._count("connector_reconnect_failures")
+                continue
+            try:
+                if sock.getsockname() == sock.getpeername():
+                    # TCP self-connect (simultaneous open): dialing a dead
+                    # EPHEMERAL port on loopback can land on the socket's
+                    # own source port and "succeed" — a live connection to
+                    # ourselves, not to a revived server. Treat as failure.
+                    sock.close()
+                    self._count("connector_reconnect_failures")
+                    continue
+            except OSError:
+                self._count("connector_reconnect_failures")
+                continue
+            sock.settimeout(None)
+            if not self._register(sock):
+                return None  # stop() won the race; socket already closed
+            self._count("connector_reconnects")
+            return sock
+        return None
 
     def _send_bounded(self, sock: socket.socket, payload: bytes) -> bool:
         """Deadline-bounded send without touching the socket's blocking
@@ -425,6 +528,17 @@ class SocketConnector(_TopicDispatchConnector):
     def stop(self) -> None:
         self._running = False
         if self._server_sock is not None:
+            # shutdown() BEFORE close(): a thread blocked in accept()
+            # holds a kernel reference to the listening socket, so a bare
+            # close() leaves it listening — it would absorb one final
+            # "ghost" connection (observed: a reconnecting client dials a
+            # stopping server, connects, and parks forever on a socket
+            # nobody will ever service). shutdown() wakes the accept with
+            # an error and genuinely stops the listener.
+            try:
+                self._server_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._server_sock.close()
             except OSError:

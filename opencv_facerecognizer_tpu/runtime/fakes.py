@@ -19,6 +19,7 @@ per frame, so end-to-end latency is real).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional, Tuple
 
@@ -63,6 +64,15 @@ class _GalleryStub:
     size = 0
     grow_count = 0
 
+    # Enough of the ShardedGallery surface that a ServiceSupervisor can
+    # checkpoint/restore over a fake pipeline (the overload soak wraps
+    # the service in one): nothing to snapshot, nothing to restore.
+    def snapshot(self):
+        return ()
+
+    def load_snapshot(self, *parts) -> None:
+        pass
+
 
 class InstantPipeline:
     """Drop-in pipeline for RecognizerService with scripted device timing.
@@ -77,12 +87,20 @@ class InstantPipeline:
 
     def __init__(self, frame_shape: Tuple[int, int], top_k: int = 1,
                  max_faces: int = 2, compute_s: float = 0.0,
-                 sync_poll_floor_s: float = 0.0):
+                 sync_poll_floor_s: float = 0.0, dispatch_s: float = 0.0):
         self.frame_shape = tuple(frame_shape)
         self.top_k = int(top_k)
         self.max_faces = int(max_faces)
         self.compute_s = float(compute_s)
         self.sync_poll_floor_s = float(sync_poll_floor_s)
+        #: host-side seconds charged INSIDE each dispatch call (the serve
+        #: thread sleeps it out). ``compute_s`` is pure latency — batches
+        #: overlap through the in-flight queue and never limit throughput;
+        #: ``dispatch_s`` models a saturated dispatch pipe, giving the fake
+        #: backend a hard capacity of ``batch_size / dispatch_s`` frames/s
+        #: — the deterministic overload wall the admission/brownout tests
+        #: and the overload soak push against.
+        self.dispatch_s = float(dispatch_s)
         self.face_size = (8, 8)
         self.gallery = _GalleryStub()
         self.fault_injector = None
@@ -94,6 +112,8 @@ class InstantPipeline:
     def recognize_batch_packed(self, frames) -> FakePacked:
         if self.fault_injector is not None:
             self.fault_injector.on_dispatch()
+        if self.dispatch_s > 0.0:
+            time.sleep(self.dispatch_s)  # capacity wall (see __init__)
         self.dispatches += 1
         b = int(np.asarray(frames).shape[0])
         self.batch_sizes_seen.append(b)
@@ -102,3 +122,94 @@ class InstantPipeline:
         packed = np.zeros((b, self.max_faces, 6 + 2 * self.top_k), np.float32)
         return FakePacked(packed, time.monotonic() + self.compute_s,
                           poll_cost_s=self.sync_poll_floor_s)
+
+
+def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
+                         dispatch_s: float = 0.04,
+                         max_inflight_frames: int = 24,
+                         brownout_queue_wait_s: float = 0.05,
+                         brownout_dwell_s: float = 0.3,
+                         stale_after_s: float = 0.25,
+                         fault_injector=None, journal=None):
+    """The canonical deterministic overload harness: an
+    ``InstantPipeline`` with a hard ``batch_size / dispatch_s`` frames/s
+    capacity wall behind a ``RecognizerService`` with the full protection
+    stack armed (admission bound with interactive reserve, brownout with
+    hysteresis, stale shedding, halved bucket ladder). Single-sourced so
+    ``scripts/chaos_soak.run_overload`` and
+    ``bench_serving.run_overload_sweep`` exercise — and their notes/pass
+    criteria describe — the exact same configuration. Returns
+    ``(pipeline, service, connector)``."""
+    from opencv_facerecognizer_tpu.runtime.admission import AdmissionController
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.runtime.resilience import (
+        BrownoutPolicy,
+        ResiliencePolicy,
+    )
+
+    pipeline = InstantPipeline(frame_shape, dispatch_s=dispatch_s)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipeline, connector, batch_size=batch_size, frame_shape=frame_shape,
+        flush_timeout=0.03, inflight_depth=2, similarity_threshold=0.0,
+        resilience=ResiliencePolicy(readback_deadline_s=2.0),
+        fault_injector=fault_injector,
+        admission=AdmissionController(max_inflight_frames=max_inflight_frames),
+        brownout=BrownoutPolicy(queue_wait_s=brownout_queue_wait_s,
+                                dwell_s=brownout_dwell_s),
+        dead_letter_journal=journal,
+        shed_stale_after_s=stale_after_s,
+        bucket_sizes=(max(1, batch_size // 2), batch_size),
+    )
+    return pipeline, service, connector
+
+
+class TrafficRecorder:
+    """Seq-tagged send/receive recorder for driving a service under
+    offered load: stamps each frame at offer time, collects its result
+    publish time, and reduces to completion counts and latency
+    percentiles. Shared by ``scripts/chaos_soak.run_overload`` and
+    ``bench_serving.run_overload_sweep`` so the soak's pass criteria and
+    the bench's rows measure traffic identically."""
+
+    def __init__(self, connector):
+        from opencv_facerecognizer_tpu.runtime.recognizer import RESULT_TOPIC
+
+        self.send_t: dict = {}
+        self.done_t: dict = {}
+        self._lock = threading.Lock()
+        connector.subscribe(RESULT_TOPIC, self._on_result)
+
+    def _on_result(self, topic, message) -> None:
+        seq = (message.get("meta") or {}).get("seq")
+        if seq is not None:
+            with self._lock:
+                self.done_t.setdefault(seq, time.monotonic())
+
+    def offer(self, connector, payload: dict, seq, priority: str) -> None:
+        """Stamp + inject one frame message (``payload`` carries the frame
+        encoding; priority rides both the admission field and the meta)."""
+        from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+
+        self.send_t[seq] = time.monotonic()
+        connector.inject(FRAME_TOPIC, {**payload, "priority": priority,
+                                       "meta": {"seq": seq, "pri": priority}})
+
+    def completed(self, seqs) -> int:
+        with self._lock:
+            return sum(1 for s in seqs if s in self.done_t)
+
+    def latencies(self, seqs):
+        with self._lock:
+            return [self.done_t[s] - self.send_t[s]
+                    for s in seqs if s in self.done_t]
+
+    def percentile_ms(self, seqs, q: float) -> float:
+        """Latency percentile in ms over the completed subset of ``seqs``
+        — NaN when nothing completed (callers must treat that as its own
+        verdict, never compare it)."""
+        lat = self.latencies(seqs)
+        if not lat:
+            return float("nan")
+        return float(np.percentile(lat, q)) * 1e3

@@ -5,7 +5,9 @@ each one has a distinct observed failure mode on this box (see
 ``utils/backend_probe.py`` for the round-4 outage evidence):
 
 - **connector receive** — a camera/transport glitch delivers a corrupt
-  payload, drops a message, or delivers it twice;
+  payload, drops a message, delivers it twice, or **floods** (one delivery
+  amplified ``flood_factor``-fold — the runaway-producer shape the
+  admission-control layer exists for);
 - **batcher put** — a malformed frame (wrong shape, NaN garbage) reaches the
   batch queue and must not poison the whole batch;
 - **device dispatch** — the backend fast-fails (``UNAVAILABLE`` at call
@@ -40,7 +42,7 @@ import numpy as np
 
 #: boundary name -> fault kinds it understands.
 BOUNDARIES: Dict[str, tuple] = {
-    "receive": ("drop", "duplicate", "corrupt"),
+    "receive": ("drop", "duplicate", "corrupt", "flood"),
     "put": ("corrupt",),
     "dispatch": ("unavailable",),
     "readback": ("stuck", "slow"),
@@ -126,11 +128,17 @@ class FaultInjector:
 
     def __init__(self, seed: int = 0,
                  rates: Optional[Dict[str, Dict[str, float]]] = None,
-                 slow_readback_s: float = 0.05):
+                 slow_readback_s: float = 0.05,
+                 flood_factor: int = 8):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         #: injected transfer latency of a ``readback: slow`` fault.
         self.slow_readback_s = float(slow_readback_s)
+        #: amplification of a ``receive: flood`` fault — one delivery
+        #: becomes this many (a runaway producer / retry storm in
+        #: miniature; the admission layer must shed the excess with
+        #: explicit reasons instead of wedging).
+        self.flood_factor = max(2, int(flood_factor))
         self.rates = rates or {}
         for boundary, fault_rates in self.rates.items():
             unknown = set(fault_rates) - set(BOUNDARIES.get(boundary, ()))
@@ -191,6 +199,8 @@ class FaultInjector:
             return []
         if fault == "duplicate":
             return [message, message]
+        if fault == "flood":
+            return [message] * self.flood_factor
         # corrupt: force the decode_frame path onto a payload whose byte
         # count cannot match its declared dtype (5 bytes into float32) —
         # the service must count it malformed and keep serving.

@@ -1,0 +1,187 @@
+"""Durable dead-letter journal (overload layer §3).
+
+Before this module, a dead-lettered or shed batch left behind exactly one
+integer (a metrics counter) — a producer that wanted to retry the lost
+frames had nothing to key on. ``DeadLetterJournal`` replaces that
+count-only accounting with a bounded, rotating JSONL file: every
+dead-lettered / shed / abandoned frame appends its metadata (the ``meta``
+the producer sent, the enqueue timestamp, the priority when known) plus an
+explicit reason, and ``replay`` walks the journal back so producers can
+re-offer exactly what was lost.
+
+Format — one JSON object per line::
+
+    {"ts": <unix time>, "reason": "dead_letter", "frames":
+     [{"meta": {...}, "enqueue_ts": <monotonic s|null>, "priority": <int|null>}]}
+
+``enqueue_ts`` is ``time.monotonic()`` at batcher-put (the same stamp the
+latency decomposition uses) — meaningful only relative to the writing
+process; ``ts`` is wall-clock for cross-process correlation.
+
+Rotation: when the active file exceeds ``max_bytes`` it is renamed to
+``<path>.1`` (shifting older backups up, dropping the oldest beyond
+``backups``) — the journal is a bounded flight recorder, not an archive.
+Appends are serialized by a lock and flushed per record: a crash loses at
+most the record being written.
+
+A journal failure must never hurt serving — every write error is swallowed
+after counting ``journal_errors`` on the (optional) metrics surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class DeadLetterJournal:
+    def __init__(self, path: str, max_bytes: int = 4 << 20, backups: int = 2,
+                 metrics=None):
+        self.path = str(path)
+        self.max_bytes = int(max_bytes)
+        self.backups = max(0, int(backups))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._fh = None
+        directory = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(directory, exist_ok=True)
+
+    # ---- writing ----
+
+    @staticmethod
+    def frame_entry(meta: Any = None, enqueue_ts: Optional[float] = None,
+                    priority: Optional[int] = None) -> Dict[str, Any]:
+        return {"meta": meta, "enqueue_ts": enqueue_ts, "priority": priority}
+
+    def append(self, reason: str, frames: List[Dict[str, Any]],
+               **extra: Any) -> None:
+        """Append one record for ``frames`` shed/dead-lettered for
+        ``reason``. Never raises (see module docstring)."""
+        record = {"ts": time.time(), "reason": str(reason),
+                  "frames": list(frames)}
+        if extra:
+            record.update(extra)
+        try:
+            line = json.dumps(record, default=repr)
+        except (TypeError, ValueError):
+            line = json.dumps({"ts": record["ts"], "reason": record["reason"],
+                               "frames": [], "encode_error": True})
+        with self._lock:
+            try:
+                self._rotate_if_needed(len(line) + 1)
+                if self._fh is None:
+                    self._fh = open(self.path, "a", encoding="utf-8")
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except OSError:
+                if self.metrics is not None:
+                    self.metrics.incr("journal_errors")
+                return
+        if self.metrics is not None:
+            self.metrics.incr("journal_records")
+            self.metrics.incr("journal_frames", len(record["frames"]))
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Caller holds the lock. Shift ``path -> path.1 -> path.2 ...``
+        when the active file would exceed ``max_bytes``."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self.backups == 0:
+            os.replace(self.path, self.path + ".old")
+            os.remove(self.path + ".old")
+            return
+        oldest = f"{self.path}.{self.backups}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self.backups - 1, 0, -1):
+            src = f"{self.path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    # ---- reading / replay ----
+
+    def _files_oldest_first(self) -> List[str]:
+        files = [f"{self.path}.{i}" for i in range(self.backups, 0, -1)]
+        files.append(self.path)
+        return [f for f in files if os.path.exists(f)]
+
+    def records(self) -> Iterator[Dict[str, Any]]:
+        """Every journal record, oldest first (rotated files included).
+        Malformed lines (a crash mid-write) are skipped, not fatal."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+            files = self._files_oldest_first()
+        for path in files:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            yield json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+            except OSError:
+                continue
+
+    def replay(self, handler: Callable[[Dict[str, Any]], None],
+               reasons: Optional[tuple] = None) -> int:
+        """Call ``handler(frame_entry)`` for every journaled frame (each
+        entry augmented with its record's ``reason`` and ``ts``), oldest
+        first; returns the number of frames replayed. The producer-side
+        retry hook: a handler typically re-offers each frame's ``meta`` to
+        its source. A raising handler stops the replay (the caller decides
+        whether a partial retry is acceptable)."""
+        n = 0
+        for record in self.records():
+            if reasons is not None and record.get("reason") not in reasons:
+                continue
+            for entry in record.get("frames", ()):
+                handler({**entry, "reason": record.get("reason"),
+                         "ts": record.get("ts")})
+                n += 1
+        return n
+
+
+def main(argv=None) -> int:
+    """Tiny ops helper: print a journal's records (oldest first)."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        description="dump a dead-letter journal as JSON lines")
+    parser.add_argument("path")
+    parser.add_argument("--reason", help="only records with this reason")
+    args = parser.parse_args(argv)
+    journal = DeadLetterJournal(args.path)
+    for record in journal.records():
+        if args.reason and record.get("reason") != args.reason:
+            continue
+        sys.stdout.write(json.dumps(record) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

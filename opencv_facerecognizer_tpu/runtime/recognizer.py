@@ -55,6 +55,28 @@ to restart with the last-known-good gallery; each crash path settles its
 own batch accounting first, so ``drain()`` stays solvable after a restart.
 ``runtime.faults.FaultInjector`` installs at every one of these boundaries
 to make the whole story testable.
+
+**Overload protection** (the client-side mirror of the resilience story —
+nothing above protects the loop from its own producers):
+
+- **Admission control** (``runtime.admission``): ``_on_frame`` consults an
+  optional ``AdmissionController`` BEFORE decoding — a rate-limited or
+  over-bound frame is rejected explicitly (``frames_rejected_<reason>``
+  plus an aggregated ``rejected`` backpressure status on ``STATUS_TOPIC``)
+  instead of silently displacing someone else's frame later. Frames carry
+  an optional ``priority`` ("interactive" default / "bulk"); the batcher
+  sheds stale and low-priority frames first under pressure, and drops
+  anything older than ``shed_stale_after_s`` before it can waste a
+  dispatch slot.
+- **Brownout controller**: a queue-wait EWMA crossing
+  ``BrownoutPolicy.queue_wait_s`` degrades work per frame with hysteresis
+  — level 1 skip-k sheds bulk intake, level 2 sheds all bulk and caps the
+  dispatch bucket ladder at its smallest rung — announced on the status
+  topic with a ``brownout_level`` gauge, recovering automatically.
+- **Admission ledger**: every admitted frame ends in exactly one bucket —
+  ``admitted == completed + Σ drops_by_reason`` (``ledger()``); shed /
+  dead-lettered / abandoned frames also append metadata + reason to the
+  optional durable ``DeadLetterJournal`` so producers can retry.
 """
 
 from __future__ import annotations
@@ -69,12 +91,18 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+from opencv_facerecognizer_tpu.runtime.admission import (
+    PRIORITY_INTERACTIVE,
+    AdmissionController,
+    parse_priority,
+)
 from opencv_facerecognizer_tpu.runtime.batcher import FrameBatcher
 from opencv_facerecognizer_tpu.runtime.connector import (
     MiddlewareConnector,
     decode_frame,
 )
 from opencv_facerecognizer_tpu.runtime.resilience import (
+    BrownoutPolicy,
     ResiliencePolicy,
     is_transient_error,
 )
@@ -207,6 +235,19 @@ class RecognizerService:
         # Continuous-batching latency target, forwarded to the batcher's
         # adaptive flush deadline (None keeps the fixed flush_timeout).
         target_latency_s: Optional[float] = None,
+        # ---- overload protection (module docstring) ----
+        # Front-door admission control: rate limits + bounded intake,
+        # consulted per frame BEFORE decode. None = admit everything.
+        admission: Optional[AdmissionController] = None,
+        # Brownout degradation knobs. None disables the controller.
+        brownout: Optional[BrownoutPolicy] = None,
+        # Durable dead-letter journal (runtime.journal.DeadLetterJournal):
+        # shed/dead-lettered/abandoned frames append metadata + reason
+        # here. None keeps counter-only accounting.
+        dead_letter_journal=None,
+        # Freshness bound forwarded to the batcher: queued frames older
+        # than this are shed (reason ``stale``) rather than dispatched.
+        shed_stale_after_s: Optional[float] = None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -222,11 +263,32 @@ class RecognizerService:
         self._drain_poll_s = float(drain_poll_s)
         if frame_shape is None:
             raise ValueError("frame_shape (H, W) is required (static device shapes)")
+        self.admission = admission
+        if self.admission is not None and self.admission.inflight_fn is None:
+            # The bounded intake reads the admission ledger: in-system =
+            # admitted - completed - Σ drops (always current, no second
+            # bookkeeping to desync).
+            self.admission.inflight_fn = self.frames_in_system
+        self.brownout_policy = brownout
+        self.journal = dead_letter_journal
+        self._brownout_level = 0
+        self._queue_wait_ewma: Optional[float] = None
+        self._brownout_changed_at = 0.0
+        self._bulk_seq = 0
+        # Aggregated backpressure announcements: one ``rejected`` status
+        # per reason per window, carrying the count since the last one —
+        # per-frame publishes would amplify the very flood being shed.
+        self._reject_note_interval_s = 0.5
+        self._reject_pending: Dict[str, int] = {}
+        self._reject_last_pub: Dict[str, float] = {}
+        self._reject_lock = threading.Lock()
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
                                     dtype=transfer_dtype,
                                     metrics=self.metrics,
                                     fault_injector=fault_injector,
-                                    target_latency_s=target_latency_s)
+                                    target_latency_s=target_latency_s,
+                                    stale_after_s=shed_stale_after_s,
+                                    drop_log=self._journal_drop)
         self.inflight_depth = int(inflight_depth)
         self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
                                                         int(batch_size))
@@ -314,6 +376,165 @@ class RecognizerService:
                 return b
         return self.batcher.batch_size
 
+    # ---- admission ledger (overload layer §4) ----
+
+    #: every way an ADMITTED frame can leave the system other than being
+    #: published: the ledger invariant is
+    #: ``frames_admitted == frames_completed + Σ(these)`` once the system
+    #: is quiescent (``in_system`` = the live remainder otherwise).
+    #: Pre-admission rejections (``frames_rejected_*``) are outside by
+    #: design — a rejected frame never entered.
+    LEDGER_DROP_COUNTERS = (
+        "frames_malformed",            # admitted, then failed to decode
+        "batcher_dropped_malformed",   # poisoned at the put boundary
+        "batcher_dropped_overflow",    # priority-aware overflow eviction
+        "batcher_dropped_stale",       # outlived shed_stale_after_s queued
+        "batcher_dropped_closed",      # arrived during shutdown
+        "frames_dropped_brownout",     # shed by the brownout controller
+        "frames_dead_lettered",        # readback outlived its deadline
+        "frames_failed",               # dispatch abandoned (retry budget)
+        "frames_dropped_crashed",      # lost to a serving-thread crash
+    )
+
+    def ledger(self) -> Dict[str, Any]:
+        """One atomic admission-ledger snapshot: ``admitted``,
+        ``completed``, per-reason ``drops_by_reason`` and the ``in_system``
+        remainder (frames admitted but not yet finished — queued in the
+        batcher, riding an in-flight batch, or mid-publish). At quiescence
+        (after ``drain()``) ``in_system`` must be exactly 0 — chaos_soak
+        and the overload tests enforce it."""
+        c = self.metrics.counters()
+        drops = {name: c[name] for name in self.LEDGER_DROP_COUNTERS
+                 if c.get(name)}
+        admitted = c.get("frames_admitted", 0.0)
+        completed = c.get("frames_completed", 0.0)
+        return {
+            "admitted": admitted,
+            "completed": completed,
+            "drops_by_reason": drops,
+            "in_system": admitted - completed - sum(drops.values()),
+        }
+
+    def frames_in_system(self) -> float:
+        """Admitted-but-unfinished frame count (the admission bound's
+        signal). One atomic allocation-free counter read (this runs per
+        offered frame on the connector thread, under exactly the flood it
+        exists to shed); it can transiently lag a frame mid-transition
+        between buckets — fine for a bound, exactness is only claimed at
+        quiescence."""
+        return max(0.0, self.metrics.sum_counters(
+            ("frames_admitted",),
+            ("frames_completed",) + self.LEDGER_DROP_COUNTERS))
+
+    def _journal_drop(self, reason: str, entries: List[Dict[str, Any]],
+                      **extra) -> None:
+        """Append shed/lost frames to the dead-letter journal (no-op
+        without one). Also the batcher's ``drop_log`` hook."""
+        if self.journal is not None:
+            self.journal.append(reason, entries, **extra)
+
+    def _note_rejection(self, reason: str) -> None:
+        """Count + (rate-limited) announce one admission rejection. The
+        status message aggregates everything since the last announcement
+        for that reason — a backpressure signal, not a per-frame echo, so
+        it carries no per-frame fields (an aggregated window mixes
+        priorities; stamping one would mislead a consumer throttling a
+        specific producer class)."""
+        self.metrics.incr(f"frames_rejected_{reason}")
+        now = time.monotonic()
+        with self._reject_lock:
+            self._reject_pending[reason] = self._reject_pending.get(reason, 0) + 1
+            last = self._reject_last_pub.get(reason, 0.0)
+            if now - last < self._reject_note_interval_s:
+                return
+            count = self._reject_pending.pop(reason)
+            self._reject_last_pub[reason] = now
+        self._publish_status({"status": "rejected", "reason": reason,
+                              "count": count})
+
+    def _flush_rejections(self, force: bool = False) -> None:
+        """Trailing-edge flush of aggregated rejections: when a flood
+        stops mid-window, the counts still pending would otherwise never
+        be announced (only a LATER rejection of the same reason triggers a
+        publish). Called from the serving loop's idle tick; stop() forces
+        a final flush regardless of the window."""
+        now = time.monotonic()
+        flush = []
+        with self._reject_lock:
+            for reason in list(self._reject_pending):
+                if force or (now - self._reject_last_pub.get(reason, 0.0)
+                             >= self._reject_note_interval_s):
+                    flush.append((reason, self._reject_pending.pop(reason)))
+                    self._reject_last_pub[reason] = now
+        for reason, count in flush:
+            self._publish_status({"status": "rejected", "reason": reason,
+                                  "count": count})
+
+    # ---- brownout controller (overload layer §2) ----
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout_level
+
+    def _note_queue_wait(self, seconds: float) -> None:
+        """Feed the brownout controller's queue-wait EWMA (called per
+        batch with the batch's mean queue wait, and with 0.0 on idle ticks
+        so an emptied queue recovers even when traffic stops entirely)."""
+        if self.brownout_policy is None:
+            return
+        policy = self.brownout_policy
+        prev = self._queue_wait_ewma
+        self._queue_wait_ewma = (seconds if prev is None
+                                 else prev + policy.ewma_alpha * (seconds - prev))
+        self._update_brownout()
+
+    def _update_brownout(self) -> None:
+        policy = self.brownout_policy
+        now = time.monotonic()
+        if now - self._brownout_changed_at < policy.dwell_s:
+            return  # hysteresis dwell: no flapping between batches
+        ewma = self._queue_wait_ewma or 0.0
+        level = self._brownout_level
+        if ewma > policy.queue_wait_s and level < policy.max_level:
+            self._set_brownout(level + 1, ewma)
+        elif ewma < policy.exit_ratio * policy.queue_wait_s and level > 0:
+            self._set_brownout(level - 1, ewma)
+
+    def _set_brownout(self, level: int, ewma: float) -> None:
+        self._brownout_level = level
+        self._brownout_changed_at = time.monotonic()
+        self.metrics.set_gauge("brownout_level", level)
+        if level > 0:
+            self.metrics.incr("brownout_transitions")
+            self._publish_status({"status": "brownout", "level": level,
+                                  "queue_wait_ewma_ms": round(ewma * 1e3, 2)})
+        else:
+            self.metrics.incr("brownout_recoveries")
+            self._publish_status({"status": "brownout_recovered",
+                                  "queue_wait_ewma_ms": round(ewma * 1e3, 2)})
+
+    def _brownout_sheds_intake(self, priority: int) -> bool:
+        """Shed this (already admitted) frame at intake? Interactive
+        frames never (the intake skip is the priority-aware half of
+        brownout; the level-2 ladder trim in ``_serve_one`` is the
+        class-blind half — see BrownoutPolicy's docstring); bulk frames
+        skip-k at level 1, always at ``max_level``."""
+        level = self._brownout_level
+        if level <= 0 or priority <= PRIORITY_INTERACTIVE:
+            return False
+        if level >= self.brownout_policy.max_level:
+            return True
+        self._bulk_seq += 1
+        return self._bulk_seq % max(2, self.brownout_policy.bulk_skip) != 0
+
+    def _brownout_bucket_cap(self) -> Optional[int]:
+        """At max brownout level the dispatch ladder is capped at its
+        smallest rung (one small fast device call per batch); else None."""
+        if (self.brownout_policy is not None
+                and self._brownout_level >= self.brownout_policy.max_level):
+            return self._bucket_ladder[0]
+        return None
+
     def _run_embed_chunk(self, params, crops):
         """One fixed-size enrolment embed, honoring ``_embed_device``
         (``jax.default_device`` participates in the jit cache key, so the
@@ -331,10 +552,21 @@ class RecognizerService:
 
     def _on_frame(self, topic: str, message: Dict[str, Any]) -> None:
         # Connector-receive fault boundary: the injector may drop,
-        # duplicate, or corrupt the delivery (runtime.faults).
+        # duplicate, flood, or corrupt the delivery (runtime.faults).
         messages = ([message] if self._faults is None
                     else self._faults.on_receive(message))
         for msg in messages:
+            priority = parse_priority(msg.get("priority"))
+            # Admission FIRST, decode second: a rejected frame must cost
+            # ~nothing (the whole point of shedding at the front door).
+            if self.admission is not None:
+                reason = self.admission.admit(topic, priority)
+                if reason is not None:
+                    self._note_rejection(reason)
+                    continue
+            # Admitted: from here on the frame is the ledger's problem —
+            # it must end as completed or as exactly one counted drop.
+            self.metrics.incr("frames_admitted")
             try:
                 frame = decode_frame(msg) if "__frame__" in msg else np.asarray(
                     msg["frame"]
@@ -342,7 +574,14 @@ class RecognizerService:
             except Exception:
                 self.metrics.incr("frames_malformed")
                 continue
-            if not self.batcher.put(frame, meta=msg.get("meta")):
+            if self._brownout_sheds_intake(priority):
+                self.metrics.incr("frames_dropped_brownout")
+                self._journal_drop("brownout", [
+                    {"meta": msg.get("meta"), "enqueue_ts": None,
+                     "priority": priority}], level=self._brownout_level)
+                continue
+            if not self.batcher.put(frame, meta=msg.get("meta"),
+                                    priority=priority):
                 self.metrics.incr("frames_dropped")
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
@@ -362,6 +601,8 @@ class RecognizerService:
                                                   **self.metrics.summary(),
                                                   **self.batcher.stats,
                                                   "degraded": self._degraded,
+                                                  "brownout_level": self._brownout_level,
+                                                  "ledger": self.ledger(),
                                                   "gallery_size": self.pipeline.gallery.size})
 
     # ---- lifecycle ----
@@ -436,6 +677,7 @@ class RecognizerService:
 
     def stop(self) -> None:
         self._running = False
+        self._flush_rejections(force=True)
         self.batcher.close()
         with self._inflight_cv:
             self._inflight_cv.notify_all()
@@ -523,6 +765,13 @@ class RecognizerService:
             if batch is None:
                 if not self._running:
                     break
+                # Idle tick: an empty queue means zero queue wait — feed
+                # the brownout EWMA so it recovers even when the flood
+                # stops dead (no batches would otherwise update it) — and
+                # announce any rejections still pending from a flood that
+                # ended mid-aggregation-window.
+                self._note_queue_wait(0.0)
+                self._flush_rejections()
                 if not self._use_worker:
                     self._drain()
                 continue
@@ -535,10 +784,28 @@ class RecognizerService:
         t0 = time.perf_counter()
         # Queue-wait: frame enqueue -> batch pop. The batching-delay
         # term of the end-to-end latency decomposition (continuous-batching
-        # deadline + waiting for batch_size peers), measured per frame.
+        # deadline + waiting for batch_size peers), measured per frame —
+        # and the brownout controller's load signal (batch mean).
         now_mono = time.monotonic()
         for ts in batch.enqueue_ts:
             self.metrics.observe("queue_wait", now_mono - ts)
+        if batch.enqueue_ts:
+            self._note_queue_wait(
+                sum(now_mono - ts for ts in batch.enqueue_ts)
+                / len(batch.enqueue_ts))
+        # Max-brownout ladder cap: trim an oversized batch down to one
+        # small fast device call; the trimmed (newest) frames are shed
+        # with an explicit reason, not silently truncated.
+        cap = self._brownout_bucket_cap()
+        if cap is not None and count > cap:
+            shed_metas = metas[cap:count]
+            shed_ts = batch.enqueue_ts[cap:count]
+            self.metrics.incr("frames_dropped_brownout", count - cap)
+            self._journal_drop("brownout", [
+                {"meta": m, "enqueue_ts": ts, "priority": None}
+                for m, ts in zip(shed_metas, shed_ts)],
+                level=self._brownout_level)
+            count = cap
         accounted = False
         try:
             # Bucketed dispatch: slice the padded staging array down to the
@@ -550,7 +817,12 @@ class RecognizerService:
             if packed is None:
                 # Retries exhausted or the error was permanent (poisoned
                 # batch): abandoned, not published — but still completed
-                # for drain() accounting.
+                # for drain() accounting (and an explicit per-frame drop
+                # in the admission ledger + journal).
+                self.metrics.incr("frames_failed", count)
+                self._journal_drop("failed", [
+                    {"meta": m, "enqueue_ts": ts, "priority": None}
+                    for m, ts in zip(metas[:count], batch.enqueue_ts[:count])])
                 self._mark_completed()
                 accounted = True
                 self.batcher.recycle(frames)
@@ -561,15 +833,17 @@ class RecognizerService:
             self.metrics.observe("dispatch", t_disp - t0)
             deadline = time.monotonic() + self.resilience.readback_deadline_s
             with self._inflight_cv:
-                self._inflight.append((packed, frames, metas, count, t0,
-                                       t_disp, deadline))
+                self._inflight.append((packed, frames, metas, count,
+                                       batch.enqueue_ts, t0, t_disp, deadline))
                 accounted = True
                 self._inflight_cv.notify_all()
         except BaseException:
             if not accounted:
                 # The popped batch dies with this crash; settle it so
                 # drain()'s delivered==completed stays solvable after the
-                # supervisor restarts the loop.
+                # supervisor restarts the loop — and its frames land in
+                # the ledger's crash bucket, not in limbo.
+                self.metrics.incr("frames_dropped_crashed", count)
                 self._mark_completed()
             raise
         self.metrics.incr("batches_dispatched")
@@ -710,14 +984,31 @@ class RecognizerService:
 
         return probe_for_recovery(timeout_s=self.resilience.probe_timeout_s)
 
-    def _dead_letter(self, count: int) -> None:
+    def _dead_letter(self, count: int, metas: Optional[List[Any]] = None,
+                     enqueue_ts: Optional[List[float]] = None) -> None:
         """Abandon a batch whose readback outlived its deadline: counted,
         announced, completed — never blocked on (SURVEY.md §5.3: an
-        unhealthy accelerator degrades the job, never wedges it)."""
+        unhealthy accelerator degrades the job, never wedges it). The
+        status message carries the dead frames' ids (their ``meta``) and
+        enqueue timestamps so producers can retry, and the same entries
+        land in the dead-letter journal."""
         self.metrics.incr("batches_dead_lettered")
         self.metrics.incr("frames_dead_lettered", count)
         self._mark_completed()
-        self._publish_status({"status": "dead_letter", "frames": count})
+        entries = [{
+            "meta": metas[i] if metas is not None else None,
+            "enqueue_ts": (enqueue_ts[i]
+                           if enqueue_ts is not None and i < len(enqueue_ts)
+                           else None),
+            "priority": None,
+        } for i in range(count)]
+        self._journal_drop("dead_letter", entries)
+        self._publish_status({
+            "status": "dead_letter",
+            "frames": count,
+            "frame_ids": [e["meta"] for e in entries],
+            "enqueued_at": [e["enqueue_ts"] for e in entries],
+        })
 
     @staticmethod
     def _is_ready(packed) -> bool:
@@ -759,8 +1050,8 @@ class RecognizerService:
                     if not self._running:
                         return
                     continue
-                packed, frames, metas, count, t0, t_disp, deadline = \
-                    self._inflight[0]
+                packed, frames, metas, count, enqueue_ts, t0, t_disp, \
+                    deadline = self._inflight[0]
             try:
                 ready = self._await_ready(packed, deadline)
             except Exception:  # noqa: BLE001 — outage at the readback side
@@ -780,9 +1071,10 @@ class RecognizerService:
                 # read of this exact host array may still be pending —
                 # reusing it would race the outage we just survived. The
                 # pool refills from completed batches.
-                self._dead_letter(count)
+                self._dead_letter(count, metas, enqueue_ts)
                 continue
-            self._complete_head(packed, frames, metas, count, t0, t_disp)
+            self._complete_head(packed, frames, metas, count, enqueue_ts,
+                                t0, t_disp)
 
     def _await_ready(self, packed, deadline: float) -> bool:
         """Wait for one batch's transfer, bounded by its deadline. Returns
@@ -828,7 +1120,8 @@ class RecognizerService:
         deadline — never an unbounded blocking readback a hang-mode outage
         could wedge."""
         while self._inflight:
-            packed, frames, metas, count, t0, t_disp, deadline = self._inflight[0]
+            packed, frames, metas, count, enqueue_ts, t0, t_disp, deadline = \
+                self._inflight[0]
             ready = self._is_ready(packed)
             if not ready:
                 if time.monotonic() >= deadline:
@@ -836,7 +1129,7 @@ class RecognizerService:
                     # an async read on this staging buffer (see the worker
                     # path's dead-letter note).
                     self._pop_inflight_head()
-                    self._dead_letter(count)
+                    self._dead_letter(count, metas, enqueue_ts)
                     continue
                 if not (force or len(self._inflight) > self.inflight_depth):
                     break
@@ -847,12 +1140,14 @@ class RecognizerService:
                     ready = self._is_ready(packed)
                 if not ready:
                     self._pop_inflight_head()
-                    self._dead_letter(count)  # no recycle: see above
+                    self._dead_letter(count, metas, enqueue_ts)  # no recycle
                     continue
             self._pop_inflight_head()
-            self._complete_head(packed, frames, metas, count, t0, t_disp)
+            self._complete_head(packed, frames, metas, count, enqueue_ts,
+                                t0, t_disp)
 
-    def _complete_head(self, packed, frames, metas, count, t0, t_disp) -> None:
+    def _complete_head(self, packed, frames, metas, count, enqueue_ts,
+                       t0, t_disp) -> None:
         """Materialize + publish one POPPED batch and settle its accounting
         — the shared tail of the readback worker and the fallback drain
         (the two paths must stay behaviorally identical apart from
@@ -879,7 +1174,8 @@ class RecognizerService:
             logging.getLogger(__name__).exception(
                 "readback materialize failed")
             self.metrics.incr("readback_errors")
-            self._dead_letter(count)  # completed++, no recycle (see above)
+            # completed++, no recycle (see above)
+            self._dead_letter(count, metas, enqueue_ts)
             return
         self.metrics.observe("ready_wait", time.perf_counter() - t_disp)
         t_pub = time.perf_counter()
@@ -905,36 +1201,48 @@ class RecognizerService:
     def _publish(self, packed, frames, metas, count) -> None:
         from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
 
-        result = unpack_result(np.asarray(packed), self.pipeline.top_k)  # no-op if already host
-        boxes = result.boxes
-        det_scores = result.det_scores
-        valid = result.valid
-        labels = result.labels
-        sims = result.similarities
-        for i in range(count):
-            faces = []
-            for j in range(boxes.shape[1]):
-                if not valid[i, j]:
-                    continue
-                sim = float(sims[i, j, 0])
-                label = int(labels[i, j, 0])
-                known = sim >= self.similarity_threshold and label >= 0
-                name = (
-                    self.subject_names[label]
-                    if known and label < len(self.subject_names)
-                    else ("unknown" if not known else str(label))
-                )
-                y0, x0, y1, x1 = (float(v) for v in boxes[i, j])
-                faces.append({
-                    "box": [x0, y0, x1, y1],  # x-first, like the reference API
-                    "detection_score": float(det_scores[i, j]),
-                    "label": label if known else -1,
-                    "name": name,
-                    "similarity": sim,
-                })
-            self._maybe_collect_enrolment(frames[i], faces)
-            self.connector.publish(RESULT_TOPIC, {"meta": metas[i], "faces": faces})
-            self.metrics.incr("faces_found", len(faces))
+        published = 0
+        try:
+            result = unpack_result(np.asarray(packed), self.pipeline.top_k)  # no-op if already host
+            boxes = result.boxes
+            det_scores = result.det_scores
+            valid = result.valid
+            labels = result.labels
+            sims = result.similarities
+            for i in range(count):
+                faces = []
+                for j in range(boxes.shape[1]):
+                    if not valid[i, j]:
+                        continue
+                    sim = float(sims[i, j, 0])
+                    label = int(labels[i, j, 0])
+                    known = sim >= self.similarity_threshold and label >= 0
+                    name = (
+                        self.subject_names[label]
+                        if known and label < len(self.subject_names)
+                        else ("unknown" if not known else str(label))
+                    )
+                    y0, x0, y1, x1 = (float(v) for v in boxes[i, j])
+                    faces.append({
+                        "box": [x0, y0, x1, y1],  # x-first, like the reference API
+                        "detection_score": float(det_scores[i, j]),
+                        "label": label if known else -1,
+                        "name": name,
+                        "similarity": sim,
+                    })
+                self._maybe_collect_enrolment(frames[i], faces)
+                self.connector.publish(RESULT_TOPIC, {"meta": metas[i], "faces": faces})
+                published += 1
+                self.metrics.incr("faces_found", len(faces))
+        finally:
+            # Ledger settlement happens HERE, per batch, whatever exits:
+            # frames that made it out are completed; on a crash escaping
+            # mid-batch the remainder lands in the crash bucket (the
+            # publishing thread dies, the supervisor restarts it — the
+            # frames must not stay in limbo between those events).
+            self.metrics.incr("frames_completed", published)
+            if published < count:
+                self.metrics.incr("frames_dropped_crashed", count - published)
 
     # ---- enrolment (interactive-trainer protocol) ----
 

@@ -11,6 +11,10 @@ module extends that posture to the serving loop itself:
   keeps serving), and N consecutive dispatch failures flip the service into
   **degraded mode** (status published on ``STATUS_TOPIC``, optional bounded
   backend probe, optional CPU-fallback hook) instead of wedging.
+- ``BrownoutPolicy`` — the overload-degradation knobs (queue-wait EWMA
+  threshold, hysteresis, per-level shedding) the recognizer's brownout
+  controller runs on; the *client-side* sibling of ``ResiliencePolicy``'s
+  backend-side knobs (see ``runtime.admission`` for the front door).
 - ``is_transient_error`` — classifies an exception as retryable
   (backend/transport outage shaped) vs permanent (a poisoned batch: retrying
   a shape error burns the retry budget for nothing).
@@ -97,6 +101,56 @@ class ResiliencePolicy:
         """Backoff before retry ``attempt`` (0-based)."""
         return min(self.backoff_max_s,
                    self.backoff_base_s * self.backoff_multiplier ** attempt)
+
+
+@dataclass
+class BrownoutPolicy:
+    """Load-shedding degradation knobs for ``RecognizerService`` (the
+    overload layer's §2 — see the recognizer docstring's "Overload
+    protection" block).
+
+    The controller watches a queue-wait EWMA (frame enqueue -> batch pop:
+    the term that balloons first when offered load exceeds capacity).
+    Crossing ``queue_wait_s`` raises the brownout level (1, then 2 at the
+    next dwell); dropping below ``exit_ratio * queue_wait_s`` lowers it.
+    The asymmetric thresholds plus the ``dwell_s`` minimum between
+    transitions are the hysteresis — a load hovering at the threshold must
+    not flap the service in and out of brownout every batch.
+
+    Degradation per level:
+
+    - level 1: bulk-priority frames are skip-``bulk_skip`` shed at intake
+      (keep one of every ``bulk_skip``), reason ``brownout``;
+    - level 2 (``max_level``): ALL bulk frames shed at intake, and the
+      dispatch bucket ladder is capped at its smallest rung — an
+      oversized partial batch is trimmed to one small fast device call
+      (the trimmed frames shed with reason ``brownout``), keeping
+      per-batch latency low for the interactive traffic that remains.
+
+    Interactive frames are never shed by the INTAKE skip (levels 1-2 drop
+    only bulk there). The level-2 ladder trim, however, is class-blind: a
+    popped batch carries no per-frame priority, so when interactive
+    traffic alone still overfills the smallest bucket (bulk is already
+    gone at intake by then), the trimmed excess is interactive — counted
+    and journaled under the same explicit ``brownout`` reason so
+    producers can retry. Keeping interactive loss at zero is the
+    admission bound's job (``max_inflight_frames`` with its interactive
+    reserve), not the brownout's.
+    """
+
+    #: queue-wait EWMA (seconds) above which the brownout level rises.
+    queue_wait_s: float = 0.25
+    #: the level drops once the EWMA falls below ``exit_ratio *
+    #: queue_wait_s`` (hysteresis band).
+    exit_ratio: float = 0.5
+    #: minimum seconds between level changes (both directions).
+    dwell_s: float = 0.5
+    #: highest level (2 = shed-all-bulk + capped bucket ladder).
+    max_level: int = 2
+    #: level 1 keeps one of every ``bulk_skip`` bulk frames.
+    bulk_skip: int = 2
+    #: EWMA smoothing for the queue-wait signal.
+    ewma_alpha: float = 0.3
 
 
 def rebuild_pipeline_on_cpu(service) -> None:

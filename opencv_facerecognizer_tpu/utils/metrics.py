@@ -51,6 +51,24 @@ class Metrics:
         with self._lock:
             return dict(self._counters)
 
+    def counters_with_prefix(self, prefix: str) -> Dict[str, float]:
+        """Atomic snapshot of the counters under one namespace — e.g.
+        ``frames_rejected_``, the admission layer's per-reason rejects,
+        which the overload soak/bench report grouped this way."""
+        with self._lock:
+            return {k: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
+    def sum_counters(self, positive, negative=()) -> float:
+        """Atomic ``sum(positive) - sum(negative)`` over counter names —
+        one lock acquisition, no dict copy. The admission bound reads its
+        in-system count through this on every offered frame, so it must
+        stay allocation-free under flood load."""
+        with self._lock:
+            c = self._counters
+            return (sum(c.get(n, 0.0) for n in positive)
+                    - sum(c.get(n, 0.0) for n in negative))
+
     def percentile(self, name: str, q: float) -> float:
         with self._lock:
             values = sorted(self._latencies.get(name, ()))

@@ -23,10 +23,18 @@ The fast deterministic variant (``--seconds 2 --seed 7``) runs in tier-1
 via ``tests/test_chaos.py``; the long randomized soak is the ``slow``-
 marked test (or run this script directly).
 
+``--scenario overload`` runs the overload-protection soak instead: a
+seed-logged ``receive: flood`` fault amplifies a mixed interactive/bulk
+stream to ~4x a deterministic fake backend's capacity, and the run passes
+only if the admission/brownout/journal stack sheds explicitly (no wedge,
+no crash, interactive p99 within 2x unloaded, exact admission ledger,
+journal covering every shed) — see ``run_overload``.
+
 Usage::
 
     python scripts/chaos_soak.py --seconds 30            # random seed
     python scripts/chaos_soak.py --seconds 30 --seed 7   # replay
+    python scripts/chaos_soak.py --scenario overload --seconds 6
 """
 
 from __future__ import annotations
@@ -158,6 +166,11 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
             time.sleep(0.05)
         results = connector.messages(RESULT_TOPIC)
         wedged = len(probe_results) < probe_n
+        # Quiesce once more, then read the admission ledger while the
+        # service is still up: every admitted frame must sit in exactly
+        # one bucket (completed or a named drop reason) — in_system == 0.
+        ledger_quiesced = service.drain(timeout=15.0)
+        ledger = service.ledger()
     finally:
         supervisor.stop()
 
@@ -166,6 +179,7 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
     report["results"] = len(results)
     report["injected"] = injector.summary()
     report["counters"] = counters
+    report["ledger"] = ledger
     report["supervisor_restarts"] = supervisor.restarts
 
     failures = []
@@ -185,6 +199,230 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
     if delivered != accounted:
         failures.append(f"accounting: delivered={delivered} != "
                         f"dispatched+failed={accounted}")
+    # Admission ledger (ISSUE 3 invariant): at quiescence every admitted
+    # frame is completed or in exactly one named drop bucket. Only checked
+    # when the final drain actually quiesced — an un-drained service has
+    # frames legitimately in flight (and is already flagged wedged above
+    # if the probe stalled too).
+    if ledger_quiesced and abs(ledger["in_system"]) > 1e-6:
+        failures.append(f"ledger: admitted={ledger['admitted']} != "
+                        f"completed={ledger['completed']} + drops="
+                        f"{ledger['drops_by_reason']} "
+                        f"(in_system={ledger['in_system']})")
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
+def run_overload(seconds: float = 6.0, seed: int | None = None,
+                 journal_path: str | None = None) -> dict:
+    """Overload scenario (ISSUE 3 acceptance): a ~4x offered-load flood —
+    seed-logged ``receive: flood`` fault amplifying a mixed interactive/
+    bulk stream — against the full overload-protection stack (admission
+    bound, priority shedding, brownout, stale drops, dead-letter journal)
+    over a deterministic capacity-limited fake backend.
+
+    Pass criteria (any miss -> ``ok: False``):
+
+    1. **no wedge** — post-flood liveness probe completes;
+    2. **no crash** — ``loop_crashes == 0``;
+    3. **interactive latency held** — flood-phase interactive e2e p99 stays
+       within 2x the unloaded baseline (+50 ms scheduler-noise floor);
+    4. **bulk actually shed** — a 4x flood must produce explicit sheds;
+    5. **ledger exact** — at quiescence ``admitted == completed +
+       Σ drops_by_reason`` (every shed frame has a named reason);
+    6. **journal covers the sheds** — journaled frame count equals the
+       shed/dead-letter counters it mirrors.
+    """
+    import random as random_mod
+    import tempfile
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.runtime import (
+        DeadLetterJournal, FaultInjector, ServiceSupervisor,
+    )
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        TrafficRecorder, build_overload_stack,
+    )
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak overload seed={seed} seconds={seconds}",
+          file=sys.stderr)
+
+    frame_shape = (32, 32)
+    batch_size = 8
+    dispatch_s = 0.04          # hard capacity: 8 / 0.04 = 200 frames/s
+    capacity_fps = batch_size / dispatch_s
+    flood_factor = 8
+    # Effective offered load ~= base * (1 + p*(factor-1)); p in [0.4, 0.6]
+    # from the logged seed lands the total at roughly 3-4.5x capacity.
+    rate_rng = random_mod.Random(seed)
+    flood_p = 0.4 + 0.2 * rate_rng.random()
+    base_hz = 4.0 * capacity_fps / (1.0 + flood_p * (flood_factor - 1))
+
+    injector = FaultInjector(seed=seed,
+                             rates={"receive": {"flood": flood_p}},
+                             flood_factor=flood_factor)
+    injector.disarm()  # armed only for the flood phase
+    temp_journal = journal_path is None
+    if temp_journal:
+        fd, journal_path = tempfile.mkstemp(prefix="ocvf_dead_letter_",
+                                            suffix=".jsonl")
+        os.close(fd)
+    journal = DeadLetterJournal(journal_path, max_bytes=1 << 20)
+    # The service-under-test: the canonical overload harness (shared with
+    # bench_serving.run_overload_sweep so both exercise one config).
+    pipeline, service, connector = build_overload_stack(
+        frame_shape=frame_shape, batch_size=batch_size,
+        dispatch_s=dispatch_s, fault_injector=injector, journal=journal)
+    supervisor = ServiceSupervisor(service, max_restarts=100,
+                                   poll_interval_s=0.05)
+    supervisor.start(warmup=False)
+
+    # Shared seq-tagged recorder (runtime.fakes.TrafficRecorder): the
+    # bench's overload_sweep measures through the same code.
+    recorder = TrafficRecorder(connector)
+    frame = np.zeros(frame_shape, np.float32)
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    frame_msg = encode_frame(frame)
+
+    def offer(seq, priority):
+        recorder.offer(connector, frame_msg, seq, priority)
+
+    report = {"scenario": "overload", "seed": seed, "seconds": seconds,
+              "flood_p": round(flood_p, 3), "flood_factor": flood_factor,
+              "capacity_fps": capacity_fps,
+              "offered_base_hz": round(base_hz, 1), "ok": False}
+    try:
+        # ---- phase A: unloaded interactive baseline ----
+        base_seqs = []
+        seq = 0
+        base_end = time.monotonic() + min(1.5, seconds)
+        while time.monotonic() < base_end:
+            offer(seq, "interactive")
+            base_seqs.append(seq)
+            seq += 1
+            time.sleep(1.0 / 40.0)
+        service.drain(timeout=15.0)
+        base_p99_ms = recorder.percentile_ms(base_seqs, 99)
+
+        # ---- phase B: the flood (seed-logged fault amplification) ----
+        injector.arm()
+        flood_interactive, flood_bulk = [], []
+        interval = 1.0 / base_hz
+        flood_end = time.monotonic() + seconds
+        i = 0
+        while time.monotonic() < flood_end:
+            if i % 10 == 0:
+                offer(seq, "interactive")
+                flood_interactive.append(seq)
+            else:
+                offer(seq, "bulk")
+                flood_bulk.append(seq)
+            seq += 1
+            i += 1
+            time.sleep(interval)
+        injector.disarm()
+
+        # ---- phase C: recovery, liveness probe, ledger ----
+        service.drain(timeout=max(15.0, 3.0 * seconds))
+        # Brownout must recover on its own once the flood stops (the
+        # hysteresis path) — and the probe below must run OUTSIDE
+        # brownout, or the level-2 ladder cap would legitimately trim
+        # probe frames and read as a false wedge.
+        recover_deadline = time.monotonic() + 15.0
+        while (service.brownout_level > 0
+               and time.monotonic() < recover_deadline):
+            time.sleep(0.05)
+        brownout_recovered = service.brownout_level == 0
+        probe_seqs = []
+        for _ in range(6):
+            offer(seq, "interactive")
+            probe_seqs.append(seq)
+            seq += 1
+        probe_deadline = time.monotonic() + 15.0
+        while time.monotonic() < probe_deadline:
+            if recorder.completed(probe_seqs) == len(probe_seqs):
+                break
+            time.sleep(0.05)
+        wedged = recorder.completed(probe_seqs) < len(probe_seqs)
+        quiesced = service.drain(timeout=15.0)
+        ledger = service.ledger()
+        flood_p99_ms = recorder.percentile_ms(flood_interactive, 99)
+        bulk_completed = recorder.completed(flood_bulk)
+    finally:
+        supervisor.stop()
+        journal.close()
+
+    counters = service.metrics.counters()
+    journaled = sum(len(r.get("frames", ())) for r in journal.records())
+    journal_expected = sum(counters.get(k, 0) for k in (
+        "frames_dead_lettered", "frames_failed", "frames_dropped_brownout",
+        "batcher_dropped_stale", "batcher_dropped_overflow"))
+    rejected = service.metrics.counters_with_prefix("frames_rejected_")
+    shed_total = journal_expected + sum(rejected.values())
+    if temp_journal:
+        for path in ([journal.path]
+                     + [f"{journal.path}.{i}" for i in range(1, 4)]):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _ms_or_none(value):
+        # NaN (no completions) must not leak into the JSON report —
+        # json.dumps would emit the non-RFC 'NaN' token.
+        return None if value != value else round(value, 1)
+
+    report.update({
+        "offered": seq,
+        "baseline_interactive_p99_ms": _ms_or_none(base_p99_ms),
+        "flood_interactive_p99_ms": _ms_or_none(flood_p99_ms),
+        "flood_bulk_offered": len(flood_bulk),
+        "flood_bulk_completed": bulk_completed,
+        "rejected": rejected,
+        "injected": injector.summary(),
+        "ledger": ledger,
+        "journal_frames": journaled,
+        "journal_path": journal.path,
+        "counters": counters,
+    })
+
+    report["brownout_recovered"] = brownout_recovered
+    failures = []
+    if wedged:
+        missing = [s for s in probe_seqs if s not in done_t]
+        failures.append(f"wedged: liveness probe missing {len(missing)} results")
+    if not brownout_recovered:
+        failures.append("brownout never recovered after the flood stopped")
+    if counters.get("loop_crashes", 0):
+        failures.append(f"crashed: loop_crashes={counters['loop_crashes']}")
+    # NaN percentiles mean zero completions in that phase — each is its
+    # own failure; the latency comparison only runs with both present (a
+    # NaN baseline must not let the criterion pass vacuously).
+    if base_p99_ms != base_p99_ms:
+        failures.append("no baseline interactive frame completed")
+    if flood_p99_ms != flood_p99_ms:
+        failures.append("no flood-phase interactive frame completed")
+    elif (base_p99_ms == base_p99_ms
+          and flood_p99_ms > 2.0 * base_p99_ms + 50.0):
+        failures.append(f"interactive p99 blew the budget: flood "
+                        f"{flood_p99_ms:.0f} ms > 2x baseline "
+                        f"{base_p99_ms:.0f} ms + 50 ms")
+    if shed_total <= 0:
+        failures.append("a 4x flood produced zero explicit sheds/rejects")
+    if quiesced and abs(ledger["in_system"]) > 1e-6:
+        failures.append(f"ledger: in_system={ledger['in_system']} != 0 "
+                        f"(admitted={ledger['admitted']}, "
+                        f"completed={ledger['completed']}, "
+                        f"drops={ledger['drops_by_reason']})")
+    if not quiesced:
+        failures.append("final drain never quiesced")
+    if journaled != journal_expected:
+        failures.append(f"journal: {journaled} frames journaled != "
+                        f"{journal_expected} counted sheds")
     report["failures"] = failures
     report["ok"] = not failures
     return report
@@ -195,8 +433,20 @@ def main(argv=None) -> int:
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--seed", type=int, default=None,
                         help="replay a previous run exactly (logged on stderr)")
+    parser.add_argument("--scenario", choices=["soak", "overload"],
+                        default="soak",
+                        help="soak: randomized fault soak (default); "
+                             "overload: 4x flood against the admission/"
+                             "brownout/journal stack (run_overload)")
+    parser.add_argument("--journal", default=None,
+                        help="overload scenario: write the dead-letter "
+                             "journal here instead of a temp file")
     args = parser.parse_args(argv)
-    report = run_soak(seconds=args.seconds, seed=args.seed)
+    if args.scenario == "overload":
+        report = run_overload(seconds=args.seconds, seed=args.seed,
+                              journal_path=args.journal)
+    else:
+        report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))
     return 0 if report["ok"] else 2
 

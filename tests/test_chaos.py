@@ -412,6 +412,19 @@ def test_fault_injector_seeded_rates_reproducible():
     assert any(outcomes[0]) and not all(outcomes[0])
 
 
+def test_fault_injector_flood_amplifies_delivery():
+    fi = FaultInjector(seed=0, flood_factor=4)
+    fi.script("receive", "flood")
+    msg = {"__frame__": "x", "shape": [1], "dtype": "uint8", "meta": 3}
+    assert fi.on_receive(msg) == [msg] * 4
+    assert fi.on_receive(msg) == [msg]  # script exhausted -> passthrough
+    assert fi.summary() == {"receive:flood": 1}
+    # Rates accept it too (the overload soak's knob).
+    fi2 = FaultInjector(seed=1, rates={"receive": {"flood": 1.0}},
+                        flood_factor=3)
+    assert fi2.on_receive(msg) == [msg] * 3
+
+
 def test_fault_injector_disarm():
     fi = FaultInjector(seed=0, rates={"dispatch": {"unavailable": 1.0}})
     fi.script("readback", "stuck")
@@ -458,16 +471,45 @@ def test_probe_for_recovery_injectable_and_bounded():
 
 def test_chaos_soak_fast_deterministic():
     """Tier-1 variant: short chaos window, pinned seed — rc-0 semantics of
-    scripts/chaos_soak.py (no wedge, no unsupervised crash, accounting)."""
+    scripts/chaos_soak.py (no wedge, no unsupervised crash, accounting,
+    and the admission ledger reconciling exactly at quiescence)."""
     report = chaos_soak.run_soak(seconds=1.5, seed=7)
     assert report["ok"], report["failures"]
     assert report["seed"] == 7
     assert report["results"] > 0
+    assert report["ledger"]["in_system"] == 0
+
+
+def test_overload_soak_fast_deterministic():
+    """Tier-1 overload smoke: the ``--scenario overload`` flood soak
+    (seed-logged receive:flood amplification to ~4x a deterministic
+    capacity wall) passes its whole criteria set — no wedge, no crash,
+    interactive p99 within 2x unloaded, explicit sheds, exact ledger,
+    journal covering every shed."""
+    report = chaos_soak.run_overload(seconds=2.0, seed=7)
+    assert report["ok"], report["failures"]
+    # Under ~4x offered load bulk must actually shed (reject or brownout).
+    shed = (sum(report["rejected"].values())
+            + sum(report["ledger"]["drops_by_reason"].values()))
+    assert shed > 0
+    assert report["ledger"]["in_system"] == 0
+    # Every journaled frame carries its reason (replayable).
+    assert report["journal_frames"] == sum(
+        report["counters"].get(k, 0) for k in (
+            "frames_dead_lettered", "frames_failed",
+            "frames_dropped_brownout", "batcher_dropped_stale",
+            "batcher_dropped_overflow"))
 
 
 @pytest.mark.slow
 def test_chaos_soak_long_randomized():
     report = chaos_soak.run_soak(seconds=30.0)
+    assert report["ok"], report["failures"]
+
+
+@pytest.mark.slow
+def test_overload_soak_long_randomized():
+    report = chaos_soak.run_overload(seconds=15.0)
     assert report["ok"], report["failures"]
 
 

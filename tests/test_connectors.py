@@ -136,6 +136,115 @@ def test_socket_connector_stalled_client_dropped_not_wedging():
     server.stop()
 
 
+def test_socket_stalled_client_drop_counted_on_metrics():
+    """The deadline-bounded send path (`_send_deadline_s`) counts each
+    evicted stalled client as ``connector_stalled_clients_dropped`` on the
+    shared Metrics surface — the ledger a stats consumer reads."""
+    import socket as socket_mod
+
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    server = SocketConnector(listen=True, metrics=m)
+    server._send_deadline_s = 0.25
+    server.start()
+    try:
+        stalled = socket_mod.create_connection(("127.0.0.1", server.port))
+        stalled.setsockopt(socket_mod.SOL_SOCKET, socket_mod.SO_RCVBUF, 1024)
+        deadline = time.monotonic() + 5
+        while not server._client_socks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        with server._lock:
+            for sock in server._client_socks:
+                sock.setsockopt(socket_mod.SOL_SOCKET,
+                                socket_mod.SO_SNDBUF, 4096)
+        blob = "x" * 65536
+        for i in range(8):
+            server.publish("results", {"seq": i, "blob": blob})
+            if m.counter("connector_stalled_clients_dropped"):
+                break
+        assert m.counter("connector_stalled_clients_dropped") == 1
+        with server._lock:
+            assert server._client_socks == []  # evicted
+        stalled.close()
+    finally:
+        server.stop()
+
+
+def test_socket_client_reconnects_after_server_blip():
+    """Satellite: ``SocketConnector(listen=False)`` used to die permanently
+    when the server dropped the connection. Now it redials with bounded
+    exponential backoff, counts ``connector_reconnects``, and keeps
+    round-tripping on the new connection; ``eof`` stays unset."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    server = SocketConnector(listen=True)
+    received = []
+    server.subscribe("frames", lambda t, msg: received.append(msg))
+    server.start()
+    port = server.port
+
+    m = Metrics()
+    client = SocketConnector(port=port, metrics=m,
+                             reconnect_backoff_base_s=0.02)
+    client.start()
+    server2 = None
+    try:
+        client.publish("frames", {"seq": 1})
+        deadline = time.monotonic() + 5
+        while not received and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert received == [{"seq": 1}]
+
+        # Server blip: tear it down, then resurrect on the SAME port.
+        server.stop()
+        server2 = SocketConnector(host="127.0.0.1", port=port, listen=True)
+        server2.subscribe("frames", lambda t, msg: received.append(msg))
+        server2.start()
+
+        deadline = time.monotonic() + 10
+        while (m.counter("connector_reconnects") < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert m.counter("connector_reconnects") == 1
+        assert m.counter("connector_peer_disconnects") == 1
+        assert not client.eof.is_set()
+
+        # The reconnected session round-trips.
+        deadline = time.monotonic() + 5
+        while len(received) < 2 and time.monotonic() < deadline:
+            client.publish("frames", {"seq": 2})
+            time.sleep(0.05)
+        assert received[-1] == {"seq": 2}
+    finally:
+        client.stop()
+        if server2 is not None:
+            server2.stop()
+
+
+def test_socket_client_reconnect_budget_bounded_then_eof():
+    """With the server gone for good, the client retries exactly its
+    bounded budget (counting failures), then sets ``eof`` — no infinite
+    redial loop, no permanent zombie."""
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    server = SocketConnector(listen=True)
+    server.start()
+    m = Metrics()
+    client = SocketConnector(port=server.port, metrics=m,
+                             reconnect_attempts=2,
+                             reconnect_backoff_base_s=0.02,
+                             reconnect_backoff_max_s=0.05)
+    client.start()
+    try:
+        server.stop()  # and never comes back
+        assert client.eof.wait(timeout=10.0), "client never gave up"
+        assert m.counter("connector_reconnect_failures") == 2
+        assert m.counter("connector_reconnects") == 0
+    finally:
+        client.stop()
+
+
 _CHILD_ECHO = """
 import sys
 sys.path.insert(0, {root!r})

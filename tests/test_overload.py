@@ -1,0 +1,508 @@
+"""Overload-protection suite (ISSUE 3): admission control at
+connector-receive, priority-aware + stale shedding in the batcher, the
+brownout controller's hysteresis, the durable dead-letter journal, and the
+admission-ledger invariant ``admitted == completed + Σ drops_by_reason``.
+
+Everything here runs over ``runtime.fakes.InstantPipeline`` (deterministic,
+no hardware) — the overload layer is pure host-side control flow.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.runtime import (
+    PRIORITY_BULK,
+    PRIORITY_INTERACTIVE,
+    AdmissionController,
+    BrownoutPolicy,
+    DeadLetterJournal,
+    FakeConnector,
+    FrameBatcher,
+    RecognizerService,
+    ResiliencePolicy,
+    TokenBucket,
+    parse_priority,
+)
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    FRAME_TOPIC,
+    RESULT_TOPIC,
+    STATUS_TOPIC,
+)
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+FRAME_HW = (16, 16)
+
+
+def _wait(cond, timeout=10.0, interval=0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _frame():
+    return np.zeros(FRAME_HW, np.float32)
+
+
+def _service(pipeline=None, **kwargs):
+    pipeline = pipeline or InstantPipeline(FRAME_HW)
+    connector = FakeConnector()
+    kwargs.setdefault("batch_size", 4)
+    kwargs.setdefault("resilience", ResiliencePolicy(readback_deadline_s=2.0))
+    service = RecognizerService(
+        pipeline, connector, frame_shape=FRAME_HW,
+        flush_timeout=0.02, similarity_threshold=0.0, **kwargs,
+    )
+    return pipeline, service, connector
+
+
+# ---------- priority parsing + token bucket ----------
+
+
+def test_parse_priority_wire_forms():
+    assert parse_priority(None) == PRIORITY_INTERACTIVE
+    assert parse_priority("interactive") == PRIORITY_INTERACTIVE
+    assert parse_priority("Bulk") == PRIORITY_BULK
+    assert parse_priority("enroll") == PRIORITY_BULK
+    assert parse_priority(3) == 3
+    assert parse_priority(-2) == 0  # clamped
+    assert parse_priority("garbage") == PRIORITY_INTERACTIVE  # safe default
+    assert parse_priority(object()) == PRIORITY_INTERACTIVE
+
+
+def test_token_bucket_rate_and_burst():
+    tb = TokenBucket(rate=1000.0, burst=3)
+    assert tb.try_acquire() and tb.try_acquire() and tb.try_acquire()
+    assert not tb.try_acquire()  # burst spent, no time passed
+    time.sleep(0.01)  # ~10 tokens refill at 1000/s
+    assert tb.try_acquire()
+
+
+def test_admission_controller_reasons_and_reserve():
+    inflight = {"n": 0}
+    a = AdmissionController(max_inflight_frames=100,
+                            rate_limit_fps=None,
+                            interactive_reserve=0.25,
+                            inflight_fn=lambda: inflight["n"])
+    assert a.admit("t", PRIORITY_INTERACTIVE) is None
+    # Bulk loses admission at 75% of the bound; interactive keeps headroom.
+    inflight["n"] = 80
+    assert a.admit("t", PRIORITY_BULK) == "overload"
+    assert a.admit("t", PRIORITY_INTERACTIVE) is None
+    inflight["n"] = 100
+    assert a.admit("t", PRIORITY_INTERACTIVE) == "overload"
+    # Rate limit: burst of 1s x 50fps, then rejections.
+    r = AdmissionController(rate_limit_fps=50.0, burst_seconds=1.0)
+    admitted = sum(r.admit("t") is None for _ in range(200))
+    assert 45 <= admitted <= 60  # the burst, ± refill during the loop
+    assert r.admit("t") == "rate_limit"
+
+
+# ---------- batcher: priority-aware + stale shedding ----------
+
+
+def test_batcher_overflow_evicts_lowest_priority_first():
+    m = Metrics()
+    drops = []
+    b = FrameBatcher(2, FRAME_HW, flush_timeout=10.0, max_pending=3,
+                     metrics=m, drop_log=lambda r, e: drops.append((r, e)))
+    assert b.put(_frame(), meta="bulk0", priority=PRIORITY_BULK)
+    assert b.put(_frame(), meta="inter0", priority=PRIORITY_INTERACTIVE)
+    assert b.put(_frame(), meta="bulk1", priority=PRIORITY_BULK)
+    # Full; an interactive arrival evicts the OLDEST bulk, not the oldest
+    # frame overall.
+    assert b.put(_frame(), meta="inter1", priority=PRIORITY_INTERACTIVE)
+    assert m.counter("batcher_dropped_overflow") == 1
+    assert drops == [("overflow", [{"meta": "bulk0", "enqueue_ts": drops[0][1][0]["enqueue_ts"],
+                                    "priority": PRIORITY_BULK}])]
+    batch = b.get_batch(block=False)
+    assert batch.metas[:2] == ["inter0", "bulk1"]  # FIFO among survivors
+
+
+def test_batcher_overflow_rejects_incoming_bulk_when_queue_outranks_it():
+    m = Metrics()
+    b = FrameBatcher(2, FRAME_HW, flush_timeout=10.0, max_pending=2, metrics=m)
+    assert b.put(_frame(), meta="i0", priority=PRIORITY_INTERACTIVE)
+    assert b.put(_frame(), meta="i1", priority=PRIORITY_INTERACTIVE)
+    # Everything queued outranks the incoming bulk frame: IT is the victim.
+    assert not b.put(_frame(), meta="b", priority=PRIORITY_BULK)
+    assert m.counter("batcher_dropped_overflow") == 1
+    assert b.stats["dropped_overflow"] == 1
+    batch = b.get_batch(block=False)
+    assert batch.metas[:2] == ["i0", "i1"]  # untouched
+
+
+def test_batcher_overflow_without_priorities_keeps_drop_oldest():
+    # Backward compatibility: all-default priorities degrade to the old
+    # freshness-over-backlog rule (oldest evicted).
+    b = FrameBatcher(2, FRAME_HW, flush_timeout=10.0, max_pending=3)
+    for i in range(5):
+        b.put(_frame(), meta=i)
+    batch = b.get_batch(block=False)
+    assert b.stats["dropped_overflow"] == 2
+    assert batch.metas[:2] == [2, 3]
+
+
+def test_batcher_stale_frames_never_reach_a_dispatch_slot():
+    m = Metrics()
+    drops = []
+    b = FrameBatcher(4, FRAME_HW, flush_timeout=0.01, stale_after_s=0.05,
+                     metrics=m, drop_log=lambda r, e: drops.append((r, e)))
+    b.put(_frame(), meta="doomed")
+    time.sleep(0.08)  # past the freshness bound
+    b.put(_frame(), meta="fresh")
+    batch = b.get_batch()
+    assert batch.count == 1 and batch.metas[0] == "fresh"
+    assert m.counter("batcher_dropped_stale") == 1
+    assert b.stats["dropped_stale"] == 1
+    assert drops[0][0] == "stale" and drops[0][1][0]["meta"] == "doomed"
+
+
+def test_batcher_stale_eviction_preferred_at_overflow():
+    b = FrameBatcher(2, FRAME_HW, flush_timeout=10.0, max_pending=2,
+                     stale_after_s=0.05)
+    b.put(_frame(), meta="stale-soon", priority=PRIORITY_INTERACTIVE)
+    time.sleep(0.08)
+    b.put(_frame(), meta="fresh-bulk", priority=PRIORITY_BULK)
+    # Queue full; the stale interactive frame is the victim even though a
+    # bulk frame is queued (dead weight goes first, whatever its class).
+    assert b.put(_frame(), meta="new", priority=PRIORITY_BULK)
+    assert b.stats["dropped_stale"] == 1
+    assert b.stats["dropped_overflow"] == 0
+    batch = b.get_batch(block=False)
+    assert batch.metas[:2] == ["fresh-bulk", "new"]
+
+
+# ---------- dead-letter journal ----------
+
+
+def test_journal_append_records_and_replay(tmp_path):
+    m = Metrics()
+    j = DeadLetterJournal(str(tmp_path / "dl.jsonl"), metrics=m)
+    j.append("dead_letter", [DeadLetterJournal.frame_entry({"seq": 1}, 2.5, 0),
+                             DeadLetterJournal.frame_entry({"seq": 2}, 2.6, 1)])
+    j.append("brownout", [DeadLetterJournal.frame_entry({"seq": 3})], level=2)
+    records = list(j.records())
+    assert [r["reason"] for r in records] == ["dead_letter", "brownout"]
+    assert records[0]["frames"][0] == {"meta": {"seq": 1}, "enqueue_ts": 2.5,
+                                       "priority": 0}
+    assert records[1]["level"] == 2
+    assert m.counter("journal_records") == 2
+    assert m.counter("journal_frames") == 3
+    replayed = []
+    n = j.replay(lambda e: replayed.append((e["reason"], e["meta"]["seq"])))
+    assert n == 3
+    assert replayed == [("dead_letter", 1), ("dead_letter", 2), ("brownout", 3)]
+    assert j.replay(lambda e: None, reasons=("brownout",)) == 1
+    j.close()
+
+
+def test_journal_rotation_bounded(tmp_path):
+    path = tmp_path / "dl.jsonl"
+    j = DeadLetterJournal(str(path), max_bytes=300, backups=1)
+    for i in range(50):
+        j.append("stale", [DeadLetterJournal.frame_entry({"seq": i})])
+    j.close()
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert files == ["dl.jsonl", "dl.jsonl.1"]  # bounded: exactly 1 backup
+    assert path.stat().st_size <= 300 + 120  # one record of slack
+    # Oldest-first replay across the rotation boundary.
+    seqs = [r["frames"][0]["meta"]["seq"] for r in
+            DeadLetterJournal(str(path), backups=1).records()]
+    assert seqs == sorted(seqs) and seqs[-1] == 49
+
+
+def test_journal_failures_never_raise(tmp_path):
+    m = Metrics()
+    j = DeadLetterJournal(str(tmp_path / "dl.jsonl"), metrics=m)
+    j.append("failed", [DeadLetterJournal.frame_entry(object())])  # unserializable meta
+    assert list(j.records())  # repr-encoded, not lost
+    j.close()
+
+
+# ---------- service: admission + rejection statuses ----------
+
+
+def test_service_rejects_explicitly_with_aggregated_status():
+    _, service, connector = _service(
+        admission=AdmissionController(max_inflight_frames=200,
+                                      rate_limit_fps=25.0, burst_seconds=0.2))
+    service._reject_note_interval_s = 0.0  # publish every rejection window
+    service.start(warmup=False)
+    try:
+        for i in range(60):
+            connector.inject(FRAME_TOPIC, {"frame": _frame(),
+                                           "meta": {"seq": i}})
+        assert _wait(lambda: service.metrics.counter(
+            "frames_rejected_rate_limit") > 0)
+        assert service.drain(10.0)
+    finally:
+        service.stop()
+    c = service.metrics.counters()
+    assert c["frames_rejected_rate_limit"] > 0
+    # Explicit backpressure: 'rejected' statuses with the reason, counts
+    # aggregated (sum over statuses == rejected counter).
+    rejected = [m for m in connector.messages(STATUS_TOPIC)
+                if m.get("status") == "rejected"]
+    assert rejected and all(m["reason"] == "rate_limit" for m in rejected)
+    assert sum(m["count"] for m in rejected) == c["frames_rejected_rate_limit"]
+    # Ledger: rejections live OUTSIDE (never admitted); what was admitted
+    # reconciles exactly.
+    ledger = service.ledger()
+    assert ledger["admitted"] == 60 - c["frames_rejected_rate_limit"]
+    assert ledger["in_system"] == 0
+
+
+def test_service_admission_bound_sheds_bulk_before_interactive():
+    pipeline = InstantPipeline(FRAME_HW, dispatch_s=0.02)  # 200 fps capacity
+    _, service, connector = _service(
+        pipeline=pipeline,
+        admission=AdmissionController(max_inflight_frames=8),
+        inflight_depth=2)
+    service.start(warmup=False)
+    try:
+        # Burst far beyond the bound: mixed priorities.
+        for i in range(120):
+            pri = "interactive" if i % 2 == 0 else "bulk"
+            connector.inject(FRAME_TOPIC, {"frame": _frame(), "priority": pri,
+                                           "meta": {"seq": i, "pri": pri}})
+        assert service.drain(20.0)
+    finally:
+        service.stop()
+    c = service.metrics.counters()
+    assert c.get("frames_rejected_overload", 0) > 0
+    done = [m["meta"]["pri"] for m in connector.messages(RESULT_TOPIC)]
+    # The 25% interactive reserve must have bought interactive more
+    # completions than bulk under the same offered load.
+    assert done.count("interactive") > done.count("bulk")
+    assert service.ledger()["in_system"] == 0
+
+
+# ---------- service: brownout controller ----------
+
+
+def test_brownout_enters_sheds_bulk_and_recovers_with_hysteresis():
+    # Deliberately NOT started: the brownout controller is pure host-side
+    # logic (connector handlers dispatch synchronously on the fake), so
+    # driving the load signal directly keeps every assertion deterministic
+    # — a running loop's idle ticks would decay the EWMA under us.
+    _, service, connector = _service(
+        batch_size=64,  # nothing flushes; frames just queue
+        brownout=BrownoutPolicy(queue_wait_s=0.05, exit_ratio=0.5,
+                                dwell_s=10.0, bulk_skip=2, max_level=2))
+    service._note_queue_wait(0.2)  # EWMA seeds above the threshold
+    assert service.brownout_level == 1  # dwell now blocks level 2
+    assert service.metrics.gauge("brownout_level") == 1
+    # Level 1: bulk is skip-2 shed at intake, interactive untouched.
+    for i in range(8):
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "priority": "bulk",
+                                       "meta": {"seq": i}})
+    for i in range(4):
+        connector.inject(FRAME_TOPIC, {"frame": _frame(),
+                                       "priority": "interactive",
+                                       "meta": {"seq": 100 + i}})
+    assert service.metrics.counter("frames_dropped_brownout") == 4
+    # Hysteresis: an EWMA below the entry threshold but above the exit
+    # band (exit_ratio * threshold) must NOT recover.
+    service._brownout_changed_at = 0.0  # dwell elapsed
+    service._queue_wait_ewma = 0.04
+    service._update_brownout()
+    assert service.brownout_level == 1
+    # Below the exit band -> recovery.
+    service._queue_wait_ewma = 0.01
+    service._update_brownout()
+    assert service.brownout_level == 0
+    msgs = [m for m in connector.messages(STATUS_TOPIC)
+            if m.get("status", "").startswith("brownout")]
+    assert [m["status"] for m in msgs] == ["brownout", "brownout_recovered"]
+    assert msgs[0]["level"] == 1
+    assert service.metrics.gauge("brownout_level") == 0
+    # Live ledger: 12 admitted, 4 brownout-shed, 8 still queued (in
+    # system) — the remainder tracks un-quiesced frames exactly.
+    ledger = service.ledger()
+    assert ledger["admitted"] == 12
+    assert ledger["drops_by_reason"]["frames_dropped_brownout"] == 4
+    assert ledger["in_system"] == 8
+
+
+def test_brownout_max_level_sheds_all_bulk_and_caps_ladder():
+    pipeline, service, connector = _service(
+        brownout=BrownoutPolicy(queue_wait_s=0.05, dwell_s=0.01, max_level=2),
+        batch_size=8, bucket_sizes=(2, 8))
+    service.start(warmup=False)
+    try:
+        # Drive straight to max level.
+        for _ in range(3):
+            service._note_queue_wait(0.5)
+            time.sleep(0.02)
+        assert service.brownout_level == 2
+        # All bulk shed at intake now.
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "priority": "bulk",
+                                       "meta": {"b": 1}})
+        assert _wait(lambda: service.metrics.counter(
+            "frames_dropped_brownout") >= 1)
+        # An oversized interactive batch is trimmed to the smallest bucket
+        # (2): 5 admitted -> 2 served per batch, the excess shed with the
+        # explicit brownout reason — never silently truncated.
+        for i in range(5):
+            connector.inject(FRAME_TOPIC, {"frame": _frame(),
+                                           "priority": "interactive",
+                                           "meta": {"seq": i}})
+        assert service.drain(10.0)
+    finally:
+        service.stop()
+    assert all(b <= 2 for b in pipeline.batch_sizes_seen), \
+        pipeline.batch_sizes_seen
+    ledger = service.ledger()
+    assert ledger["in_system"] == 0
+    completed = len(connector.messages(RESULT_TOPIC))
+    assert completed == ledger["completed"]
+    assert (ledger["completed"]
+            + ledger["drops_by_reason"]["frames_dropped_brownout"]
+            == ledger["admitted"])
+
+
+def test_brownout_recovers_on_idle_queue():
+    """Traffic stopping dead must still recover the brownout level — the
+    idle tick feeds the EWMA zeros."""
+    _, service, connector = _service(
+        brownout=BrownoutPolicy(queue_wait_s=0.05, dwell_s=0.02,
+                                max_level=1, ewma_alpha=0.9))
+    service.start(warmup=False)
+    try:
+        service._note_queue_wait(0.5)
+        time.sleep(0.03)
+        service._note_queue_wait(0.5)
+        assert service.brownout_level == 1
+        # No traffic at all: the serving loop's idle ticks decay the EWMA.
+        assert _wait(lambda: service.brownout_level == 0, timeout=5.0)
+    finally:
+        service.stop()
+    assert service.metrics.counter("brownout_recoveries") == 1
+
+
+# ---------- dead-letter metadata + journal end to end ----------
+
+
+def test_dead_letter_status_carries_frame_ids_and_feeds_journal(tmp_path):
+    from opencv_facerecognizer_tpu.runtime import FaultInjector
+
+    injector = FaultInjector(seed=3)
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"))
+    _, service, connector = _service(
+        fault_injector=injector, dead_letter_journal=journal,
+        batch_size=2, resilience=ResiliencePolicy(readback_deadline_s=0.3))
+    service.start(warmup=False)
+    try:
+        injector.script("readback", "stuck")
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "meta": {"seq": 7}})
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "meta": {"seq": 8}})
+        assert _wait(lambda: service.metrics.counter(
+            "batches_dead_lettered") >= 1)
+    finally:
+        service.stop()
+        journal.close()
+    # The status message names the dead frames (producers can retry).
+    dl = next(m for m in connector.messages(STATUS_TOPIC)
+              if m["status"] == "dead_letter")
+    assert dl["frames"] == 2
+    assert dl["frame_ids"] == [{"seq": 7}, {"seq": 8}]
+    assert len(dl["enqueued_at"]) == 2
+    assert all(ts is not None for ts in dl["enqueued_at"])
+    # And the same frames landed in the durable journal.
+    records = list(journal.records())
+    assert [r["reason"] for r in records] == ["dead_letter"]
+    assert [f["meta"] for f in records[0]["frames"]] == [{"seq": 7}, {"seq": 8}]
+    # Ledger: both frames accounted as dead-lettered.
+    ledger = service.ledger()
+    assert ledger["drops_by_reason"]["frames_dead_lettered"] == 2
+    assert ledger["in_system"] == 0
+
+
+def test_abandoned_batch_frames_land_in_ledger_and_journal(tmp_path):
+    from opencv_facerecognizer_tpu.runtime import FaultInjector
+
+    injector = FaultInjector(seed=4)
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"))
+    _, service, connector = _service(
+        fault_injector=injector, dead_letter_journal=journal, batch_size=2,
+        resilience=ResiliencePolicy(dispatch_retries=0, backoff_base_s=0.01,
+                                    readback_deadline_s=2.0, degraded_after=99))
+    service.start(warmup=False)
+    try:
+        injector.script("dispatch", "unavailable")
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "meta": {"seq": 1}})
+        connector.inject(FRAME_TOPIC, {"frame": _frame(), "meta": {"seq": 2}})
+        assert _wait(lambda: service.metrics.counter("batches_failed") >= 1)
+        assert service.drain(10.0)
+    finally:
+        service.stop()
+        journal.close()
+    ledger = service.ledger()
+    assert ledger["drops_by_reason"]["frames_failed"] == 2
+    assert ledger["in_system"] == 0
+    assert [r["reason"] for r in journal.records()] == ["failed"]
+
+
+# ---------- the ledger under a mixed storm ----------
+
+
+def test_ledger_reconciles_exactly_under_mixed_faults_and_overload(tmp_path):
+    from opencv_facerecognizer_tpu.runtime import FaultInjector
+
+    injector = FaultInjector(
+        seed=5, rates={"receive": {"flood": 0.3, "drop": 0.1},
+                       "dispatch": {"unavailable": 0.05}},
+        flood_factor=4)
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"))
+    pipeline = InstantPipeline(FRAME_HW, dispatch_s=0.01)
+    _, service, connector = _service(
+        pipeline=pipeline, fault_injector=injector,
+        dead_letter_journal=journal,
+        admission=AdmissionController(max_inflight_frames=16),
+        brownout=BrownoutPolicy(queue_wait_s=0.04, dwell_s=0.1),
+        shed_stale_after_s=0.2,
+        resilience=ResiliencePolicy(dispatch_retries=1, backoff_base_s=0.005,
+                                    backoff_max_s=0.01,
+                                    readback_deadline_s=2.0,
+                                    degraded_after=999))
+    service.start(warmup=False)
+    try:
+        for i in range(300):
+            pri = "interactive" if i % 4 == 0 else "bulk"
+            connector.inject(FRAME_TOPIC, {"frame": _frame(), "priority": pri,
+                                           "meta": {"seq": i}})
+            if i % 25 == 0:
+                time.sleep(0.01)
+        injector.disarm()
+        assert service.drain(30.0)
+    finally:
+        service.stop()
+        journal.close()
+    ledger = service.ledger()
+    # THE invariant: every admitted frame is completed or in exactly one
+    # named drop bucket — nothing vanished, nothing double-counted.
+    assert ledger["in_system"] == 0, ledger
+    assert ledger["admitted"] > 0 and ledger["completed"] > 0
+    # Results on the wire match the completed count exactly.
+    assert len(connector.messages(RESULT_TOPIC)) == ledger["completed"]
+
+
+# ---------- stats surface ----------
+
+
+def test_stats_command_exposes_ledger_and_brownout():
+    _, service, connector = _service(
+        brownout=BrownoutPolicy(queue_wait_s=0.5))
+    from opencv_facerecognizer_tpu.runtime.recognizer import CONTROL_TOPIC
+
+    connector.inject(CONTROL_TOPIC, {"cmd": "stats"})
+    stats = next(m for m in connector.messages(STATUS_TOPIC)
+                 if m.get("status") == "stats")
+    assert stats["brownout_level"] == 0
+    assert "ledger" in stats and stats["ledger"]["in_system"] == 0
