@@ -164,6 +164,34 @@ def build_parser() -> argparse.ArgumentParser:
                         "reason to this bounded rotating JSONL journal "
                         "(replayable: python -m opencv_facerecognizer_tpu"
                         ".runtime.journal PATH)")
+    # ---- crash-safe state lifecycle (runtime.state_store / README
+    # "State durability") ----
+    p.add_argument("--state-dir", metavar="DIR",
+                   help="durable state directory: atomic checksummed "
+                        "gallery checkpoints + an enrollment write-ahead "
+                        "log. On startup the newest verified checkpoint "
+                        "is restored and the WAL replayed (superseding "
+                        "the --gallery startup enrollment); enrollments "
+                        "accepted while serving then survive restarts. "
+                        "Unset = state lives only in memory")
+    p.add_argument("--checkpoint-every-s", type=float, default=300.0,
+                   help="age threshold for background checkpoints: WAL "
+                        "entries older than this trigger one (only "
+                        "meaningful with --state-dir)")
+    p.add_argument("--checkpoint-wal-rows", type=int, default=256,
+                   help="row-count threshold: a WAL holding this many "
+                        "enrolled rows triggers a background checkpoint")
+    p.add_argument("--keep-checkpoints", type=int, default=3,
+                   help="checkpoint retention: newest N kept; older ones "
+                        "(and quarantined corrupt files beyond N) pruned")
+    p.add_argument("--journal-fsync", choices=["never", "interval", "always"],
+                   default="never",
+                   help="fsync policy of the dead-letter journal: never "
+                        "(default — flush per record, the original "
+                        "behavior), interval (fsync at most once per "
+                        "second), always (fsync per record). The "
+                        "enrollment WAL always runs at 'always' — its "
+                        "acknowledgments promise durability")
     return p
 
 
@@ -256,6 +284,7 @@ def main(argv=None) -> int:
         BrownoutPolicy, ResiliencePolicy, ServiceSupervisor,
         rebuild_pipeline_on_cpu,
     )
+    from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
     pipeline, names = _load_stack(args)
@@ -270,8 +299,28 @@ def main(argv=None) -> int:
         )
     brownout = (BrownoutPolicy(queue_wait_s=args.brownout_queue_wait_ms / 1e3)
                 if args.brownout_queue_wait_ms > 0 else None)
-    journal = (DeadLetterJournal(args.dead_letter_journal, metrics=metrics)
+    journal = (DeadLetterJournal(args.dead_letter_journal, metrics=metrics,
+                                 fsync=args.journal_fsync)
                if args.dead_letter_journal else None)
+
+    state = None
+    if args.state_dir:
+        state = StateLifecycle(
+            args.state_dir, metrics=metrics,
+            keep_checkpoints=args.keep_checkpoints,
+            checkpoint_wal_rows=args.checkpoint_wal_rows,
+            checkpoint_every_s=args.checkpoint_every_s,
+        )
+        # Startup recovery: newest verified checkpoint + WAL replay
+        # supersede the fresh --gallery enrollment (the baseline rows are
+        # part of the state dir's own first checkpoint, taken below).
+        report = state.recover(pipeline.gallery, names)
+        print(f"state recovery: {report}", file=sys.stderr)
+        if report["recovered_checkpoint"] is None and not report["replayed_records"]:
+            # First run against this state dir: make the baseline gallery
+            # durable NOW, so a crash before the first enrollment still
+            # restarts into a serving gallery.
+            state.checkpoint_now(wait=True)
 
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)
@@ -301,6 +350,7 @@ def main(argv=None) -> int:
         dead_letter_journal=journal,
         shed_stale_after_s=(args.shed_stale_after_ms / 1e3
                             if args.shed_stale_after_ms > 0 else None),
+        state_store=state,
         resilience=ResiliencePolicy(
             dispatch_retries=args.dispatch_retries,
             readback_deadline_s=args.readback_deadline,
@@ -312,11 +362,25 @@ def main(argv=None) -> int:
         # handling"). Only reachable with --probe-on-degraded.
         cpu_fallback=rebuild_pipeline_on_cpu if args.probe_on_degraded else None,
     )
-    supervisor = ServiceSupervisor(service) if args.supervised else None
+    supervisor = (ServiceSupervisor(service, state=state)
+                  if args.supervised else None)
     if supervisor is not None:
         supervisor.start()
     else:
         service.start()
+
+    # Graceful SIGTERM (README "State durability"): drain in-flight
+    # batches, final checkpoint, WAL truncate, exit 0 — a deploy-level
+    # stop must not cost acknowledged enrollments or in-flight frames.
+    import signal
+    import threading
+
+    term_event = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM,
+                      lambda signum, frame: term_event.set())
+    except ValueError:
+        pass  # not the main thread (tests drive main() from a worker)
 
     profiling = False
     if args.profile_dir:
@@ -337,6 +401,7 @@ def main(argv=None) -> int:
             profiling = False
             print(f"profile trace written to {args.profile_dir}", file=sys.stderr)
 
+    interrupted = False
     try:
         if args.source == "dir":
             import json
@@ -356,36 +421,52 @@ def main(argv=None) -> int:
                 connector.inject(FRAME_TOPIC, {**encode_frame(img), "meta": {"file": fn}})
             deadline = time.monotonic() + 60
             while (len(connector.messages(RESULT_TOPIC)) < len(files)
-                   and time.monotonic() < deadline):
+                   and time.monotonic() < deadline
+                   and not term_event.is_set()):
                 _stop_profile_if_due()
                 time.sleep(0.05)
             for message in connector.messages(RESULT_TOPIC):
                 print(json.dumps(message))
         else:
             # Serve until the input stream/socket ends (stdin EOF terminates
-            # the process instead of spinning forever) or Ctrl-C; then let
-            # every frame already accepted finish and publish before the
-            # teardown in `finally` discards the queues.
+            # the process instead of spinning forever), SIGTERM, or Ctrl-C;
+            # then let every frame already accepted finish and publish
+            # before the teardown in `finally` discards the queues.
             while not connector.eof.wait(timeout=0.5):
                 _stop_profile_if_due()
+                if term_event.is_set():
+                    print("SIGTERM: draining before shutdown", file=sys.stderr)
+                    break
             service.drain()
     except KeyboardInterrupt:
-        pass
+        interrupted = True
     finally:
         if profiling:
             import jax
 
             jax.profiler.stop_trace()
-        if supervisor is not None:
-            supervisor.stop()
-        else:
-            service.stop()
+        # ONE shutdown sequence — the exported helper the recovery chaos
+        # scenario validates (drain -> stop -> final checkpoint -> WAL
+        # truncate), not a hand-rolled copy that could drift from it.
+        # Ctrl-C keeps its prompt-teardown semantics via a zero drain
+        # budget; EOF/SIGTERM paths already drained above, so the
+        # helper's drain is a fast no-op there.
+        from opencv_facerecognizer_tpu.runtime.state_store import (
+            graceful_shutdown,
+        )
+
+        shutdown = graceful_shutdown(service, state=state,
+                                     supervisor=supervisor,
+                                     drain_timeout=0.0 if interrupted else 30.0)
+        if state is not None:
+            print(f"final checkpoint: "
+                  f"{'written' if shutdown['final_checkpoint'] else 'FAILED (previous kept)'}",
+                  file=sys.stderr)
         summary = metrics.summary()
         if summary:
             print(f"metrics: {summary}", file=sys.stderr)
-        ledger = service.ledger()
-        if ledger["admitted"]:
-            print(f"admission ledger: {ledger}", file=sys.stderr)
+        if shutdown["ledger"]["admitted"]:
+            print(f"admission ledger: {shutdown['ledger']}", file=sys.stderr)
         if journal is not None:
             journal.close()
         if metrics_sink:

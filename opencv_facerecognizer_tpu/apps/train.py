@@ -42,6 +42,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace of the whole train+"
                         "validate run into this directory (open with "
                         "TensorBoard or xprof)")
+    p.add_argument("--keep-checkpoints", type=int, default=0,
+                   help="retain the previous N model checkpoints as "
+                        "model.ckpt.1..N when overwriting (the write "
+                        "itself is always atomic: tmp + fsync + rename, "
+                        "so a crash mid-save never corrupts the existing "
+                        "checkpoint)")
     return p
 
 
@@ -61,10 +67,11 @@ def main(argv=None) -> int:
         # Flags that select a specific artifact shape don't compose with
         # selection — fail loudly (this file's policy) instead of silently
         # ignoring them.
-        if args.profile_dir or args.eigenfaces_plot:
-            parser.error("--profile-dir/--eigenfaces-plot don't apply with "
-                         "--model auto (profile/plot the selected model in "
-                         "a follow-up single-model run)")
+        if args.profile_dir or args.eigenfaces_plot or args.keep_checkpoints:
+            parser.error("--profile-dir/--eigenfaces-plot/--keep-checkpoints "
+                         "don't apply with --model auto (selection saves "
+                         "candidate models repeatedly; run the winner "
+                         "single-model to use them)")
         from opencv_facerecognizer_tpu.runtime.trainer import select_model
         from opencv_facerecognizer_tpu.utils import dataset as dataset_utils
 
@@ -98,6 +105,7 @@ def main(argv=None) -> int:
         train_steps=args.train_steps,
     )
     trainer = TheTrainer(config)
+    trainer.keep_checkpoints = args.keep_checkpoints
     if args.profile_dir:
         import jax
 

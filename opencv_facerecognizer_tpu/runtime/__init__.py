@@ -27,12 +27,20 @@ from opencv_facerecognizer_tpu.runtime.resilience import (
     ResiliencePolicy,
     ServiceSupervisor,
 )
+from opencv_facerecognizer_tpu.runtime.state_store import (
+    CheckpointStore,
+    EnrollmentWAL,
+    StateLifecycle,
+    graceful_shutdown,
+)
 from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer
 
 __all__ = [
     "AdmissionController",
     "BrownoutPolicy",
+    "CheckpointStore",
     "DeadLetterJournal",
+    "EnrollmentWAL",
     "FakeConnector",
     "FaultInjector",
     "FrameBatcher",
@@ -43,7 +51,9 @@ __all__ = [
     "RecognizerService",
     "ResiliencePolicy",
     "ServiceSupervisor",
+    "StateLifecycle",
     "TheTrainer",
     "TokenBucket",
+    "graceful_shutdown",
     "parse_priority",
 ]

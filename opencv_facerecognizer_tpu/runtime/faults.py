@@ -46,7 +46,27 @@ BOUNDARIES: Dict[str, tuple] = {
     "put": ("corrupt",),
     "dispatch": ("unavailable",),
     "readback": ("stuck", "slow"),
+    # Durability boundaries (state lifecycle layer — runtime.state_store):
+    # "torn" = the process dies mid-write leaving a partial record/file on
+    # disk; "crash" = it dies before the write becomes visible (before the
+    # WAL bytes land / before the checkpoint tmp renames); checkpoint
+    # "late" = the checkpoint file lands but the process dies before the
+    # WAL truncation that follows — the window where replay must dedup
+    # against the checkpoint's recorded WAL sequence.
+    "wal": ("torn", "crash"),
+    "checkpoint": ("torn", "crash", "late"),
 }
+
+
+class InjectedCrashError(RuntimeError):
+    """Simulated process death at a durability boundary (``wal`` /
+    ``checkpoint`` faults). The recovery chaos scenario raises this where
+    a real kill -9 would land, then "restarts" by rebuilding the state
+    lifecycle from disk — the caller must treat it as fatal, never catch
+    and continue (a real SIGKILL offers no such choice)."""
+
+    def __init__(self, msg: str = "injected crash at a durability boundary"):
+        super().__init__(msg)
 
 
 class InjectedUnavailableError(RuntimeError):
@@ -233,6 +253,22 @@ class FaultInjector:
         if fault == "slow":
             return SlowReadback(device_array, self.slow_readback_s)
         return StuckReadback(device_array)
+
+    def on_wal_append(self) -> Optional[str]:
+        """Enrollment-WAL append boundary: returns the fault kind the
+        writer must enact (``"torn"``: persist a partial line then die;
+        ``"crash"``: die before any byte lands) or None. The WRITER
+        performs the torn write and raises ``InjectedCrashError`` — the
+        injector only draws, so the torn bytes are exactly the writer's
+        real encoding, not a fake."""
+        return self._draw("wal")
+
+    def on_checkpoint(self) -> Optional[str]:
+        """Checkpoint-save boundary: ``"torn"`` (die mid-tmp-write),
+        ``"crash"`` (die after the tmp is complete but before the rename
+        installs it), ``"late"`` (the checkpoint lands; die before the WAL
+        truncation that follows), or None."""
+        return self._draw("checkpoint")
 
     def summary(self) -> Dict[str, int]:
         return dict(self.injected)

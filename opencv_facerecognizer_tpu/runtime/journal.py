@@ -1,4 +1,6 @@
-"""Durable dead-letter journal (overload layer §3).
+"""Durable journals: a bounded rotating JSONL base + the dead-letter
+journal built on it (overload layer §3; the enrollment WAL in
+``runtime.state_store`` reuses the same machinery).
 
 Before this module, a dead-lettered or shed batch left behind exactly one
 integer (a metrics counter) — a producer that wanted to retry the lost
@@ -24,8 +26,26 @@ Rotation: when the active file exceeds ``max_bytes`` it is renamed to
 Appends are serialized by a lock and flushed per record: a crash loses at
 most the record being written.
 
-A journal failure must never hurt serving — every write error is swallowed
-after counting ``journal_errors`` on the (optional) metrics surface.
+**Fsync policy** (``fsync=``, shared with the enrollment WAL and exposed
+as ``ocvf-recognize --journal-fsync``):
+
+- ``"never"`` (default — the original behavior): flush to the kernel per
+  record, never ``fsync``; a process crash loses at most the torn tail
+  record, a POWER cut can lose everything the kernel hadn't written back.
+- ``"interval"``: additionally ``fsync`` at most once per
+  ``fsync_interval_s`` — bounds the power-cut window to that interval
+  while appends continue (the sync rides the next append); after a burst
+  STOPS, the un-synced tail persists at ``close()``/``sync()`` or the
+  next append, whichever comes first — an idle open journal's last
+  sub-interval of records is the residual exposure.
+- ``"always"``: ``fsync`` after every append — an append that returned is
+  durable (what the enrollment WAL runs with: its acknowledgments promise
+  crash-survival).
+
+A DEAD-LETTER journal failure must never hurt serving — every write error
+is swallowed after counting ``journal_errors`` on the (optional) metrics
+surface. The WAL subclass uses ``strict=True`` appends instead: a failed
+write there must abort the enrollment acknowledgment, not vanish.
 """
 
 from __future__ import annotations
@@ -36,53 +56,97 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
+#: accepted fsync policies, in increasing durability order.
+FSYNC_POLICIES = ("never", "interval", "always")
 
-class DeadLetterJournal:
+
+class RotatingJournal:
+    """Append-only JSONL file with bounded rotation and an fsync policy —
+    the shared machinery under ``DeadLetterJournal`` and the enrollment
+    WAL (``state_store.EnrollmentWAL``). Subclasses own record semantics;
+    this class owns the file: locking, rotation, flush/fsync, and the
+    oldest-first reader that skips torn lines."""
+
     def __init__(self, path: str, max_bytes: int = 4 << 20, backups: int = 2,
-                 metrics=None):
+                 metrics=None, fsync: str = "never",
+                 fsync_interval_s: float = 1.0):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(f"fsync policy {fsync!r} not in {FSYNC_POLICIES}")
         self.path = str(path)
         self.max_bytes = int(max_bytes)
         self.backups = max(0, int(backups))
         self.metrics = metrics
+        self.fsync = fsync
+        self.fsync_interval_s = float(fsync_interval_s)
+        self._last_fsync_t = 0.0
         self._lock = threading.Lock()
         self._fh = None
+        # Set when an append failed partway (ENOSPC can land a partial
+        # line before raising): the next append must first terminate the
+        # torn bytes with a newline, or a SUCCESSFUL, fsynced,
+        # acknowledged record would glue onto them and become one
+        # unparseable line — silent loss of acked data on replay.
+        self._needs_seal = False
         directory = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(directory, exist_ok=True)
 
     # ---- writing ----
 
-    @staticmethod
-    def frame_entry(meta: Any = None, enqueue_ts: Optional[float] = None,
-                    priority: Optional[int] = None) -> Dict[str, Any]:
-        return {"meta": meta, "enqueue_ts": enqueue_ts, "priority": priority}
-
-    def append(self, reason: str, frames: List[Dict[str, Any]],
-               **extra: Any) -> None:
-        """Append one record for ``frames`` shed/dead-lettered for
-        ``reason``. Never raises (see module docstring)."""
-        record = {"ts": time.time(), "reason": str(reason),
-                  "frames": list(frames)}
-        if extra:
-            record.update(extra)
-        try:
-            line = json.dumps(record, default=repr)
-        except (TypeError, ValueError):
-            line = json.dumps({"ts": record["ts"], "reason": record["reason"],
-                               "frames": [], "encode_error": True})
+    def append_line(self, line: str, strict: bool = False) -> bool:
+        """Append one pre-encoded JSON line (rotating first if needed),
+        flushed and fsynced per policy. Returns True on success; an OSError
+        is counted (``journal_errors``) and either swallowed (default —
+        the dead-letter posture: a journal failure must never hurt
+        serving) or re-raised (``strict`` — the WAL posture: a failed
+        append must fail the acknowledgment that depends on it)."""
         with self._lock:
             try:
-                self._rotate_if_needed(len(line) + 1)
-                if self._fh is None:
-                    self._fh = open(self.path, "a", encoding="utf-8")
-                self._fh.write(line + "\n")
-                self._fh.flush()
+                self._append_locked(line)
             except OSError:
+                self._needs_seal = True  # partial bytes may have landed
                 if self.metrics is not None:
                     self.metrics.incr("journal_errors")
-                return
-        if self.metrics is not None:
-            self.metrics.incr("journal_records")
-            self.metrics.incr("journal_frames", len(record["frames"]))
+                if strict:
+                    raise
+                return False
+        return True
+
+    def _append_locked(self, line: str, newline: bool = True) -> None:
+        """Caller holds the lock. Raw write + flush + policy fsync. A
+        pending seal (previous failed append) is prepended as a newline in
+        the SAME write, so the torn bytes end up an isolated unparseable
+        line instead of a prefix of this record."""
+        self._rotate_if_needed(len(line) + 2)
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        prefix = "\n" if self._needs_seal else ""
+        self._fh.write(prefix + line + ("\n" if newline else ""))
+        self._needs_seal = False  # the write (incl. the seal) landed
+        self._fh.flush()
+        self._fsync_locked()
+
+    def _fsync_locked(self) -> None:
+        if self.fsync == "never" or self._fh is None:
+            return
+        now = time.monotonic()
+        if (self.fsync == "interval"
+                and now - self._last_fsync_t < self.fsync_interval_s):
+            return
+        os.fsync(self._fh.fileno())
+        self._last_fsync_t = now
+
+    def sync(self) -> None:
+        """Force an fsync of the active file regardless of policy (the
+        graceful-shutdown path wants durability NOW)."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                    self._last_fsync_t = time.monotonic()
+                except OSError:
+                    if self.metrics is not None:
+                        self.metrics.incr("journal_errors")
 
     def _rotate_if_needed(self, incoming: int) -> None:
         """Caller holds the lock. Shift ``path -> path.1 -> path.2 ...``
@@ -113,12 +177,22 @@ class DeadLetterJournal:
         with self._lock:
             if self._fh is not None:
                 try:
+                    if self.fsync != "never":
+                        # "interval" only fsyncs on SUBSEQUENT appends: the
+                        # tail of a burst would otherwise never be synced
+                        # once traffic stops — close is the last chance to
+                        # honor the bounded-window promise.
+                        self._fh.flush()
+                        os.fsync(self._fh.fileno())
+                except OSError:
+                    pass
+                try:
                     self._fh.close()
                 except OSError:
                     pass
                 self._fh = None
 
-    # ---- reading / replay ----
+    # ---- reading ----
 
     def _files_oldest_first(self) -> List[str]:
         files = [f"{self.path}.{i}" for i in range(self.backups, 0, -1)]
@@ -127,24 +201,64 @@ class DeadLetterJournal:
 
     def records(self) -> Iterator[Dict[str, Any]]:
         """Every journal record, oldest first (rotated files included).
-        Malformed lines (a crash mid-write) are skipped, not fatal."""
+        Malformed lines are skipped, not fatal — corruption-total: invalid
+        UTF-8 bytes (``errors="replace"``), unparseable JSON, and lines
+        that parse to a non-object (``null``, a bare number) all read as
+        damage to skip, never an exception out of a recovery/replay loop."""
         with self._lock:
             if self._fh is not None:
                 self._fh.flush()
             files = self._files_oldest_first()
         for path in files:
             try:
-                with open(path, "r", encoding="utf-8") as fh:
+                with open(path, "r", encoding="utf-8",
+                          errors="replace") as fh:
                     for line in fh:
                         line = line.strip()
                         if not line:
                             continue
                         try:
-                            yield json.loads(line)
+                            record = json.loads(line)
                         except json.JSONDecodeError:
                             continue
+                        if isinstance(record, dict):
+                            yield record
             except OSError:
                 continue
+
+
+class DeadLetterJournal(RotatingJournal):
+    """Bounded rotating journal of dead-lettered / shed / abandoned frames
+    (module docstring). Non-strict by design: a journal failure is counted
+    and swallowed — serving never dies to its flight recorder."""
+
+    # ---- writing ----
+
+    @staticmethod
+    def frame_entry(meta: Any = None, enqueue_ts: Optional[float] = None,
+                    priority: Optional[int] = None) -> Dict[str, Any]:
+        return {"meta": meta, "enqueue_ts": enqueue_ts, "priority": priority}
+
+    def append(self, reason: str, frames: List[Dict[str, Any]],
+               **extra: Any) -> None:
+        """Append one record for ``frames`` shed/dead-lettered for
+        ``reason``. Never raises (see module docstring)."""
+        record = {"ts": time.time(), "reason": str(reason),
+                  "frames": list(frames)}
+        if extra:
+            record.update(extra)
+        try:
+            line = json.dumps(record, default=repr)
+        except (TypeError, ValueError):
+            line = json.dumps({"ts": record["ts"], "reason": record["reason"],
+                               "frames": [], "encode_error": True})
+        if not self.append_line(line, strict=False):
+            return
+        if self.metrics is not None:
+            self.metrics.incr("journal_records")
+            self.metrics.incr("journal_frames", len(record["frames"]))
+
+    # ---- replay ----
 
     def replay(self, handler: Callable[[Dict[str, Any]], None],
                reasons: Optional[tuple] = None) -> int:

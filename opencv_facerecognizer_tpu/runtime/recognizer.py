@@ -77,6 +77,16 @@ nothing above protects the loop from its own producers):
   ``admitted == completed + Σ drops_by_reason`` (``ledger()``); shed /
   dead-lettered / abandoned frames also append metadata + reason to the
   optional durable ``DeadLetterJournal`` so producers can retry.
+
+**Durable state** (``runtime.state_store``, wired via ``state_store=``):
+an enrolment write-ahead-logs its embeddings/labels (fsynced per policy)
+before the gallery mutation and is acknowledged only after — restart
+recovery (checkpoint + WAL replay) then loses nothing acknowledged. The
+serving loop ticks the lifecycle's checkpoint thresholds each iteration;
+the checkpoint itself (host-mirror ``snapshot()`` + atomic checksummed
+write) runs on a background thread behind a single-flight guard, so
+dispatch never blocks on durability. ``reload_gallery`` forces a
+checkpoint — a swap is not WAL-representable.
 """
 
 from __future__ import annotations
@@ -248,6 +258,12 @@ class RecognizerService:
         # Freshness bound forwarded to the batcher: queued frames older
         # than this are shed (reason ``stale``) rather than dispatched.
         shed_stale_after_s: Optional[float] = None,
+        # Crash-safe state lifecycle (runtime.state_store.StateLifecycle):
+        # enrollments write-ahead to its WAL before touching the gallery,
+        # the serving loop ticks its checkpoint thresholds, and a reload
+        # forces a durable checkpoint. None keeps state memory-only (the
+        # pre-durability behavior).
+        state_store=None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -271,6 +287,7 @@ class RecognizerService:
             self.admission.inflight_fn = self.frames_in_system
         self.brownout_policy = brownout
         self.journal = dead_letter_journal
+        self.state = state_store
         self._brownout_level = 0
         self._queue_wait_ewma: Optional[float] = None
         self._brownout_changed_at = 0.0
@@ -323,6 +340,11 @@ class RecognizerService:
         # supervisor listening on STATUS_TOPIC would never hear commits in
         # production. ServiceSupervisor registers its checkpoint here.
         self.commit_hooks: List[Callable[[], None]] = []
+        if self.state is not None:
+            # The lifecycle reads the LIVE pipeline's gallery at
+            # checkpoint time (reload/CPU-fallback may swap it) and nudges
+            # its thresholds through the commit hooks just registered.
+            self.state.attach(self)
 
         # Enrolment embeds ride a FIXED-size padded chunk: one compiled
         # shape, warmed at start(), so an enroll command never triggers a
@@ -762,6 +784,12 @@ class RecognizerService:
     def _serve_loop(self) -> None:
         while self._running:
             batch = self.batcher.get_batch(block=True)
+            # Durable-state tick: a cheap WAL row-count/age threshold
+            # check; when due it SPAWNS the checkpoint worker (snapshot +
+            # write happen off-thread, single-flight) — dispatch never
+            # blocks on a checkpoint.
+            if self.state is not None:
+                self.state.tick()
             if batch is None:
                 if not self._running:
                     break
@@ -1294,8 +1322,21 @@ class RecognizerService:
                 label = len(self.subject_names)
                 self.subject_names.append(enrolment.subject_name)
         before_grow = self.pipeline.gallery.grow_count
+        labels_arr = np.full(len(emb), label, np.int32)
         try:
-            self.pipeline.gallery.add(emb, np.full(len(emb), label, np.int32))
+            if self.state is not None:
+                # Write-ahead: the WAL record (fsynced per policy) lands
+                # BEFORE the gallery mutation, both under the lifecycle's
+                # enroll lock — a crash anywhere after the append replays
+                # this enrolment on restart, and the 'enrolled' ack below
+                # is a durability promise. A failed append raises: the
+                # enrolment is rolled back, never acknowledged-but-lost.
+                self.state.append_enrollment(
+                    emb, labels_arr, subject=enrolment.subject_name,
+                    label=label,
+                    apply_fn=lambda: self.pipeline.gallery.add(emb, labels_arr))
+            else:
+                self.pipeline.gallery.add(emb, labels_arr)
             grown = self.pipeline.gallery.grow_count - before_grow
             if grown:
                 # Auto-grow saved the enrolment but forced a recompile-sized
@@ -1329,6 +1370,12 @@ class RecognizerService:
         self.connector.publish(STATUS_TOPIC, {"status": "reloaded",
                                               "gallery_size": self.pipeline.gallery.size})
         self._run_commit_hooks()
+        if self.state is not None:
+            # A swap is not WAL-representable (the log speaks in appended
+            # rows): force a durable checkpoint of the NEW gallery. Until
+            # it lands, a crash recovers the previous gallery plus every
+            # acknowledged enrolment — the documented reload window.
+            self.state.maybe_checkpoint(force=True)
 
     def _run_commit_hooks(self) -> None:
         """Notify commit watchers (see ``commit_hooks``); a raising hook

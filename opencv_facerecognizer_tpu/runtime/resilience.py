@@ -223,6 +223,13 @@ class ServiceSupervisor:
     ``{"status": "supervisor_gave_up"}`` and stops intervening (a crash
     loop almost always means a real bug, and flapping hides it).
 
+    With a durable state lifecycle wired (``state=``,
+    ``runtime.state_store``), the in-memory snapshot stays the primary
+    in-process restore and the lifecycle's checkpoint+WAL recovery is the
+    fallback when that snapshot is missing or fails to install — the same
+    path a full process restart takes, so both rungs of the restart
+    ladder land on consistent state.
+
     Honest limitation — the **call-time hang**: a backend that blocks
     forever *inside* the dispatch call itself (not the readback) cannot be
     preempted from within the process — the serving thread is stuck in
@@ -238,11 +245,18 @@ class ServiceSupervisor:
     def __init__(self, service, max_restarts: int = 5,
                  poll_interval_s: float = 0.2,
                  restart_backoff_s: float = 0.1,
-                 commit_wait_s: float = 30.0):
+                 commit_wait_s: float = 30.0,
+                 state=None):
         self.service = service
         self.max_restarts = int(max_restarts)
         self.poll_interval_s = float(poll_interval_s)
         self.restart_backoff_s = float(restart_backoff_s)
+        #: optional runtime.state_store.StateLifecycle — the DURABLE
+        #: last-known-good. The in-memory snapshot stays the primary
+        #: restore (cheap, no disk); the lifecycle is the fallback when
+        #: that snapshot is missing or its install fails, and the source
+        #: of process-restart recovery either way.
+        self.state = state
         #: bounded wait for async-grow staged rows to land before a
         #: post-commit checkpoint (a snapshot taken mid-grow would MISS
         #: the rows the commit announced); on timeout the previous
@@ -259,6 +273,7 @@ class ServiceSupervisor:
         self._last_progress_t = time.monotonic()
         self._stall_warned = False
         self._snapshot: Optional[Tuple] = None
+        self._snapshot_wal_seq: Optional[int] = None
         self._subject_names: Optional[list] = None
         self._thread: Optional[threading.Thread] = None
         self._running = False
@@ -296,9 +311,18 @@ class ServiceSupervisor:
     def checkpoint(self) -> None:
         """Record the current gallery + subject names as last-known-good.
         Host-mirror copies only — no device readback (the axon backend's
-        sync-poll trap, see runtime.recognizer)."""
-        self._snapshot = self.service.pipeline.gallery.snapshot()
-        self._subject_names = list(self.service.subject_names)
+        sync-poll trap, see runtime.recognizer). With a state lifecycle
+        wired, the snapshot is STAMPED with the WAL sequence it covers —
+        a restore then replays the acknowledged tail past the stamp, so
+        rolling back to this snapshot can never desync the gallery from
+        the WAL coverage the next durable checkpoint claims."""
+        if self.state is not None:
+            (self._snapshot_wal_seq, self._snapshot,
+             self._subject_names) = self.state.stamped_snapshot()
+        else:
+            self._snapshot_wal_seq = None
+            self._snapshot = self.service.pipeline.gallery.snapshot()
+            self._subject_names = list(self.service.subject_names)
         self.service.metrics.incr("supervisor_checkpoints")
 
     def _on_commit(self) -> None:
@@ -354,7 +378,11 @@ class ServiceSupervisor:
                 self._restore_gallery()
             except Exception:
                 logging.getLogger(__name__).exception(
-                    "gallery restore failed; restarting with current state")
+                    "gallery restore failed; trying durable state")
+                if not self._restore_durable():
+                    logging.getLogger(__name__).exception(
+                        "durable restore unavailable; restarting with "
+                        "current state")
             service.restart_loop()
             # Counter flips only once the restore + restart are done, so a
             # watcher seeing it can rely on the last-known-good gallery
@@ -397,6 +425,10 @@ class ServiceSupervisor:
 
     def _restore_gallery(self) -> None:
         if self._snapshot is None:
+            # No in-memory last-known-good (possible when start() raced a
+            # crash before its first checkpoint): fall back to the durable
+            # lifecycle when one is wired.
+            self._restore_durable()
             return
         service = self.service
         service.pipeline.gallery.load_snapshot(*self._snapshot)
@@ -404,6 +436,27 @@ class ServiceSupervisor:
             # Same in-place trim/extend rule as the gallery restore: names
             # enrolled after the checkpoint have no committed rows anymore.
             service.subject_names[:] = self._subject_names
+        if self.state is not None and self._snapshot_wal_seq is not None:
+            # Enrollments ACKNOWLEDGED after this snapshot was stamped
+            # (crash raced the commit hook) must come back: without the
+            # tail replay they would vanish from serving and the next
+            # durable checkpoint would truncate their WAL records.
+            self.state.replay_tail(self._snapshot_wal_seq)
+
+    def _restore_durable(self) -> bool:
+        """Fallback restore from the durable state lifecycle (checkpoint +
+        WAL replay) — the same path a process restart takes. Returns True
+        when it ran."""
+        if self.state is None:
+            return False
+        try:
+            self.state.recover(self.service.pipeline.gallery,
+                               self.service.subject_names)
+            self.service.metrics.incr("supervisor_durable_restores")
+            return True
+        except Exception:  # noqa: BLE001 — restore is best-effort here
+            logging.getLogger(__name__).exception("durable restore failed")
+            return False
 
     def _publish(self, topic: str, message: dict) -> None:
         try:

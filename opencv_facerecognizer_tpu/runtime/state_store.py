@@ -1,0 +1,1032 @@
+"""Crash-safe state lifecycle: durable checkpoints + enrollment WAL +
+startup recovery + graceful drain (durability layer).
+
+Before this module, every gallery row enrolled while serving lived only in
+device/host memory: a process restart silently lost all enrollments since
+the last manual ``save_model``, and the bare ``open+write`` save could
+corrupt the only checkpoint mid-crash. This layer makes accepted
+enrollments survive restarts:
+
+- **CheckpointStore** — atomic, checksummed, versioned checkpoints in a
+  retention-bounded directory. Each file is ``MAGIC + header(JSON, with a
+  sha256 of the payload) + payload(msgpack arrays)``, written tmp + fsync
+  + rename + directory fsync. ``load_latest`` scans newest -> oldest and
+  falls back past corrupt/truncated files (quarantined to ``*.corrupt``,
+  counted ``checkpoints_corrupt``) — a torn newest checkpoint costs the
+  delta since the previous one plus the WAL, never the gallery.
+- **EnrollmentWAL** — an append-only, fsync-policy-knobbed journal (on
+  ``runtime.journal``'s shared ``RotatingJournal`` machinery, with
+  size-rotation overridden to warn-only: acked records are never
+  unlinked) of ``add()``ed embeddings/labels between checkpoints.
+  Embedding bytes ride base64 with a per-record crc32; a torn tail
+  (crash mid-append) is sealed at open and skipped on replay, never
+  fatal. Appends are **strict**: a failed write raises, so the
+  enrollment acknowledgment that follows it never lies. Default policy
+  is ``always`` — an acknowledged enrollment is fsync-durable; the
+  ``interval``/``never`` policies widen the documented fsync window in
+  exchange for write cost.
+- **StateLifecycle** — the glue: write-ahead ``append_enrollment`` (WAL
+  first, then the gallery mutation, under one lock so a concurrent
+  checkpoint can never snapshot rows the WAL hasn't sequenced),
+  **background checkpointing** triggered by WAL row-count / age
+  thresholds (built from ``ShardedGallery.snapshot()`` host mirrors on a
+  worker thread — dispatch never blocks — with a single-flight guard),
+  and **startup recovery**: newest valid checkpoint -> ``load_snapshot``,
+  then WAL replay of records with ``seq`` beyond the checkpoint's
+  recorded ``wal_seq`` (so the crash window between checkpoint-rename and
+  WAL-truncate replays nothing twice).
+- **graceful_shutdown** — the SIGTERM path: drain in-flight batches,
+  stop (remaining queued frames are journaled as ``closed`` drops), take
+  a final checkpoint, truncate the WAL, report the settled admission
+  ledger.
+
+Consistency contract (what the recovery chaos scenario asserts —
+``scripts/chaos_soak.py --scenario recovery``): after ANY crash, restart
+lands on a checksum-verified gallery equal to a prefix of the
+acknowledged-enrollment history plus nothing else, and no enrollment whose
+``append_enrollment`` returned (with the WAL at ``always``) is ever lost.
+
+Known window, documented not hidden: a ``reload_gallery`` swap (retrain)
+is durable only once the forced checkpoint that follows it lands — a
+crash inside that window recovers the previous gallery plus every
+acknowledged enrollment replayed onto it.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+from opencv_facerecognizer_tpu.runtime.journal import RotatingJournal
+from opencv_facerecognizer_tpu.utils.serialization import (
+    CheckpointCorruptError,
+    atomic_write_bytes,
+    fsync_directory,
+)
+
+#: checkpoint file magic — identifies the framed gallery-state format
+#: (distinct from the model checkpoints ``utils.serialization`` writes).
+CHECKPOINT_MAGIC = b"OCVFSTATE\n"
+CHECKPOINT_FORMAT_VERSION = 1
+CHECKPOINT_SUFFIX = ".ckpt"
+QUARANTINE_SUFFIX = ".corrupt"
+
+
+class CheckpointVersionError(ValueError):
+    """The checkpoint is from a NEWER format than this binary supports —
+    intact, just unreadable here (a binary downgrade). Deliberately NOT
+    ``CheckpointCorruptError``: classifying it as corrupt would quarantine
+    and eventually retention-prune valid newer state, silently destroying
+    enrollments on rollback. Scans skip past it non-destructively."""
+
+
+def _encode_checkpoint(header: Dict[str, Any], payload: bytes) -> bytes:
+    """``MAGIC + u32 header_len + header_json + sha256(header_json) +
+    payload``. The raw 32-byte header digest covers the HEADER bytes —
+    the payload has its own sha256 inside the header. Without it, a bit
+    flip in e.g. the header's ``wal_seq`` digits would pass every check
+    and silently mis-dedup WAL replay (phantom rows or acked loss)."""
+    header_blob = json.dumps(header, sort_keys=True).encode("utf-8")
+    return (CHECKPOINT_MAGIC
+            + len(header_blob).to_bytes(4, "big")
+            + header_blob
+            + hashlib.sha256(header_blob).digest()
+            + payload)
+
+
+def _decode_checkpoint(blob: bytes, path: str) -> Tuple[Dict[str, Any], bytes]:
+    """Parse + validate one checkpoint file's bytes; raises
+    ``CheckpointCorruptError`` on ANY format/checksum miss — corruption
+    must always land on the quarantine-and-fall-back path, never escape
+    as a stray AttributeError/ValueError that crashes recovery."""
+    if not blob.startswith(CHECKPOINT_MAGIC):
+        raise CheckpointCorruptError(f"{path}: bad magic")
+    off = len(CHECKPOINT_MAGIC)
+    if len(blob) < off + 4:
+        raise CheckpointCorruptError(f"{path}: truncated before header")
+    hlen = int.from_bytes(blob[off:off + 4], "big")
+    off += 4
+    if hlen <= 0 or len(blob) < off + hlen + 32:
+        raise CheckpointCorruptError(f"{path}: truncated header")
+    header_blob = blob[off:off + hlen]
+    header_digest = blob[off + hlen:off + hlen + 32]
+    if hashlib.sha256(header_blob).digest() != header_digest:
+        raise CheckpointCorruptError(f"{path}: header sha256 mismatch")
+    try:
+        header = json.loads(header_blob.decode("utf-8"))
+        if not isinstance(header, dict):
+            raise ValueError(f"header is {type(header).__name__}, not object")
+        version = int(header.get("format_version", -1))
+        want_bytes = int(header.get("payload_bytes", -1))
+    except (UnicodeDecodeError, json.JSONDecodeError, TypeError,
+            ValueError, AttributeError) as exc:
+        raise CheckpointCorruptError(f"{path}: header decode failed: "
+                                     f"{exc!r}") from exc
+    if version > CHECKPOINT_FORMAT_VERSION:
+        raise CheckpointVersionError(
+            f"{path}: format v{version} is newer than supported "
+            f"v{CHECKPOINT_FORMAT_VERSION} (binary downgrade?)")
+    payload = blob[off + hlen + 32:]
+    if want_bytes != len(payload):
+        raise CheckpointCorruptError(
+            f"{path}: payload truncated ({len(payload)} bytes, header says "
+            f"{want_bytes})")
+    digest = hashlib.sha256(payload).hexdigest()
+    if digest != header.get("sha256"):
+        raise CheckpointCorruptError(f"{path}: sha256 mismatch")
+    return header, payload
+
+
+class CheckpointStore:
+    """Atomic, checksummed, versioned checkpoints in one directory.
+
+    Filenames are ``ckpt-<seq:08d>.ckpt``; ``seq`` is monotonically
+    increasing across restarts (scanned from the directory). Retention
+    keeps the newest ``keep`` files. Corrupt files found during a load
+    scan are quarantined (renamed ``*.corrupt``) so ops tooling can
+    inspect them while the next scan skips the known-bad file cheaply.
+    """
+
+    def __init__(self, directory: str, keep: int = 3, metrics=None):
+        self.directory = str(directory)
+        self.keep = max(1, int(keep))
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        os.makedirs(self.directory, exist_ok=True)
+
+    # ---- naming ----
+
+    @staticmethod
+    def _seq_of(filename: str) -> Optional[int]:
+        base = os.path.basename(filename)
+        if not (base.startswith("ckpt-") and base.endswith(CHECKPOINT_SUFFIX)):
+            return None
+        try:
+            return int(base[len("ckpt-"):-len(CHECKPOINT_SUFFIX)])
+        except ValueError:
+            return None
+
+    def checkpoint_files(self) -> List[Tuple[int, str]]:
+        """(seq, path) of every installed checkpoint, newest first."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            seq = self._seq_of(name)
+            if seq is not None:
+                out.append((seq, os.path.join(self.directory, name)))
+        out.sort(reverse=True)
+        return out
+
+    def next_seq(self) -> int:
+        files = self.checkpoint_files()
+        return (files[0][0] + 1) if files else 1
+
+    # ---- writing ----
+
+    def save(self, payload: bytes, meta: Dict[str, Any],
+             fault: Optional[str] = None) -> str:
+        """Install one checkpoint atomically; returns its path. ``fault``
+        is the chaos hook's verdict (see ``FaultInjector.on_checkpoint``):
+        ``torn`` persists a partial tmp then raises, ``crash`` completes
+        the tmp but raises before the rename — both leave the previous
+        checkpoint as the newest installed one."""
+        with self._lock:
+            seq = self.next_seq()
+            header = {
+                "format_version": CHECKPOINT_FORMAT_VERSION,
+                "seq": seq,
+                "created_ts": time.time(),
+                "payload_bytes": len(payload),
+                "sha256": hashlib.sha256(payload).hexdigest(),
+                "meta": dict(meta),
+            }
+            blob = _encode_checkpoint(header, payload)
+            path = os.path.join(self.directory,
+                                f"ckpt-{seq:08d}{CHECKPOINT_SUFFIX}")
+            if fault == "torn":
+                # Die mid-write: a durable partial tmp, never renamed.
+                with open(path + ".tmp", "wb") as fh:
+                    fh.write(blob[:max(1, len(blob) // 2)])
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                raise InjectedCrashError("torn checkpoint write (tmp left)")
+            if fault == "crash":
+                # Die after the tmp completes but before the rename: the
+                # checkpoint never installs.
+                with open(path + ".tmp", "wb") as fh:
+                    fh.write(blob)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                raise InjectedCrashError("crash before checkpoint rename")
+            atomic_write_bytes(path, blob)
+            if self.metrics is not None:
+                self.metrics.incr("checkpoints_written")
+            self._prune_locked()
+            return path
+
+    def _prune_locked(self) -> None:
+        """Retention: drop installed checkpoints beyond ``keep`` (oldest
+        first), stale tmp files, and quarantined files beyond ``keep``."""
+        for _seq, path in self.checkpoint_files()[self.keep:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        stale_tmp = [n for n in names if n.endswith(".tmp")]
+        quarantined = sorted(n for n in names if n.endswith(QUARANTINE_SUFFIX))
+        for name in stale_tmp + quarantined[:-self.keep or None]:
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:
+                pass
+
+    # ---- reading ----
+
+    def load_latest(self) -> Optional[Tuple[Dict[str, Any], bytes, str]]:
+        """Newest valid checkpoint as ``(header, payload, path)``, or None
+        when the directory holds none. Scans newest -> oldest; each
+        corrupt/truncated file costs one ``checkpoints_corrupt`` count and
+        a quarantine rename, then the scan falls back to the next older
+        file — recovery proceeds on the best verified state available. A
+        READ error (OSError) raises instead: it proves nothing about the
+        bytes, and quarantining on it could demote a valid checkpoint
+        whose WAL delta is already truncated."""
+        with self._lock:
+            for _seq, path in self.checkpoint_files():
+                try:
+                    with open(path, "rb") as fh:
+                        blob = fh.read()
+                except OSError:
+                    # A transient READ failure (EIO, NFS blip) proves
+                    # nothing about the bytes — quarantining would
+                    # permanently demote a possibly-valid newest
+                    # checkpoint whose WAL delta was already truncated
+                    # (silent loss). Fail the recovery loudly instead;
+                    # the operator/supervisor retries.
+                    logging.getLogger(__name__).exception(
+                        "checkpoint read failed (NOT corruption): %s", path)
+                    if self.metrics is not None:
+                        self.metrics.incr("checkpoint_read_errors")
+                    raise
+                try:
+                    header, payload = _decode_checkpoint(blob, path)
+                    return header, payload, path
+                except CheckpointVersionError as exc:
+                    # Intact but newer than this binary (downgrade):
+                    # skip WITHOUT quarantining — renaming it would let
+                    # retention prune valid newer state.
+                    logging.getLogger(__name__).warning(
+                        "newer-format checkpoint skipped (NOT quarantined)"
+                        ": %s", exc)
+                    if self.metrics is not None:
+                        self.metrics.incr("checkpoints_version_skipped")
+                except CheckpointCorruptError as exc:
+                    logging.getLogger(__name__).warning(
+                        "corrupt checkpoint skipped: %s", exc)
+                    if self.metrics is not None:
+                        self.metrics.incr("checkpoints_corrupt")
+                    self.quarantine(path)
+            return None
+
+    def quarantine(self, path: str) -> None:
+        """Rename a corrupt checkpoint to ``*.corrupt`` so scans skip it
+        cheaply while ops can still inspect the bytes."""
+        try:
+            os.replace(path, path + QUARANTINE_SUFFIX)
+            fsync_directory(self.directory)
+        except OSError:
+            pass
+
+    def verify(self) -> Dict[str, Any]:
+        """Offline integrity sweep (``scripts/verify_checkpoint.py``):
+        validates every installed checkpoint without quarantining.
+        Returns {"ok": [paths], "corrupt": [(path, reason)],
+        "newer_version": [(path, reason)]} — a newer-format file is
+        intact-but-unreadable-here, reported separately from damage."""
+        ok, corrupt, newer = [], [], []
+        for _seq, path in self.checkpoint_files():
+            try:
+                with open(path, "rb") as fh:
+                    _decode_checkpoint(fh.read(), path)
+                ok.append(path)
+            except CheckpointVersionError as exc:
+                newer.append((path, str(exc)))
+            except (OSError, CheckpointCorruptError) as exc:
+                corrupt.append((path, str(exc)))
+        return {"ok": ok, "corrupt": corrupt, "newer_version": newer}
+
+
+def decode_enroll_record(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Validate + decode one parsed WAL ``enroll`` record (base64 rows,
+    crc32, shape); returns the record with ``embeddings``/``labels_np``
+    attached, or None when validation fails. Pure — shared by the WAL's
+    replay and the read-only offline verifier, which must never construct
+    the writer class against live state."""
+    try:
+        raw = base64.b64decode(record["emb"], validate=True)
+        if (binascii.crc32(raw) & 0xFFFFFFFF) != record["crc32"]:
+            return None
+        n, dim = int(record["n"]), int(record["dim"])
+        emb = np.frombuffer(raw, np.float32)
+        if emb.size != n * dim:
+            return None
+        out = dict(record)
+        out["embeddings"] = emb.reshape(n, dim)
+        out["labels_np"] = np.asarray(record["labels"], np.int32)
+        return out
+    except (KeyError, TypeError, ValueError, binascii.Error):
+        return None
+
+
+class EnrollmentWAL(RotatingJournal):
+    """Write-ahead log of enrollments between checkpoints.
+
+    One JSON line per ``add()``: ``{"kind": "enroll", "seq": n, "n": rows,
+    "dim": d, "labels": [...], "label": int|null, "subject": str|null,
+    "emb": base64(<f4 row bytes), "crc32": ...}``. Strict appends (a
+    failed write raises — the acknowledgment must not lie) with the fsync
+    policy knob inherited from ``RotatingJournal`` (default ``always``
+    here: acknowledged == durable).
+
+    Unlike the dead-letter journal, the WAL NEVER rotates records away:
+    the base class's size-bound rotation would eventually unlink
+    acknowledged enrollments whenever checkpoints persistently fail (a
+    full or unwritable checkpoint directory) while appends keep
+    succeeding — a silent breach of the acknowledged-==-durable promise.
+    Crossing ``max_bytes`` here only logs + counts (``wal_over_bytes``);
+    compaction is exclusively ``truncate_below`` after a checkpoint
+    lands, so disk growth is the visible symptom and zero loss stays the
+    invariant.
+    """
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20,
+                 metrics=None, fsync: str = "always",
+                 fsync_interval_s: float = 1.0, fault_injector=None):
+        # backups=0 everywhere: size rotation is disabled below, so .1..N
+        # backup files can never exist — plumbing a backups knob through
+        # would be dead machinery inviting someone to re-enable the
+        # rotation this class deliberately forbids.
+        super().__init__(path, max_bytes=max_bytes, backups=0,
+                         metrics=metrics, fsync=fsync,
+                         fsync_interval_s=fsync_interval_s)
+        self._faults = fault_injector
+        self._warned_over_bytes = False
+        self._seal_torn_tail()
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Deliberately NOT the base rotation (class docstring): acked
+        records are never unlinked for size. One warning + a counter when
+        the WAL first crosses ``max_bytes`` (checkpoints are failing or
+        thresholds are mis-sized); appends keep going."""
+        if self._warned_over_bytes:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size + incoming <= self.max_bytes:
+            return
+        self._warned_over_bytes = True
+        if self.metrics is not None:
+            self.metrics.incr("wal_over_bytes")
+        logging.getLogger(__name__).warning(
+            "enrollment WAL exceeds %d bytes without a checkpoint "
+            "truncating it — checkpoints failing, or thresholds too "
+            "loose; records are retained (never rotated away)",
+            self.max_bytes)
+
+    def _seal_torn_tail(self) -> None:
+        """A crash mid-append leaves a partial final line with no newline;
+        the NEXT append would otherwise concatenate onto it and corrupt a
+        brand-new acknowledged record. Seal the torn tail with a newline
+        at open so it stays an isolated unparseable line (skipped on
+        replay, visible to forensics) and new appends start clean."""
+        with self._lock:
+            try:
+                if not os.path.exists(self.path) or not os.path.getsize(self.path):
+                    return
+                with open(self.path, "rb+") as fh:
+                    fh.seek(-1, os.SEEK_END)
+                    if fh.read(1) != b"\n":
+                        fh.write(b"\n")
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                        if self.metrics is not None:
+                            self.metrics.incr("wal_torn_tails_sealed")
+            except OSError:
+                if self.metrics is not None:
+                    self.metrics.incr("journal_errors")
+
+    def append_enroll(self, seq: int, embeddings: np.ndarray,
+                      labels: np.ndarray, subject: Optional[str] = None,
+                      label: Optional[int] = None) -> None:
+        """Append one enrollment record; raises on write failure (strict)
+        or injected crash. The caller acknowledges the enrollment only
+        after this returns — with ``fsync="always"`` that acknowledgment
+        is a durability promise."""
+        emb = np.ascontiguousarray(np.asarray(embeddings, np.float32))
+        labels = np.asarray(labels, np.int32)
+        if emb.ndim != 2 or emb.shape[0] != labels.shape[0]:
+            raise ValueError(f"embeddings {emb.shape} / labels "
+                             f"{labels.shape} mismatch")
+        raw = emb.tobytes()
+        record = {
+            "kind": "enroll",
+            "seq": int(seq),
+            "ts": time.time(),
+            "n": int(emb.shape[0]),
+            "dim": int(emb.shape[1]),
+            "labels": [int(v) for v in labels],
+            "label": None if label is None else int(label),
+            "subject": subject,
+            "emb": base64.b64encode(raw).decode("ascii"),
+            "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
+        }
+        line = json.dumps(record)
+        fault = self._faults.on_wal_append() if self._faults is not None else None
+        if fault == "crash":
+            raise InjectedCrashError("crash before WAL append")
+        if fault == "torn":
+            # Persist exactly half the real encoding with no newline, then
+            # die: the torn tail replay must skip.
+            with self._lock:
+                self._append_locked(line[:max(1, len(line) // 2)],
+                                    newline=False)
+            raise InjectedCrashError("torn WAL append")
+        self.append_line(line, strict=True)
+        if self.metrics is not None:
+            self.metrics.incr("wal_appends")
+            self.metrics.incr("wal_rows_appended", emb.shape[0])
+
+    def scan(self) -> Tuple[List[Dict[str, Any]], int]:
+        """ONE parse of the whole WAL -> (surviving decoded enrollments
+        oldest-first, highest seq in ANY record). The max covers enrolls,
+        aborts, even crc-failed ones whose JSON still parses: the
+        lifecycle seeds ``_wal_seq`` from it, NOT from surviving
+        enrollments — seeding from survivors would reuse an aborted
+        record's seq for the next acknowledged enrollment, and the abort
+        tombstone would then silently filter the NEW record on the next
+        recovery (acknowledged data loss). Single-pass so a large WAL
+        (checkpoints failing — exactly the degraded case recovery serves)
+        is not parsed twice per recovery."""
+        records = list(self.records())
+        highest = 0
+        aborted = set()
+        for record in records:
+            seq = record.get("seq")
+            if isinstance(seq, (int, float)):
+                highest = max(highest, int(seq))
+                if record.get("kind") == "abort":
+                    aborted.add(int(seq))
+        out = []
+        for record in records:
+            if record.get("kind") != "enroll":
+                continue
+            seq = record.get("seq")
+            if isinstance(seq, (int, float)) and int(seq) in aborted:
+                continue
+            decoded = decode_enroll_record(record)
+            if decoded is None:
+                if self.metrics is not None:
+                    self.metrics.incr("wal_corrupt_records")
+                continue
+            out.append(decoded)
+        return out, highest
+
+    def max_seq(self) -> int:
+        return self.scan()[1]
+
+    def append_abort(self, seq: int) -> None:
+        """Tombstone an enroll record whose gallery apply FAILED after the
+        append (write-ahead means the record is already durable): replay
+        must skip it — the enrolment was rolled back and never
+        acknowledged, so resurrecting its rows on restart would invent
+        phantom gallery entries. Best-effort (non-strict): if the
+        tombstone itself cannot be written we are already in the failure
+        path, and the residual risk is the same as a crash between append
+        and apply — an at-least-once replay of an unacknowledged record."""
+        self.append_line(json.dumps({"kind": "abort", "seq": int(seq),
+                                     "ts": time.time()}), strict=False)
+        if self.metrics is not None:
+            self.metrics.incr("wal_aborts")
+
+    def enrollments(self) -> Iterator[Dict[str, Any]]:
+        """Decoded enrollment records oldest-first, with aborted sequences
+        (``append_abort`` tombstones) filtered out. Torn lines are already
+        skipped by ``records``; a line that parses but fails crc/base64
+        validation is counted ``wal_corrupt_records`` and skipped too."""
+        return iter(self.scan()[0])
+
+    def truncate_below(self, seq: int) -> None:
+        """Compact away records with ``seq`` <= the given sequence (they
+        are covered by an installed checkpoint): the file is rewritten
+        with only the surviving records and atomically swapped in.
+        Correctness never depends on this running — replay dedups against
+        the checkpoint's ``wal_seq`` either way; truncation only bounds
+        disk."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+            survivors: List[str] = []
+            try:
+                with open(self.path, "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    for line in fh:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                            covered = (isinstance(rec, dict)
+                                       and int(rec.get("seq", 0)) <= seq)
+                        except (json.JSONDecodeError, TypeError, ValueError):
+                            continue  # torn/garbage remnant: drop it
+                        if not covered:
+                            survivors.append(line)
+            except OSError:
+                return
+            blob = ("\n".join(survivors) + "\n") if survivors else ""
+            try:
+                atomic_write_bytes(self.path, blob.encode("utf-8"))
+                self._warned_over_bytes = False  # compacted: re-arm
+            except OSError:
+                if self.metrics is not None:
+                    self.metrics.incr("journal_errors")
+
+
+class StateLifecycle:
+    """Glue layer: WAL-backed enrollments, threshold-driven background
+    checkpoints, and startup recovery over one ``state_dir``::
+
+        state_dir/
+          checkpoints/ckpt-00000001.ckpt   # CheckpointStore
+          enroll.wal                        # EnrollmentWAL (+ .1 .. .N)
+
+    Attach to a ``RecognizerService`` (``attach``) or bind a bare gallery
+    + subject-name list (``bind``) — the chaos scenario drives the latter.
+    """
+
+    def __init__(self, state_dir: str, metrics=None, keep_checkpoints: int = 3,
+                 checkpoint_wal_rows: int = 256,
+                 checkpoint_every_s: float = 300.0,
+                 wal_fsync: str = "always", wal_fsync_interval_s: float = 1.0,
+                 wal_max_bytes: int = 64 << 20,
+                 fault_injector=None):
+        self.state_dir = str(state_dir)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.metrics = metrics
+        self.checkpoint_wal_rows = int(checkpoint_wal_rows)
+        self.checkpoint_every_s = float(checkpoint_every_s)
+        self._faults = fault_injector
+        self.store = CheckpointStore(os.path.join(self.state_dir, "checkpoints"),
+                                     keep=keep_checkpoints, metrics=metrics)
+        self.wal = EnrollmentWAL(os.path.join(self.state_dir, "enroll.wal"),
+                                 max_bytes=wal_max_bytes,
+                                 metrics=metrics, fsync=wal_fsync,
+                                 fsync_interval_s=wal_fsync_interval_s,
+                                 fault_injector=fault_injector)
+        #: highest WAL sequence appended (or observed during recovery).
+        self._wal_seq = 0
+        self._rows_since_ckpt = 0
+        self._last_ckpt_t = time.monotonic()
+        # _enroll_lock orders WAL appends + gallery mutations against the
+        # checkpoint snapshot: a record with seq <= the snapshot-time
+        # _wal_seq is provably IN the snapshot (its apply ran inside the
+        # lock before the snapshot took it), so replay-after-recovery can
+        # dedup exactly. Never acquire the WAL's file lock first.
+        self._enroll_lock = threading.Lock()
+        # Single-flight guard: one background checkpoint at a time; an
+        # overlapping THRESHOLD trigger is counted and skipped (the
+        # thresholds re-fire), but a FORCED trigger (reload_gallery: the
+        # in-flight checkpoint may have snapshotted the pre-swap gallery)
+        # latches _force_pending so the next tick retries it.
+        self._ckpt_lock = threading.Lock()
+        self._force_pending = False
+        # Failure backoff: a persistently failing save (disk full) must
+        # not re-run a full snapshot+serialize on every serving-loop tick.
+        self._ckpt_retry_backoff_s = 1.0
+        self._ckpt_retry_at = 0.0
+        self._gallery = None
+        self._subject_names: Optional[list] = None
+        self._service = None
+        self._closed = False
+
+    # ---- wiring ----
+
+    def bind(self, gallery, subject_names: list) -> None:
+        """Point the lifecycle at a bare gallery + live subject-name list
+        (the list object is read at checkpoint time, not copied now)."""
+        self._gallery = gallery
+        self._subject_names = subject_names
+
+    def attach(self, service) -> None:
+        """Wire into a ``RecognizerService``: checkpoints read the live
+        pipeline's gallery (it may be swapped by reload/CPU-fallback) and
+        the service's subject names; committed gallery changes nudge the
+        threshold check via the service's commit hooks."""
+        self._service = service
+        service.commit_hooks.append(self.maybe_checkpoint)
+
+    def _targets(self):
+        if self._service is not None:
+            return (self._service.pipeline.gallery,
+                    self._service.subject_names)
+        if self._gallery is None:
+            raise RuntimeError("StateLifecycle has no gallery: call "
+                               "attach(service) or bind(gallery, names)")
+        return self._gallery, self._subject_names
+
+    @property
+    def wal_seq(self) -> int:
+        return self._wal_seq
+
+    @property
+    def rows_since_checkpoint(self) -> int:
+        return self._rows_since_ckpt
+
+    # ---- recovery ----
+
+    def recover(self, gallery=None, subject_names: Optional[list] = None) -> Dict[str, Any]:
+        """Startup recovery: install the newest verified checkpoint into
+        the gallery (``load_snapshot`` — capacity/size/labels adopt the
+        checkpoint's), restore subject names, then replay WAL records with
+        ``seq`` beyond the checkpoint's recorded ``wal_seq`` in order.
+        Runs under the enroll lock — the supervisor's mid-run durable
+        restore must not interleave with a concurrent enrolment append or
+        a background checkpoint's snapshot. Returns a report dict; raises
+        ``ValueError`` when the checkpoint's embedding dim does not match
+        the gallery (a state dir pointed at the wrong model is an operator
+        error, not a fallback case)."""
+        if gallery is not None:
+            self.bind(gallery, subject_names if subject_names is not None
+                      else [])
+        gallery, names = self._targets()
+        report: Dict[str, Any] = {"recovered_checkpoint": None,
+                                  "checkpoint_size": 0, "replayed_records": 0,
+                                  "replayed_rows": 0, "skipped_records": 0}
+        with self._enroll_lock:
+            base_seq = self._recover_checkpoint_locked(gallery, names, report)
+            # WAL replay: acknowledged enrollments since that checkpoint
+            # (one scan pass also yields the seq high-water mark).
+            surviving, highest = self.wal.scan()
+            for record in surviving:
+                seq = int(record["seq"])
+                if seq <= base_seq:
+                    report["skipped_records"] += 1
+                    if self.metrics is not None:
+                        self.metrics.incr("wal_skipped_records")
+                    continue
+                gallery.add(record["embeddings"], record["labels_np"])
+                self._grow_names(names, record)
+                report["replayed_records"] += 1
+                report["replayed_rows"] += int(record["n"])
+                if self.metrics is not None:
+                    self.metrics.incr("wal_replayed_records")
+                    self.metrics.incr("wal_replayed_rows", int(record["n"]))
+            # Seed the sequence from EVERY record — aborts and corrupt-
+            # but-parseable ones included (wal.scan docstring): seeding
+            # from surviving enrollments alone would reuse a tombstoned
+            # seq and the tombstone would filter the NEW record later.
+            self._wal_seq = max(base_seq, highest)
+            self._rows_since_ckpt = report["replayed_rows"]
+        wait_ready = getattr(gallery, "wait_ready", None)
+        if wait_ready is not None:
+            wait_ready(timeout=300.0)
+        self._last_ckpt_t = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.incr("state_recoveries")
+            self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+        report["gallery_size"] = gallery.size
+        return report
+
+    def _recover_checkpoint_locked(self, gallery, names,
+                                   report: Dict[str, Any]) -> int:
+        """Install the newest checkpoint that BOTH checksum-verifies and
+        payload-decodes, quarantining + falling back past any that fails
+        either test (a checksum-valid payload msgpack rejects is corrupt
+        all the same — stopping at it would silently discard every older
+        valid checkpoint and recover WAL-only). Returns the installed
+        checkpoint's ``wal_seq`` (0 when none installed)."""
+        from flax import serialization as flax_serialization
+
+        while True:
+            loaded = self.store.load_latest()
+            if loaded is None:
+                return 0
+            header, payload, path = loaded
+            meta = header.get("meta", {})
+            dim = int(meta.get("dim", -1))
+            if dim != gallery.dim:
+                raise ValueError(
+                    f"state dir {self.state_dir!r} holds dim={dim} "
+                    f"checkpoints but the gallery is dim={gallery.dim} — "
+                    f"wrong --state-dir for this model?")
+            try:
+                state = flax_serialization.msgpack_restore(payload)
+                emb = np.asarray(state["emb"], np.float32)
+                lab = np.asarray(state["lab"], np.int32)
+                val = np.asarray(state["val"], bool)
+            except Exception as exc:  # noqa: BLE001 — decode-corrupt
+                logging.getLogger(__name__).warning(
+                    "checkpoint %s payload decode failed (%r); falling "
+                    "back to the previous checkpoint", path, exc)
+                if self.metrics is not None:
+                    self.metrics.incr("checkpoints_corrupt")
+                report.setdefault("payload_decode_errors", []).append(repr(exc))
+                self.store.quarantine(path)
+                continue
+            size = int(meta.get("size", int(val.sum())))
+            gallery.load_snapshot(emb, lab, val, size)
+            if names is not None:
+                names[:] = [str(s) for s in meta.get("subject_names", [])]
+            report["recovered_checkpoint"] = path
+            report["checkpoint_size"] = size
+            return int(meta.get("wal_seq", 0))
+
+    @staticmethod
+    def _grow_names(names: Optional[list], record: Dict[str, Any]) -> None:
+        """Re-grow the subject-name list from a replayed record: the name
+        lives at index ``label`` exactly as the enrolling service placed
+        it (gaps get placeholders — they can only arise from a baseline
+        checkpoint written without names, or a tombstoned record whose
+        label slot a later enrolment reused)."""
+        if names is None or record.get("label") is None:
+            return
+        label = int(record["label"])
+        while len(names) <= label:
+            names.append(f"subject_{len(names)}")
+        if record.get("subject"):
+            names[label] = str(record["subject"])
+
+    # ---- write path ----
+
+    def append_enrollment(self, embeddings: np.ndarray, labels: np.ndarray,
+                          subject: Optional[str] = None,
+                          label: Optional[int] = None,
+                          apply_fn: Optional[Callable[[], None]] = None) -> int:
+        """Write-ahead append + apply: the WAL record lands (fsynced per
+        policy) BEFORE ``apply_fn`` mutates the gallery, both under the
+        enroll lock, so (a) a crash after the append replays the rows on
+        restart, and (b) a concurrent checkpoint can never capture gallery
+        rows the WAL hasn't sequenced (its dedup would otherwise double-
+        apply them). Returns the record's sequence number; raises when the
+        append fails — the caller must NOT acknowledge the enrollment."""
+        n = int(np.asarray(labels).shape[0])
+        with self._enroll_lock:
+            # Burn the sequence BEFORE attempting the append: a failed
+            # strict append (fsync raised) may still have landed the full
+            # record bytes — reissuing the seq to the next enrollment
+            # would leave two enroll records sharing it, which replay
+            # cannot tell apart (phantom rows / cross-subject labels).
+            seq = self._wal_seq = self._wal_seq + 1
+            try:
+                self.wal.append_enroll(seq, embeddings, labels,
+                                       subject=subject, label=label)
+            except InjectedCrashError:
+                raise  # simulated kill: no post-mortem writes
+            except BaseException:
+                # Best-effort tombstone for the possibly-landed record;
+                # if this fails too the residual risk is the documented
+                # at-least-once replay of an UNacknowledged record.
+                self.wal.append_abort(seq)
+                raise
+            if apply_fn is not None:
+                try:
+                    apply_fn()
+                except BaseException:
+                    # The apply failed AFTER the record became durable: the
+                    # caller rolls the enrolment back and never
+                    # acknowledges it, so tombstone the record — replay
+                    # must not resurrect rows the live gallery never got.
+                    self.wal.append_abort(seq)
+                    raise
+            self._rows_since_ckpt += n
+        if self.metrics is not None:
+            self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+        self.maybe_checkpoint()
+        return seq
+
+    def stamped_snapshot(self):
+        """(wal_seq, gallery snapshot, subject-names copy) read atomically
+        against enrollments — ``ServiceSupervisor.checkpoint`` pairs its
+        in-memory snapshot with the WAL sequence it covers so a crash
+        restore can replay the acknowledged tail (``replay_tail``)."""
+        gallery, names = self._targets()
+        with self._enroll_lock:
+            return (self._wal_seq, gallery.snapshot(),
+                    list(names) if names is not None else None)
+
+    def replay_tail(self, from_seq: int) -> int:
+        """Re-apply acknowledged WAL records with ``seq > from_seq`` to
+        the live gallery; returns rows replayed. The supervisor's
+        in-memory restore rolls the gallery back to a snapshot stamped
+        ``from_seq`` — WITHOUT this replay, enrollments acknowledged after
+        that stamp would vanish from serving, and the next background
+        checkpoint (whose header claims the current ``wal_seq``) would
+        truncate their WAL records: permanent loss of fsync-acknowledged
+        data."""
+        gallery, names = self._targets()
+        rows = 0
+        with self._enroll_lock:
+            surviving, _highest = self.wal.scan()
+            for record in surviving:
+                if int(record["seq"]) <= from_seq:
+                    continue
+                gallery.add(record["embeddings"], record["labels_np"])
+                self._grow_names(names, record)
+                rows += int(record["n"])
+        if rows and self.metrics is not None:
+            self.metrics.incr("wal_tail_replayed_rows", rows)
+        return rows
+
+    # ---- checkpointing ----
+
+    def checkpoint_due(self) -> bool:
+        if time.monotonic() < self._ckpt_retry_at:
+            return False  # failure backoff window (see checkpoint_now)
+        if self._force_pending:
+            return True
+        if self._rows_since_ckpt >= self.checkpoint_wal_rows:
+            return True
+        return (self._rows_since_ckpt > 0
+                and time.monotonic() - self._last_ckpt_t
+                >= self.checkpoint_every_s)
+
+    def tick(self) -> None:
+        """Cheap per-loop-iteration threshold check (the serving loop
+        calls this): a few comparisons in the common case."""
+        if self.checkpoint_due():
+            self.maybe_checkpoint()
+
+    def maybe_checkpoint(self, force: bool = False) -> bool:
+        """Spawn a background checkpoint when thresholds say so (or
+        ``force``). Returns True when a worker was started. Single-flight:
+        a THRESHOLD trigger overlapping an in-flight checkpoint is counted
+        and dropped (the thresholds re-fire on their own); a FORCED one is
+        latched instead — the in-flight checkpoint may predate the state
+        change that forced this request (a reload swap), so the next tick
+        must retry until a post-request snapshot lands."""
+        if self._closed:
+            return False
+        if force:
+            self._force_pending = True
+        elif not self.checkpoint_due():
+            return False
+        if self._ckpt_lock.locked():
+            if self.metrics is not None:
+                self.metrics.incr("checkpoints_skipped_inflight")
+            return False
+        threading.Thread(target=self.checkpoint_now, daemon=True,
+                         name="state-checkpoint").start()
+        return True
+
+    def checkpoint_now(self, wait: bool = False) -> bool:
+        """Take one durable checkpoint synchronously: snapshot the gallery
+        host mirrors (+ wal_seq, atomically vs. enrollments), serialize,
+        install via the store, then compact the WAL below the captured
+        sequence. Returns True on success; False when another checkpoint
+        holds the single-flight guard (unless ``wait`` — the graceful-
+        shutdown path must not skip its FINAL checkpoint just because a
+        background one is mid-flight) or the save failed (counted
+        ``checkpoint_failures`` — the previous checkpoint stays
+        last-known-good). An ``InjectedCrashError`` propagates — it is a
+        simulated kill, not a failure to handle."""
+        if not self._ckpt_lock.acquire(blocking=wait):
+            if self.metrics is not None:
+                self.metrics.incr("checkpoints_skipped_inflight")
+            return False
+        # Claim any pending force request BEFORE snapshotting: this
+        # attempt's snapshot postdates the request, so success satisfies
+        # it; failure paths restore the latch so ticks keep retrying.
+        claimed_force = self._force_pending
+        self._force_pending = False
+        try:
+            gallery, names = self._targets()
+            # Bounded wait for async-grow staged rows: a snapshot taken
+            # mid-grow would miss rows whose WAL records this checkpoint
+            # claims to cover.
+            wait_ready = getattr(gallery, "wait_ready", None)
+            if wait_ready is not None:
+                wait_ready(timeout=30.0)
+            with self._enroll_lock:
+                # Staged-rows guard, read under the enroll lock: staging
+                # only happens inside append_enrollment (which holds this
+                # lock), so pending can only DRAIN during this section —
+                # pending == 0 here proves the snapshot below contains
+                # every sequenced row. Nonzero (a grow still in flight,
+                # wedged, or failed-and-awaiting-retry) means some records
+                # <= wal_seq are NOT in the snapshot: writing a checkpoint
+                # that claims them (or truncating their WAL records) would
+                # lose acknowledged enrollments — DEFER instead; the
+                # thresholds re-trigger, and until then the previous
+                # checkpoint + full WAL stay consistent.
+                if getattr(gallery, "pending_rows", 0):
+                    if self.metrics is not None:
+                        self.metrics.incr("checkpoints_deferred_pending")
+                    logging.getLogger(__name__).warning(
+                        "checkpoint deferred: %d staged rows not yet "
+                        "landed", gallery.pending_rows)
+                    self._force_pending = self._force_pending or claimed_force
+                    # Short retry pause: each attempt already waited up to
+                    # 30 s for the grow; don't spin a new worker per tick.
+                    self._ckpt_retry_at = time.monotonic() + 5.0
+                    return False
+                wal_seq = self._wal_seq
+                rows_at = self._rows_since_ckpt
+                emb, lab, val, size = gallery.snapshot()
+                names_copy = [] if names is None else list(names)
+            from flax import serialization as flax_serialization
+
+            payload = flax_serialization.msgpack_serialize(
+                {"emb": emb, "lab": lab, "val": val})
+            meta = {
+                "kind": "gallery",
+                "size": int(size),
+                "capacity": int(emb.shape[0]),
+                "dim": int(emb.shape[1]),
+                "subject_names": names_copy,
+                "wal_seq": wal_seq,
+            }
+            fault = (self._faults.on_checkpoint()
+                     if self._faults is not None else None)
+            try:
+                self.store.save(payload, meta,
+                                fault=fault if fault != "late" else None)
+            except InjectedCrashError:
+                raise
+            except Exception:  # noqa: BLE001 — disk full, perms, ...
+                logging.getLogger(__name__).exception("checkpoint save failed")
+                if self.metrics is not None:
+                    self.metrics.incr("checkpoint_failures")
+                # Exponential retry backoff: a persistently failing save
+                # (full/unwritable dir) must not re-run a whole-gallery
+                # snapshot + serialize on every serving-loop tick.
+                self._force_pending = self._force_pending or claimed_force
+                self._ckpt_retry_at = (time.monotonic()
+                                       + self._ckpt_retry_backoff_s)
+                self._ckpt_retry_backoff_s = min(
+                    60.0, self._ckpt_retry_backoff_s * 2.0)
+                return False
+            if fault == "late":
+                # The checkpoint landed; die before the WAL truncation —
+                # the replay-dedup window the wal_seq header exists for.
+                raise InjectedCrashError("crash after checkpoint, before "
+                                         "WAL truncate")
+            self.wal.truncate_below(wal_seq)
+            with self._enroll_lock:
+                self._rows_since_ckpt = max(0, self._rows_since_ckpt - rows_at)
+            self._last_ckpt_t = time.monotonic()
+            self._ckpt_retry_backoff_s = 1.0
+            self._ckpt_retry_at = 0.0
+            if self.metrics is not None:
+                self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+            return True
+        finally:
+            self._ckpt_lock.release()
+
+    def close(self) -> None:
+        self._closed = True
+        self.wal.close()
+
+
+def graceful_shutdown(service, state: Optional[StateLifecycle] = None,
+                      supervisor=None, drain_timeout: float = 60.0) -> Dict[str, Any]:
+    """The SIGTERM path (``ocvf-recognize`` wires this behind a signal
+    handler): drain in-flight batches so accepted frames publish, stop the
+    service (queued leftovers are journaled as ``closed`` drops — every
+    admitted frame still lands in exactly one ledger bucket), take a final
+    checkpoint, truncate the WAL, and report. The caller exits 0 when
+    ``report["clean"]``."""
+    drained = service.drain(timeout=drain_timeout)
+    if supervisor is not None:
+        supervisor.stop()
+    else:
+        service.stop()
+    report: Dict[str, Any] = {"drained": drained}
+    if state is not None:
+        report["final_checkpoint"] = state.checkpoint_now(wait=True)
+        state.close()
+    ledger = service.ledger()
+    report["ledger"] = ledger
+    report["clean"] = bool(drained and abs(ledger["in_system"]) < 1e-6
+                           and (state is None or report["final_checkpoint"]))
+    return report

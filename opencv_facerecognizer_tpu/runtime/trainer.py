@@ -78,6 +78,9 @@ class TheTrainer:
             setattr(self.config, key, value)
         self.model: Optional[ExtendedPredictableModel] = None
         self.validation: Optional[KFoldCrossValidation] = None
+        #: previous model checkpoints retained on save (rotated to
+        #: ``<model_path>.1..N``); 0 = overwrite only (still atomic).
+        self.keep_checkpoints = 0
 
     # ---- model zoo ----
 
@@ -183,7 +186,11 @@ class TheTrainer:
         model.compute(images, labels)
         self.model = model
         if model_path:
-            serialization.save_model(model_path, model)
+            # Atomic write (tmp+fsync+rename) — a crash mid-save keeps the
+            # previous checkpoint; keep_checkpoints>0 also rotates it to
+            # model.ckpt.1..N so retrains retain history.
+            serialization.save_model(model_path, model,
+                                     keep_previous=self.keep_checkpoints)
         return model
 
     @property

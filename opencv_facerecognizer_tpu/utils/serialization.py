@@ -18,12 +18,92 @@ participates — including operators, which recursively serialize children.
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict
 
 import numpy as np
 from flax import serialization as flax_serialization
 
 FORMAT_VERSION = 1
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint file failed decode/validation — truncated, garbage, or
+    missing its header. Deliberately a ``ValueError`` subclass so existing
+    broad handlers keep working, but precise enough that recovery code can
+    fall back to an older checkpoint instead of treating the failure as a
+    code bug."""
+
+
+def fsync_directory(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives a power cut.
+    Best-effort: some filesystems refuse O_RDONLY dir fsync — a failure
+    only widens the durability window back to the kernel's writeback."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(filename: str, blob: bytes,
+                       keep_previous: int = 0) -> None:
+    """Crash-safe file write: tmp in the same directory + flush + fsync +
+    atomic rename + directory fsync. A crash at any point leaves either the
+    old file intact or the new one complete — never a torn half-write under
+    the final name (the seed's bare ``open+write`` could corrupt the ONLY
+    checkpoint mid-save). With ``keep_previous > 0`` the existing file's
+    content is preserved at ``filename.1..N`` — hardlinked AFTER the tmp
+    is durable, so neither a write failure (ENOSPC) nor process death
+    between the rotate and the install ever leaves ``filename`` absent or
+    stale-only-under-``.1``."""
+    filename = str(filename)
+    directory = os.path.dirname(os.path.abspath(filename))
+    tmp = filename + ".tmp"
+    fh = open(tmp, "wb")
+    try:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    finally:
+        fh.close()
+    if keep_previous > 0:
+        rotate_backups(filename, keep_previous)
+    os.replace(tmp, filename)
+    fsync_directory(directory)
+
+
+def rotate_backups(filename: str, keep: int) -> None:
+    """Preserve the current file's content at ``filename.1`` (shifting
+    ``.1 -> .2 -> ... -> .keep``, dropping the oldest) so an atomic
+    overwrite can retain previous versions — ``ocvf-train
+    --keep-checkpoints`` uses this to keep the last N model checkpoints
+    across retrains.
+
+    ``filename`` itself is HARDLINKED to ``.1``, not renamed: the final
+    name stays present throughout, so a SIGKILL/power cut anywhere in the
+    rotate-then-install sequence never leaves the path empty (a rename
+    here would open exactly that window). On a filesystem without
+    hardlinks the rename fallback reopens that (tiny) window — renames
+    only, never data loss."""
+    if keep <= 0 or not os.path.exists(filename):
+        return
+    oldest = f"{filename}.{keep}"
+    if os.path.exists(oldest):
+        os.remove(oldest)
+    for i in range(keep - 1, 0, -1):
+        src = f"{filename}.{i}"
+        if os.path.exists(src):
+            os.replace(src, f"{filename}.{i + 1}")
+    try:
+        os.link(filename, f"{filename}.1")
+    except OSError:
+        os.replace(filename, f"{filename}.1")
 
 #: registry-name -> class, populated lazily to avoid import cycles.
 _REGISTRY: Dict[str, type] = {}
@@ -92,24 +172,43 @@ def _to_numpy_tree(state: Any) -> Any:
     return np.asarray(state)
 
 
-def save_model(filename: str, model: Any) -> None:
-    """Write {header, spec, state} as one msgpack blob. No pickle anywhere."""
+def save_model(filename: str, model: Any, keep_previous: int = 0) -> None:
+    """Write {header, spec, state} as one msgpack blob. No pickle anywhere.
+
+    The write is atomic (tmp + fsync + rename): a crash mid-save leaves the
+    previous checkpoint intact, never a truncated file under ``filename``.
+    ``keep_previous > 0`` additionally rotates the existing file to
+    ``filename.1`` (... ``.keep_previous``) before the rename."""
     payload = {
         "header": {"format_version": FORMAT_VERSION, "spec_json": json.dumps(serialize_spec(model))},
         "state": _to_numpy_tree(model.get_state()),
     }
     blob = flax_serialization.msgpack_serialize(payload)
-    with open(filename, "wb") as fh:
-        fh.write(blob)
+    atomic_write_bytes(filename, blob, keep_previous=keep_previous)
 
 
 def load_model(filename: str) -> Any:
     with open(filename, "rb") as fh:
-        payload = flax_serialization.msgpack_restore(fh.read())
+        blob = fh.read()
+    try:
+        payload = flax_serialization.msgpack_restore(blob)
+    except Exception as exc:  # noqa: BLE001 — msgpack raises assorted types
+        raise CheckpointCorruptError(
+            f"checkpoint {filename!r} failed msgpack decode (truncated or "
+            f"garbage): {exc!r}") from exc
+    if not isinstance(payload, dict) or "header" not in payload:
+        raise CheckpointCorruptError(
+            f"checkpoint {filename!r} decoded but has no header — not an "
+            f"ocvf model checkpoint")
     header = payload["header"]
-    version = int(header["format_version"])
+    try:
+        version = int(header["format_version"])
+        spec = json.loads(header["spec_json"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise CheckpointCorruptError(
+            f"checkpoint {filename!r} has a malformed header: {exc!r}") from exc
     if version > FORMAT_VERSION:
         raise ValueError(f"checkpoint format v{version} is newer than supported v{FORMAT_VERSION}")
-    model = deserialize_spec(json.loads(header["spec_json"]))
+    model = deserialize_spec(spec)
     model.set_state(payload.get("state", {}))
     return model

@@ -428,23 +428,330 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
     return report
 
 
+def run_recovery(seconds: float = 4.0, seed: int | None = None,
+                 state_dir: str | None = None) -> dict:
+    """Crash-recovery scenario (state lifecycle acceptance): seeded kill
+    points injected at every durability boundary — mid-WAL-append (torn
+    and before-write), mid-checkpoint (torn tmp, crash-before-rename, and
+    crash-after-rename-before-WAL-truncate), post-rename media corruption
+    of the newest checkpoint, and a kill mid-restore — across repeated
+    simulated process lifetimes over ONE state directory.
+
+    Invariant asserted every "restart": recovery lands on a
+    checksum-verified gallery holding EXACTLY the acknowledged enrollment
+    history (an ``append_enrollment`` that returned, WAL at ``always``) —
+    bit-equal rows, zero loss, zero phantoms — with ``checkpoints_corrupt``
+    incremented whenever a corrupt newest checkpoint forced fallback.
+
+    Ends with a **graceful-drain phase**: a live service (deterministic
+    ``InstantPipeline`` backend) takes frames, then the SIGTERM path
+    (``state_store.graceful_shutdown``) must complete in-flight frames,
+    settle the admission ledger exactly, write a final checkpoint, and
+    leave the WAL empty.
+    """
+    import random as random_mod
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        FakeConnector, FaultInjector, RecognizerService, StateLifecycle,
+        graceful_shutdown,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+    from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak recovery seed={seed} seconds={seconds}",
+          file=sys.stderr)
+    rng = random_mod.Random(seed)
+    frame_rng = np.random.default_rng(seed)
+
+    temp_dir = state_dir is None
+    if temp_dir:
+        state_dir = tempfile.mkdtemp(prefix="ocvf_recovery_")
+    mesh = make_mesh()
+    DIM = 8
+
+    #: acknowledged history: (seq, raw embeddings, labels, subject, label)
+    #: — only appended AFTER append_enrollment returns (the fsync ack).
+    acked: list = []
+    report = {"scenario": "recovery", "seed": seed, "seconds": seconds,
+              "state_dir": state_dir, "ok": False}
+    failures: list = []
+    counts = {"rounds": 0, "kills": 0, "wal_torn": 0, "wal_crash": 0,
+              "ckpt_torn": 0, "ckpt_crash": 0, "ckpt_late": 0,
+              "media_corrupt": 0, "mid_restore_kills": 0,
+              "checkpoints_corrupt": 0, "replayed_rows": 0}
+
+    def expected_rows():
+        """The normalized row matrix + labels recovery must reproduce."""
+        if not acked:
+            return np.zeros((0, DIM), np.float32), np.zeros((0,), np.int32)
+        emb = np.concatenate([e for _s, e, _l, _su, _la in acked])
+        lab = np.concatenate([l for _s, _e, l, _su, _la in acked])
+        norm = emb / np.maximum(
+            np.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+        return norm.astype(np.float32), lab.astype(np.int32)
+
+    def verify_recovered(gallery, where: str) -> None:
+        want_emb, want_lab = expected_rows()
+        got_emb, got_lab, got_val, got_size = gallery.snapshot()
+        if got_size != len(want_lab):
+            failures.append(
+                f"{where}: recovered {got_size} rows, expected "
+                f"{len(want_lab)} acknowledged rows (seed={seed})")
+            return
+        if got_size and not np.array_equal(got_lab[:got_size], want_lab):
+            failures.append(f"{where}: recovered labels differ")
+            return
+        if got_size and not np.allclose(got_emb[:got_size], want_emb,
+                                        rtol=0, atol=1e-6):
+            failures.append(f"{where}: recovered embeddings differ")
+
+    # Rounds derive from the time budget DETERMINISTICALLY (not from the
+    # wall clock): the kill schedule is a pure function of (seed, seconds),
+    # so a replay with the printed seed reproduces the exact same crash
+    # sequence regardless of machine speed.
+    n_rounds = max(6, min(60, int(seconds * 5)))
+    metrics = None
+    try:
+        while counts["rounds"] < n_rounds:
+            counts["rounds"] += 1
+            injector = FaultInjector(seed=seed + counts["rounds"])
+            metrics = Metrics()
+            # ---- "restart": fresh process state over the same dir ----
+            if acked and rng.random() < 0.25:
+                # Kill mid-restore: run a recovery, discard everything it
+                # built, restart again — recovery is read-only on the
+                # durable files (quarantine renames are idempotent), so a
+                # second restore must land identically.
+                counts["mid_restore_kills"] += 1
+                counts["kills"] += 1
+                scratch = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+                # Shares the round's metrics: a corrupt checkpoint
+                # quarantined by THIS (killed) restore must still show up
+                # in the counted fallbacks.
+                StateLifecycle(state_dir, metrics=metrics).recover(
+                    scratch, [])
+            gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+            names: list = []
+            state = StateLifecycle(
+                state_dir, metrics=metrics, keep_checkpoints=3,
+                # Manual checkpoints only: the kill schedule owns timing.
+                checkpoint_wal_rows=1 << 30, checkpoint_every_s=1e9,
+                fault_injector=injector)
+            rec = state.recover(gallery, names)
+            counts["checkpoints_corrupt"] += int(
+                metrics.counter("checkpoints_corrupt"))
+            counts["replayed_rows"] += rec["replayed_rows"]
+            verify_recovered(gallery, f"round {counts['rounds']} recovery")
+            # Subject names must match the acknowledged mapping too.
+            for _seq, _e, _l, subject, label in acked:
+                if label < len(names) and names[label] != subject:
+                    failures.append(
+                        f"round {counts['rounds']}: name[{label}] = "
+                        f"{names[label]!r}, expected {subject!r}")
+                    break
+
+            # ---- live phase: enrollments with seeded kill points ----
+            died = False
+            for _ in range(rng.randint(2, 5)):
+                n = rng.randint(1, 3)
+                emb = frame_rng.normal(size=(n, DIM)).astype(np.float32)
+                label = len(names)
+                subject = f"subject_{len(acked)}"
+                labels = np.full(n, label, np.int32)
+                kill = rng.random()
+                if kill < 0.15:
+                    injector.script("wal", "torn")
+                elif kill < 0.25:
+                    injector.script("wal", "crash")
+                try:
+                    seq = state.append_enrollment(
+                        emb, labels, subject=subject, label=label,
+                        apply_fn=lambda e=emb, l=labels: gallery.add(e, l))
+                except InjectedCrashError:
+                    # Process died mid-append: NOT acknowledged — the
+                    # enrollment may or may not survive; what recovery
+                    # must never do is lose an ACKED one or invent rows
+                    # (a torn record never replays: crc/json guard).
+                    counts["kills"] += 1
+                    counts["wal_torn" if kill < 0.15 else "wal_crash"] += 1
+                    died = True
+                    break
+                names.append(subject)
+                acked.append((seq, emb, labels, subject, label))
+            if died:
+                continue  # abandoned without close(): a real crash
+
+            # ---- checkpoint attempts with seeded kill points ----
+            if rng.random() < 0.7:
+                kill = rng.random()
+                fault = None
+                if kill < 0.2:
+                    fault, key = "torn", "ckpt_torn"
+                elif kill < 0.35:
+                    fault, key = "crash", "ckpt_crash"
+                elif kill < 0.5:
+                    fault, key = "late", "ckpt_late"
+                if fault is not None:
+                    injector.script("checkpoint", fault)
+                try:
+                    state.checkpoint_now(wait=True)
+                except InjectedCrashError:
+                    counts["kills"] += 1
+                    counts[key] += 1
+                    if fault == "late":
+                        # The checkpoint INSTALLED; the WAL truncate never
+                        # ran. Sometimes additionally corrupt the newest
+                        # file on disk (the torn-rename/media shape): the
+                        # next recovery must fall back past it — the WAL
+                        # still covers everything.
+                        if rng.random() < 0.6:
+                            files = state.store.checkpoint_files()
+                            if files:
+                                path = files[0][1]
+                                blob = open(path, "rb").read()
+                                with open(path, "wb") as fh:
+                                    fh.write(blob[:int(len(blob) * 0.6)])
+                                counts["media_corrupt"] += 1
+                    continue  # died: next round restarts
+            # Clean shutdown of this lifetime (no close: daemon-style exit)
+
+        # ---- final full verification over a clean recovery ----
+        final_metrics = Metrics()
+        gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+        names = []
+        state = StateLifecycle(state_dir, metrics=final_metrics)
+        state.recover(gallery, names)
+        # A media corruption injected in the LAST round is quarantined by
+        # THIS recovery — fold its fallback count in too.
+        counts["checkpoints_corrupt"] += int(
+            final_metrics.counter("checkpoints_corrupt"))
+        verify_recovered(gallery, "final recovery")
+        if not state.checkpoint_now(wait=True):
+            failures.append("final checkpoint failed")
+        # Offline verification must pass on what recovery left installed.
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "verify_checkpoint",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "verify_checkpoint.py"))
+        verify_mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(verify_mod)
+        vreport = verify_mod.verify_state_dir(state_dir)
+        report["verify"] = {"ok": vreport["ok"],
+                            "checkpoints": len(vreport["checkpoints"]),
+                            "corrupt": vreport["corrupt"]}
+        if not vreport["ok"]:
+            failures.append(f"offline verification failed: "
+                            f"{vreport['corrupt']}")
+
+        # ---- graceful-drain phase (the SIGTERM path) ----
+        frame_shape = (16, 16)
+        drain_metrics = Metrics()
+        pipe = InstantPipeline(frame_shape, dispatch_s=0.002)
+        pipe.gallery = gallery
+        connector = FakeConnector()
+        drain_state = StateLifecycle(state_dir, metrics=drain_metrics,
+                                     checkpoint_wal_rows=1 << 30,
+                                     checkpoint_every_s=1e9)
+        service = RecognizerService(
+            pipe, connector, batch_size=4, frame_shape=frame_shape,
+            flush_timeout=0.02, state_store=drain_state)
+        # recover() was already run for this dir; bind fresh seq state so
+        # the drain-phase enrollment sequences continue, not collide.
+        drain_state.recover(gallery, names)
+        service.subject_names = names
+        service.start(warmup=False)
+        frame = np.zeros(frame_shape, np.float32)
+        sent = 24
+        for i in range(sent):
+            connector.inject(FRAME_TOPIC,
+                             {**encode_frame(frame), "meta": {"seq": i}})
+        # One in-flight enrollment through the write-ahead path.
+        emb = frame_rng.normal(size=(2, DIM)).astype(np.float32)
+        label = len(names)
+        drain_state.append_enrollment(
+            emb, np.full(2, label, np.int32), subject="drain_subject",
+            label=label,
+            apply_fn=lambda: gallery.add(emb, np.full(2, label, np.int32)))
+        names.append("drain_subject")
+        acked.append((drain_state.wal_seq, emb, np.full(2, label, np.int32),
+                      "drain_subject", label))
+        shutdown = graceful_shutdown(service, state=drain_state,
+                                     drain_timeout=30.0)
+        results = len(connector.messages(RESULT_TOPIC))
+        report["drain"] = {"sent": sent, "results": results,
+                           "shutdown": {k: v for k, v in shutdown.items()}}
+        if not shutdown["drained"]:
+            failures.append("graceful drain timed out")
+        if results != sent:
+            failures.append(f"drain: {results}/{sent} frames published")
+        if abs(shutdown["ledger"]["in_system"]) > 1e-6:
+            failures.append(f"drain ledger unsettled: "
+                            f"{shutdown['ledger']}")
+        if not shutdown.get("final_checkpoint"):
+            failures.append("no final checkpoint on graceful shutdown")
+        # WAL must be empty after the final checkpoint truncated it.
+        leftover = sum(1 for _ in drain_state.wal.enrollments())
+        if leftover:
+            failures.append(f"WAL holds {leftover} records after final "
+                            f"checkpoint")
+        # And the post-shutdown state must recover the drain enrollment.
+        g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+        StateLifecycle(state_dir, metrics=Metrics()).recover(g2, [])
+        verify_recovered(g2, "post-drain recovery")
+    finally:
+        if temp_dir:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+    if counts["checkpoints_corrupt"] < 1 <= counts["media_corrupt"]:
+        failures.append("corrupt newest checkpoint never counted "
+                        "checkpoints_corrupt")
+    report["counts"] = counts
+    report["acked_enrollments"] = len(acked)
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--seed", type=int, default=None,
                         help="replay a previous run exactly (logged on stderr)")
-    parser.add_argument("--scenario", choices=["soak", "overload"],
+    parser.add_argument("--scenario", choices=["soak", "overload", "recovery"],
                         default="soak",
                         help="soak: randomized fault soak (default); "
                              "overload: 4x flood against the admission/"
-                             "brownout/journal stack (run_overload)")
+                             "brownout/journal stack (run_overload); "
+                             "recovery: seeded kills at every durability "
+                             "boundary, zero-loss recovery + graceful "
+                             "drain (run_recovery)")
     parser.add_argument("--journal", default=None,
                         help="overload scenario: write the dead-letter "
                              "journal here instead of a temp file")
+    parser.add_argument("--state-dir", default=None,
+                        help="recovery scenario: run over this state dir "
+                             "(kept afterwards) instead of a temp dir")
     args = parser.parse_args(argv)
     if args.scenario == "overload":
         report = run_overload(seconds=args.seconds, seed=args.seed,
                               journal_path=args.journal)
+    elif args.scenario == "recovery":
+        report = run_recovery(seconds=args.seconds, seed=args.seed,
+                              state_dir=args.state_dir)
     else:
         report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))

@@ -88,6 +88,48 @@ def test_save_load_roundtrip_preserves_predictions(tmp_path, make_model):
         assert restored.subject_name(0) == NAMES[0]
 
 
+def test_load_model_truncated_or_garbage_raises_corrupt(tmp_path):
+    """A truncated or garbage checkpoint must raise the explicit
+    CheckpointCorruptError (recovery code falls back on it), never an
+    opaque msgpack decode exception — and save_model's atomic write must
+    leave no tmp debris."""
+    model = PredictableModel(PCA(5), NearestNeighbor())
+    model.compute(X, Y)
+    path = os.path.join(tmp_path, "model.ckpt")
+    serialization.save_model(path, model)
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    blob = open(path, "rb").read()
+    truncated = os.path.join(tmp_path, "trunc.ckpt")
+    open(truncated, "wb").write(blob[: len(blob) // 3])
+    with pytest.raises(serialization.CheckpointCorruptError):
+        serialization.load_model(truncated)
+    garbage = os.path.join(tmp_path, "garbage.ckpt")
+    open(garbage, "wb").write(b"\x00\xffnot-a-checkpoint" * 16)
+    with pytest.raises(serialization.CheckpointCorruptError):
+        serialization.load_model(garbage)
+    # CheckpointCorruptError stays a ValueError for legacy handlers.
+    assert issubclass(serialization.CheckpointCorruptError, ValueError)
+    # The intact original still round-trips after all that.
+    restored = serialization.load_model(path)
+    p1, _ = model.predict(X)
+    p2, _ = restored.predict(X)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+def test_save_model_keep_previous_rotates(tmp_path):
+    model = PredictableModel(PCA(5), NearestNeighbor())
+    model.compute(X, Y)
+    path = os.path.join(tmp_path, "model.ckpt")
+    for _ in range(3):
+        serialization.save_model(path, model, keep_previous=2)
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")
+    # every retained generation still loads
+    for p in (path, path + ".1", path + ".2"):
+        serialization.load_model(p)
+
+
 def test_checkpoint_has_no_pickle(tmp_path):
     model = PredictableModel(PCA(5), NearestNeighbor())
     model.compute(X, Y)

@@ -720,6 +720,30 @@ def test_gallery_swap_from_casts_store_dtype():
     assert (sims[:, 0] > 0.99).all()
 
 
+def test_gallery_snapshot_roundtrip_bf16_from_f32_checkpoint():
+    """Satellite (state-lifecycle PR): snapshot()/load_snapshot()
+    round-trip across a store_dtype boundary — an f32 trainer gallery's
+    host-mirror snapshot (what a durable checkpoint persists) installs
+    into a bf16 serving gallery at the SERVING width (the swap_from cast
+    path, via the restore route this time), with match parity."""
+    mesh = make_mesh(tp=4)
+    trainer = ShardedGallery(capacity=16, dim=8, mesh=mesh)  # f32 default
+    emb = _unit(RNG.normal(size=(6, 8)).astype(np.float32))
+    trainer.add(emb, np.arange(6, dtype=np.int32))
+    snap = trainer.snapshot()
+    serving = ShardedGallery(capacity=16, dim=8, mesh=mesh,
+                             store_dtype=jnp.bfloat16)
+    serving.load_snapshot(*snap)
+    assert serving.size == 6
+    assert serving.data.embeddings.dtype == jnp.bfloat16  # serving width
+    assert serving._host_emb.dtype == np.float32  # host truth stays f32
+    l32, s32, i32 = (np.asarray(v) for v in trainer.match(emb, k=1))
+    l16, s16, i16 = (np.asarray(v) for v in serving.match(emb, k=1))
+    np.testing.assert_array_equal(l32, l16)
+    np.testing.assert_array_equal(i32, i16)
+    np.testing.assert_allclose(s32, s16, atol=2e-2)  # bf16 matmul on both
+
+
 def test_gallery_load_snapshot_restores_last_known_good():
     """load_snapshot (the supervisor's restore path): rows added after the
     snapshot are rolled back, the host mirrors are private copies of the

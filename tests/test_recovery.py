@@ -1,0 +1,981 @@
+"""Crash-safe state lifecycle suite (runtime.state_store): atomic
+checksummed checkpoints with corrupt-fallback, the enrollment WAL's
+write-ahead/replay/torn-tail semantics, background checkpointing's
+single-flight guard, graceful shutdown, and the seeded crash-recovery
+chaos scenario (``scripts/chaos_soak.py --scenario recovery`` — fast
+deterministic variant in tier-1, the long randomized soak marked slow,
+mirroring the PR 1/PR 3 chaos split)."""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.runtime import (
+    FakeConnector,
+    RecognizerService,
+    StateLifecycle,
+    graceful_shutdown,
+)
+from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.faults import (
+    FaultInjector,
+    InjectedCrashError,
+)
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    FRAME_TOPIC,
+    RESULT_TOPIC,
+)
+from opencv_facerecognizer_tpu.runtime.state_store import (
+    CheckpointStore,
+    EnrollmentWAL,
+)
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "chaos_soak_recovery", os.path.join(REPO_ROOT, "scripts", "chaos_soak.py"))
+chaos_soak = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos_soak)
+
+_vspec = importlib.util.spec_from_file_location(
+    "verify_checkpoint", os.path.join(REPO_ROOT, "scripts",
+                                      "verify_checkpoint.py"))
+verify_checkpoint = importlib.util.module_from_spec(_vspec)
+_vspec.loader.exec_module(verify_checkpoint)
+
+DIM = 8
+RNG = np.random.default_rng(31)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _gallery(mesh, capacity=64, store_dtype=None):
+    kwargs = {} if store_dtype is None else {"store_dtype": store_dtype}
+    return ShardedGallery(capacity=capacity, dim=DIM, mesh=mesh, **kwargs)
+
+
+def _wait(cond, timeout=10.0, interval=0.02) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------- CheckpointStore ----------
+
+
+def test_checkpoint_store_roundtrip_retention_and_seq(tmp_path):
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=3, metrics=m)
+    for i in range(5):
+        store.save(f"payload-{i}".encode(), {"i": i})
+    files = store.checkpoint_files()
+    assert len(files) == 3  # retention pruned the two oldest
+    assert [seq for seq, _ in files] == [5, 4, 3]
+    header, payload, path = store.load_latest()
+    assert payload == b"payload-4"
+    assert header["meta"]["i"] == 4
+    assert header["seq"] == 5
+    assert m.counter("checkpoints_written") == 5
+    # seq survives a "restart" (fresh store over the same dir)
+    assert CheckpointStore(str(tmp_path)).next_seq() == 6
+    # no tmp leftovers from the atomic writes
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_checkpoint_store_falls_back_past_corrupt_newest(tmp_path):
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=3, metrics=m)
+    store.save(b"old-good", {"gen": "old"})
+    newest = store.save(b"new-doomed", {"gen": "new"})
+    blob = open(newest, "rb").read()
+    open(newest, "wb").write(blob[: len(blob) // 2])  # torn media
+    header, payload, _path = store.load_latest()
+    assert payload == b"old-good"
+    assert m.counter("checkpoints_corrupt") == 1
+    quarantined = [n for n in os.listdir(tmp_path) if n.endswith(".corrupt")]
+    assert len(quarantined) == 1
+    # Quarantine means the corrupt file is not re-counted on a re-scan.
+    store.load_latest()
+    assert m.counter("checkpoints_corrupt") == 1
+
+
+def test_checkpoint_store_rejects_garbage_and_checksum_flip(tmp_path):
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=3, metrics=m)
+    path = store.save(b"real", {})
+    # Flip a payload byte WITHOUT touching the framing: sha256 must catch.
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    garbage = os.path.join(str(tmp_path), "ckpt-00009999.ckpt")
+    open(garbage, "wb").write(b"not a checkpoint at all")
+    assert store.load_latest() is None
+    assert m.counter("checkpoints_corrupt") == 2
+
+
+def test_newer_format_checkpoint_skipped_not_quarantined(tmp_path):
+    """Review fix: a binary downgrade finds newer-format checkpoints —
+    they are intact, so the scan must fall back past them WITHOUT
+    quarantining (retention would otherwise prune valid newer state)."""
+    from opencv_facerecognizer_tpu.runtime.state_store import (
+        _encode_checkpoint,
+    )
+
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=3, metrics=m)
+    store.save(b"v1-state", {})
+    payload = b"future"
+    import hashlib as _h
+    header = {"format_version": 99, "seq": 2, "payload_bytes": len(payload),
+              "sha256": _h.sha256(payload).hexdigest(), "meta": {}}
+    future = os.path.join(str(tmp_path), "ckpt-00000002.ckpt")
+    open(future, "wb").write(_encode_checkpoint(header, payload))
+    _header, got, _path = store.load_latest()
+    assert got == b"v1-state"  # fell back past the newer file
+    assert m.counter("checkpoints_version_skipped") == 1
+    assert m.counter("checkpoints_corrupt") == 0
+    assert os.path.exists(future)  # NOT quarantined — intact for the
+    # newer binary that wrote it
+    sweep = store.verify()
+    assert len(sweep["newer_version"]) == 1 and not sweep["corrupt"]
+
+
+def test_verify_checkpoint_rc_contract_on_bad_paths(tmp_path):
+    """Review fix: a typo'd path must exit 2 with a JSON report (not
+    traceback rc 1), and an empty/mistyped directory must NOT pass."""
+    assert verify_checkpoint.main([str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert verify_checkpoint.main([str(empty)]) == 2
+
+
+def test_checkpoint_header_bitflip_detected(tmp_path):
+    """Review fix: the header carries its own sha256 — a bit flip in e.g.
+    the header's wal_seq digits (payload checksum untouched) must read as
+    corrupt, not silently mis-dedup WAL replay."""
+    from opencv_facerecognizer_tpu.runtime.state_store import (
+        CHECKPOINT_MAGIC,
+    )
+
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=3, metrics=m)
+    store.save(b"old", {"wal_seq": 3})
+    newest = store.save(b"new", {"wal_seq": 7})
+    blob = bytearray(open(newest, "rb").read())
+    # Flip one byte INSIDE the header json region (after MAGIC + u32).
+    blob[len(CHECKPOINT_MAGIC) + 4 + 5] ^= 0x01
+    open(newest, "wb").write(bytes(blob))
+    header, payload, _path = store.load_latest()
+    assert payload == b"old"  # fell back past the header-corrupt newest
+    assert m.counter("checkpoints_corrupt") == 1
+    # Non-object header JSON is corruption too, never a stray crash.
+    bad = os.path.join(str(tmp_path), "ckpt-00000031.ckpt")
+    import hashlib as _h
+    hdr = b"null"
+    open(bad, "wb").write(CHECKPOINT_MAGIC + len(hdr).to_bytes(4, "big")
+                          + hdr + _h.sha256(hdr).digest() + b"x")
+    header2, payload2, _ = store.load_latest()
+    assert payload2 == b"old"
+    assert m.counter("checkpoints_corrupt") == 2
+
+
+# ---------- EnrollmentWAL ----------
+
+
+def _append(wal, seq, n=2, label=0, subject=None):
+    emb = RNG.normal(size=(n, DIM)).astype(np.float32)
+    wal.append_enroll(seq, emb, np.full(n, label, np.int32),
+                      subject=subject, label=label)
+    return emb
+
+
+def test_wal_roundtrip_preserves_exact_rows(tmp_path):
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    want = [_append(wal, seq, n=seq, label=seq - 1, subject=f"s{seq}")
+            for seq in (1, 2, 3)]
+    wal.close()
+    got = list(EnrollmentWAL(path).enrollments())
+    assert [r["seq"] for r in got] == [1, 2, 3]
+    for rec, emb in zip(got, want):
+        np.testing.assert_array_equal(rec["embeddings"], emb)  # bit-exact
+    assert got[2]["subject"] == "s3" and got[2]["label"] == 2
+
+
+def test_wal_torn_tail_is_sealed_and_skipped(tmp_path):
+    path = str(tmp_path / "enroll.wal")
+    m = Metrics()
+    wal = EnrollmentWAL(path, metrics=m, fault_injector=None)
+    _append(wal, 1)
+    injector = FaultInjector(seed=0)
+    injector.script("wal", "torn")
+    wal._faults = injector
+    with pytest.raises(InjectedCrashError):
+        _append(wal, 2)
+    wal.close()
+    # "Restart": the torn tail must be sealed so the NEXT append cannot
+    # concatenate onto it, and replay must skip it.
+    m2 = Metrics()
+    wal2 = EnrollmentWAL(path, metrics=m2)
+    assert m2.counter("wal_torn_tails_sealed") == 1
+    emb3 = _append(wal2, 3)
+    records = list(wal2.enrollments())
+    assert [r["seq"] for r in records] == [1, 3]
+    np.testing.assert_array_equal(records[1]["embeddings"], emb3)
+
+
+def test_wal_crc_guard_skips_bitflipped_record(tmp_path):
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    _append(wal, 1)
+    _append(wal, 2)
+    wal.close()
+    lines = open(path).read().splitlines()
+    rec = json.loads(lines[0])
+    b64 = rec["emb"]
+    rec["emb"] = ("A" if b64[0] != "A" else "B") + b64[1:]  # payload bitflip
+    lines[0] = json.dumps(rec)
+    open(path, "w").write("\n".join(lines) + "\n")
+    m = Metrics()
+    survivors = list(EnrollmentWAL(path, metrics=m).enrollments())
+    assert [r["seq"] for r in survivors] == [2]
+    assert m.counter("wal_corrupt_records") == 1
+
+
+def test_wal_truncate_below_compacts(tmp_path):
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    for seq in (1, 2, 3, 4):
+        _append(wal, seq)
+    wal.truncate_below(2)
+    assert [r["seq"] for r in wal.enrollments()] == [3, 4]
+    wal.truncate_below(4)
+    assert list(wal.enrollments()) == []
+    # still appendable after full truncation
+    _append(wal, 5)
+    assert [r["seq"] for r in wal.enrollments()] == [5]
+
+
+def test_wal_failed_append_seals_before_next_record(tmp_path):
+    """Review fix: partial bytes landed by a FAILED append (ENOSPC mid-
+    write) must be newline-sealed by the next append in the same write —
+    otherwise a later acknowledged record glues onto them and both read
+    as one torn line."""
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    _append(wal, 1)
+    # Simulate the failed-append aftermath: torn bytes on disk, flag set
+    # (append_line sets it whenever _append_locked raises).
+    with wal._lock:
+        wal._append_locked('{"kind": "enroll", "seq": 2, "torn', newline=False)
+    wal._needs_seal = True
+    emb3 = _append(wal, 3)
+    records = list(wal.enrollments())
+    assert [r["seq"] for r in records] == [1, 3]  # 3 survived, isolated
+    np.testing.assert_array_equal(records[1]["embeddings"], emb3)
+
+
+def test_wal_reads_are_corruption_total(tmp_path):
+    """Review fix: invalid UTF-8 bytes and JSON-parseable-but-non-object
+    lines must be skipped by every read path (records/enrollments/max_seq/
+    truncate_below), never raise out of a recovery loop."""
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    _append(wal, 1)
+    wal.close()
+    with open(path, "ab") as fh:
+        fh.write(b"\xff\xfe not utf8 \xf0\n")
+        fh.write(b"null\n")
+        fh.write(b"1234\n")
+        fh.write(b'{"kind": "abort", "seq": null}\n')
+    wal2 = EnrollmentWAL(path, metrics=Metrics())
+    assert [r["seq"] for r in wal2.enrollments()] == [1]
+    assert wal2.max_seq() == 1
+    wal2.truncate_below(0)  # must not raise; garbage lines dropped
+    assert [r["seq"] for r in wal2.enrollments()] == [1]
+    report = verify_checkpoint.verify_state_dir(str(tmp_path))
+    assert report["wal"]["valid_records"] == 1  # and the tool survives too
+
+
+def test_checkpoint_read_error_raises_not_quarantines(tmp_path, monkeypatch):
+    """Review fix: a transient read failure (EIO) proves nothing about the
+    bytes — recovery must fail loudly, not quarantine a possibly-valid
+    newest checkpoint whose WAL delta was already truncated."""
+    import builtins
+
+    m = Metrics()
+    store = CheckpointStore(str(tmp_path), metrics=m)
+    path = store.save(b"precious", {})
+    real_open = builtins.open
+
+    def flaky_open(file, *args, **kwargs):
+        if str(file) == path:
+            raise OSError(5, "Input/output error")
+        return real_open(file, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "open", flaky_open)
+    with pytest.raises(OSError):
+        store.load_latest()
+    monkeypatch.undo()
+    assert m.counter("checkpoint_read_errors") == 1
+    assert m.counter("checkpoints_corrupt") == 0
+    header, payload, _p = store.load_latest()  # intact after the blip
+    assert payload == b"precious"
+
+
+def test_journal_fsync_policy_validated(tmp_path):
+    with pytest.raises(ValueError):
+        EnrollmentWAL(str(tmp_path / "w"), fsync="sometimes")
+    for policy in ("never", "interval", "always"):
+        EnrollmentWAL(str(tmp_path / f"w-{policy}"), fsync=policy).close()
+
+
+def test_wal_never_rotates_acked_records_away(tmp_path):
+    """Review fix: the size bound must not unlink acknowledged records
+    when checkpoints persistently fail — it warns (wal_over_bytes) and
+    keeps appending instead."""
+    path = str(tmp_path / "enroll.wal")
+    m = Metrics()
+    wal = EnrollmentWAL(path, max_bytes=256, metrics=m)
+    for seq in range(1, 9):  # each record is far over 256/8 bytes
+        _append(wal, seq)
+    assert [r["seq"] for r in wal.enrollments()] == list(range(1, 9))
+    assert not os.path.exists(path + ".1")  # nothing rotated, ever
+    assert m.counter("wal_over_bytes") == 1  # warned exactly once
+
+
+def test_wal_abort_tombstone_blocks_replay(tmp_path):
+    """Review fix: an apply_fn failure after the (durable) append
+    tombstones the record — replay must not resurrect rows the live
+    gallery rolled back."""
+    path = str(tmp_path / "enroll.wal")
+    wal = EnrollmentWAL(path, metrics=Metrics())
+    _append(wal, 1)
+    _append(wal, 2)
+    wal.append_abort(2)
+    _append(wal, 3)
+    assert [r["seq"] for r in wal.enrollments()] == [1, 3]
+
+
+def test_atomic_write_failure_keeps_previous_installed(tmp_path, monkeypatch):
+    """Review fix: with keep_previous, rotation happens only after the new
+    bytes are durable — any failure leaves the previous file under the
+    expected name, never only under .1."""
+    from opencv_facerecognizer_tpu.utils import serialization
+
+    target = tmp_path / "model.ckpt"
+    serialization.atomic_write_bytes(str(target), b"v1")
+    serialization.atomic_write_bytes(str(target), b"v2", keep_previous=2)
+    assert target.read_bytes() == b"v2"
+    assert (tmp_path / "model.ckpt.1").read_bytes() == b"v1"
+
+    def boom(fd):
+        raise OSError("injected: disk full at fsync")
+
+    monkeypatch.setattr(serialization.os, "fsync", boom)
+    with pytest.raises(OSError):
+        serialization.atomic_write_bytes(str(target), b"v3", keep_previous=2)
+    monkeypatch.undo()
+    assert target.read_bytes() == b"v2"  # still installed
+    assert (tmp_path / "model.ckpt.1").read_bytes() == b"v1"  # not shifted
+
+
+# ---------- StateLifecycle ----------
+
+
+def test_lifecycle_recover_dedups_after_late_crash(tmp_path, mesh):
+    """The checkpoint-landed-but-WAL-not-truncated window ('late' kill):
+    replay must skip every record the checkpoint's wal_seq already
+    covers — no duplicate gallery rows."""
+    g = _gallery(mesh)
+    names = []
+    injector = FaultInjector(seed=0)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics(),
+                        checkpoint_wal_rows=1 << 30,
+                        checkpoint_every_s=1e9, fault_injector=injector)
+    st.bind(g, names)
+    emb = RNG.normal(size=(3, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(3, np.int32), subject="a", label=0,
+                         apply_fn=lambda: g.add(emb, np.zeros(3, np.int32)))
+    names.append("a")
+    injector.script("checkpoint", "late")
+    with pytest.raises(InjectedCrashError):
+        st.checkpoint_now(wait=True)
+    # WAL still holds the record; the installed checkpoint covers it.
+    assert len(list(st.wal.enrollments())) == 1
+    m = Metrics()
+    g2 = _gallery(mesh)
+    names2 = []
+    rep = StateLifecycle(str(tmp_path), metrics=m).recover(g2, names2)
+    assert rep["skipped_records"] == 1 and rep["replayed_records"] == 0
+    assert g2.size == 3  # exactly once, not twice
+    assert names2 == ["a"]
+
+
+def test_lifecycle_apply_failure_never_resurrects_on_recovery(tmp_path, mesh):
+    """Review fix end-to-end: gallery apply raises after the WAL append —
+    the caller sees the failure (no ack), and a restart must NOT replay
+    the tombstoned record as phantom rows."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    ok_emb = RNG.normal(size=(2, DIM)).astype(np.float32)
+    st.append_enrollment(ok_emb, np.zeros(2, np.int32), subject="ok", label=0,
+                         apply_fn=lambda: g.add(ok_emb, np.zeros(2, np.int32)))
+
+    def failing_apply():
+        raise RuntimeError("device fell over mid-add")
+
+    bad = RNG.normal(size=(3, DIM)).astype(np.float32)
+    with pytest.raises(RuntimeError, match="fell over"):
+        st.append_enrollment(bad, np.ones(3, np.int32), subject="ghost",
+                             label=1, apply_fn=failing_apply)
+    g2 = _gallery(mesh)
+    names2 = []
+    StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, names2)
+    assert g2.size == 2  # the ghost's 3 rows never materialize
+    assert "ghost" not in names2
+
+
+def test_lifecycle_checkpoint_deferred_while_rows_pending(tmp_path):
+    """Review fix: staged-but-unlanded async-grow rows (pending_rows > 0,
+    e.g. a failed grow awaiting retry) must DEFER the checkpoint — a
+    snapshot without them that truncated their WAL records would lose
+    acknowledged enrollments."""
+
+    class PendingGallery:
+        dim = DIM
+        size = 0
+        pending_rows = 4
+
+        def wait_ready(self, timeout=None):
+            return True  # a FAILED grow also returns True with pending>0
+
+        def snapshot(self):
+            raise AssertionError("must not snapshot while rows are pending")
+
+    m = Metrics()
+    st = StateLifecycle(str(tmp_path), metrics=m)
+    st.bind(PendingGallery(), [])
+    assert st.checkpoint_now(wait=True) is False
+    assert m.counter("checkpoints_deferred_pending") == 1
+    assert m.counter("checkpoints_written") == 0
+
+
+def test_lifecycle_checkpoint_single_flight(tmp_path, mesh):
+    g = _gallery(mesh)
+    m = Metrics()
+    st = StateLifecycle(str(tmp_path), metrics=m)
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    assert st._ckpt_lock.acquire(blocking=False)  # simulate one in flight
+    try:
+        assert st.maybe_checkpoint(force=True) is False
+        assert st.checkpoint_now() is False
+        assert m.counter("checkpoints_skipped_inflight") == 2
+    finally:
+        st._ckpt_lock.release()
+    assert st.checkpoint_now(wait=True) is True
+    assert list(st.wal.enrollments()) == []  # truncated after the save
+
+
+def test_wal_seq_not_reused_after_abort_across_restart(tmp_path, mesh):
+    """Review fix (empirically reproduced loss): recovery must seed
+    _wal_seq from ALL records including abort tombstones — seeding from
+    surviving enrollments would hand the aborted seq to the next
+    acknowledged enrollment, which the tombstone then filters on the
+    following restart."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    a = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(a, np.zeros(1, np.int32), subject="a", label=0,
+                         apply_fn=lambda: g.add(a, np.zeros(1, np.int32)))
+    with pytest.raises(RuntimeError):
+        st.append_enrollment(a, np.ones(1, np.int32), subject="b", label=1,
+                             apply_fn=lambda: (_ for _ in ()).throw(
+                                 RuntimeError("apply died")))
+    # Restart 1: enroll C — its seq must NOT collide with the tombstone.
+    g2 = _gallery(mesh)
+    st2 = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st2.recover(g2, [])
+    assert st2.wal_seq == 2  # tombstoned seq counted, never reissued
+    c = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st2.append_enrollment(c, np.ones(1, np.int32), subject="c", label=1,
+                          apply_fn=lambda: g2.add(c, np.ones(1, np.int32)))
+    # Restart 2: C must survive (the old bug filtered it as aborted).
+    g3 = _gallery(mesh)
+    names3 = []
+    StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g3, names3)
+    assert g3.size == 2, g3.size
+    assert names3[1] == "c"
+
+
+def test_recover_falls_back_past_checksum_valid_but_undecodable(tmp_path, mesh):
+    """Review fix: a checkpoint whose sha256 verifies but whose payload
+    msgpack rejects must be quarantined and recovery must fall back to
+    the next-older VALID checkpoint, not degrade to WAL-only."""
+    from opencv_facerecognizer_tpu.runtime.state_store import (
+        _encode_checkpoint,
+    )
+    import hashlib
+
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    names = []
+    st.bind(g, names)
+    emb = RNG.normal(size=(3, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(3, np.int32), subject="a", label=0,
+                         apply_fn=lambda: g.add(emb, np.zeros(3, np.int32)))
+    names.append("a")  # the enrolling service grows its own list
+    assert st.checkpoint_now(wait=True)
+    # Craft a NEWER checkpoint with a valid checksum over garbage payload.
+    payload = b"this is not msgpack"
+    header = {"format_version": 1, "seq": 99, "payload_bytes": len(payload),
+              "sha256": hashlib.sha256(payload).hexdigest(),
+              "meta": {"kind": "gallery", "size": 0, "capacity": 64,
+                       "dim": DIM, "subject_names": [], "wal_seq": 7}}
+    bad = os.path.join(str(tmp_path), "checkpoints", "ckpt-00000099.ckpt")
+    open(bad, "wb").write(_encode_checkpoint(header, payload))
+    m = Metrics()
+    g2 = _gallery(mesh)
+    names2 = []
+    rep = StateLifecycle(str(tmp_path), metrics=m).recover(g2, names2)
+    assert g2.size == 3  # the older VALID checkpoint won
+    assert names2 == ["a"]
+    assert rep["recovered_checkpoint"].endswith("ckpt-00000001.ckpt")
+    assert m.counter("checkpoints_corrupt") == 1
+    assert os.path.exists(bad + ".corrupt")  # quarantined
+
+
+def test_append_failure_burns_seq_and_tombstones(tmp_path, mesh, monkeypatch):
+    """Review fix: a failed strict append may still have landed its full
+    bytes — the seq must be burned (and tombstoned best-effort), never
+    reissued to the next acknowledged enrollment (two enroll records
+    sharing a seq are indistinguishable on replay)."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    a = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(a, np.zeros(1, np.int32), subject="a", label=0,
+                         apply_fn=lambda: g.add(a, np.zeros(1, np.int32)))
+    real_append = st.wal.append_enroll
+
+    def failing_append(*args, **kwargs):
+        raise OSError("fsync blew up after the bytes landed")
+
+    monkeypatch.setattr(st.wal, "append_enroll", failing_append)
+    with pytest.raises(OSError):
+        st.append_enrollment(a, np.ones(1, np.int32), subject="b", label=1,
+                             apply_fn=lambda: None)
+    monkeypatch.setattr(st.wal, "append_enroll", real_append)
+    assert st.wal_seq == 2  # burned, not rolled back
+    c = RNG.normal(size=(1, DIM)).astype(np.float32)
+    seq_c = st.append_enrollment(
+        c, np.ones(1, np.int32), subject="c", label=1,
+        apply_fn=lambda: g.add(c, np.ones(1, np.int32)))
+    assert seq_c == 3  # never reuses the burned seq
+    g2 = _gallery(mesh)
+    names2 = []
+    StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, names2)
+    assert g2.size == 2 and names2[1] == "c"
+
+
+def test_supervisor_inmemory_restore_replays_acked_tail(tmp_path, mesh):
+    """Review fix: the supervisor's in-memory snapshot restore must
+    replay enrollments acknowledged AFTER the snapshot's WAL stamp —
+    otherwise they vanish from serving and the next durable checkpoint
+    truncates their records (permanent acked loss)."""
+    from opencv_facerecognizer_tpu.runtime import ServiceSupervisor
+
+    gallery, state, service, connector, metrics = _service_stack(
+        tmp_path, mesh, checkpoint_wal_rows=1 << 30, checkpoint_every_s=1e9)
+    supervisor = ServiceSupervisor(service, state=state)
+    supervisor.checkpoint()  # last-known-good BEFORE the enrollment
+    emb = RNG.normal(size=(2, DIM)).astype(np.float32)
+    state.append_enrollment(emb, np.zeros(2, np.int32), subject="late",
+                            label=0,
+                            apply_fn=lambda: gallery.add(emb, np.zeros(2, np.int32)))
+    service.subject_names.append("late")
+    assert gallery.size == 2
+    # Crash restore path: rolls to the stamped snapshot, then MUST replay
+    # the acknowledged tail.
+    supervisor._restore_gallery()
+    assert gallery.size == 2, "acked enrollment vanished from serving"
+    assert service.subject_names[0] == "late"
+    # The next durable checkpoint + restart must still hold it.
+    assert state.checkpoint_now(wait=True)
+    g2 = _gallery(mesh)
+    names2 = []
+    StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, names2)
+    assert g2.size == 2 and names2[0] == "late"
+    state.close()
+
+
+def test_forced_checkpoint_latches_past_inflight_one(tmp_path, mesh):
+    """Review fix: a FORCED checkpoint (reload swap) colliding with an
+    in-flight background one must stay pending — the in-flight snapshot
+    may predate the swap — and be retried by the next tick."""
+    g = _gallery(mesh)
+    m = Metrics()
+    st = StateLifecycle(str(tmp_path), metrics=m)
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    assert st._ckpt_lock.acquire(blocking=False)  # simulate one in flight
+    try:
+        assert st.maybe_checkpoint(force=True) is False
+        assert st._force_pending is True
+        assert st.checkpoint_due()  # ticks will keep retrying
+    finally:
+        st._ckpt_lock.release()
+    assert st.checkpoint_now(wait=True) is True
+    assert st._force_pending is False  # satisfied by a post-request snapshot
+
+
+def test_checkpoint_failure_backs_off(tmp_path, mesh, monkeypatch):
+    """Review fix: a persistently failing save must not re-snapshot and
+    re-serialize the gallery on every tick — exponential retry backoff."""
+    g = _gallery(mesh)
+    m = Metrics()
+    st = StateLifecycle(str(tmp_path), metrics=m)
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    # Tighten AFTER the append (so the append itself spawned nothing):
+    # from here one uncovered row makes a checkpoint due.
+    st.checkpoint_wal_rows = 1
+    assert st.checkpoint_due() is True
+
+    def failing_save(payload, meta, fault=None):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(st.store, "save", failing_save)
+    assert st.checkpoint_now(wait=True) is False
+    assert m.counter("checkpoint_failures") == 1
+    assert st.checkpoint_due() is False  # inside the backoff window
+    assert st.tick() is None and m.counter("checkpoint_failures") == 1
+    monkeypatch.undo()
+    st._ckpt_retry_at = 0.0  # backoff elapsed
+    assert st.checkpoint_due() is True  # rows still uncovered
+    assert st.checkpoint_now(wait=True) is True
+    assert st._ckpt_retry_backoff_s == 1.0  # reset on success
+
+
+def test_lifecycle_dim_mismatch_is_operator_error(tmp_path, mesh):
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    assert st.checkpoint_now(wait=True)
+    wrong = ShardedGallery(capacity=32, dim=DIM * 2, mesh=mesh)
+    with pytest.raises(ValueError, match="dim"):
+        StateLifecycle(str(tmp_path), metrics=Metrics()).recover(wrong, [])
+
+
+def test_bf16_serving_gallery_restores_f32_checkpoint_from_disk(tmp_path, mesh):
+    """Satellite: the PR 1 swap_from cast path, exercised via
+    restore-from-disk — an f32 trainer-default gallery's durable
+    checkpoint recovers into a bf16 serving gallery (host mirrors stay f32
+    truth; the device snapshot installs at the SERVING width) and matching
+    agrees with the f32 original."""
+    import jax.numpy as jnp
+
+    f32 = _gallery(mesh, store_dtype=jnp.float32)
+    emb = RNG.normal(size=(12, DIM)).astype(np.float32)
+    labels = (np.arange(12) % 4).astype(np.int32)
+    f32.add(emb, labels)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(f32, [f"s{i}" for i in range(4)])
+    assert st.checkpoint_now(wait=True)
+
+    bf16 = _gallery(mesh, store_dtype=jnp.bfloat16)
+    names = []
+    rep = StateLifecycle(str(tmp_path), metrics=Metrics()).recover(bf16, names)
+    assert rep["checkpoint_size"] == 12
+    assert bf16.size == 12
+    assert bf16.data.embeddings.dtype == jnp.bfloat16  # serving width
+    assert bf16._host_emb.dtype == np.float32  # host truth stays f32
+    q = emb[:8] / np.linalg.norm(emb[:8], axis=-1, keepdims=True)
+    l32, s32, i32 = (np.asarray(v) for v in f32.match(q, k=1))
+    l16, s16, i16 = (np.asarray(v) for v in bf16.match(q, k=1))
+    np.testing.assert_array_equal(l32, l16)
+    np.testing.assert_array_equal(i32, i16)
+    np.testing.assert_allclose(s32, s16, atol=2e-2)  # bf16 matmul on both
+
+
+def test_snapshot_roundtrip_survives_second_restore(tmp_path, mesh):
+    """Mid-restore kill: recovery is read-only on durable files, so a
+    restore interrupted (discarded) and rerun lands identically."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    emb = RNG.normal(size=(4, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(4, np.int32), subject="a", label=0,
+                         apply_fn=lambda: g.add(emb, np.zeros(4, np.int32)))
+    for _ in range(2):  # first "killed" (discarded), second must match
+        g2 = _gallery(mesh)
+        StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, [])
+        assert g2.size == 4
+        np.testing.assert_allclose(g2.snapshot()[0][:4],
+                                   g.snapshot()[0][:4], atol=0)
+
+
+# ---------- service integration ----------
+
+
+def _service_stack(tmp_path, mesh, **state_kwargs):
+    metrics = Metrics()
+    gallery = _gallery(mesh)
+    pipe = InstantPipeline((16, 16))
+    pipe.gallery = gallery
+    state = StateLifecycle(str(tmp_path), metrics=metrics, **state_kwargs)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=2, frame_shape=(16, 16),
+        flush_timeout=0.02, metrics=metrics, state_store=state)
+    return gallery, state, service, connector, metrics
+
+
+def test_serving_loop_background_checkpoint_on_row_threshold(tmp_path, mesh):
+    gallery, state, service, connector, metrics = _service_stack(
+        tmp_path, mesh, checkpoint_wal_rows=3, checkpoint_every_s=1e9)
+    service.start(warmup=False)
+    try:
+        emb = RNG.normal(size=(4, DIM)).astype(np.float32)
+        state.append_enrollment(
+            emb, np.zeros(4, np.int32), subject="a", label=0,
+            apply_fn=lambda: gallery.add(emb, np.zeros(4, np.int32)))
+        frame = np.zeros((16, 16), np.float32)
+        connector.inject(FRAME_TOPIC, {**encode_frame(frame), "meta": {}})
+        # The serving loop's tick must notice the over-threshold WAL and
+        # background-checkpoint without any explicit call.
+        assert _wait(lambda: metrics.counter("checkpoints_written") >= 1), \
+            "serving loop never triggered the threshold checkpoint"
+        assert _wait(
+            lambda: len(list(state.wal.enrollments())) == 0), \
+            "WAL not truncated after the background checkpoint"
+    finally:
+        service.stop()
+        state.close()
+
+
+def test_graceful_shutdown_drains_checkpoints_and_settles_ledger(tmp_path, mesh):
+    gallery, state, service, connector, metrics = _service_stack(
+        tmp_path, mesh, checkpoint_wal_rows=1 << 30, checkpoint_every_s=1e9)
+    service.start(warmup=False)
+    frame = np.zeros((16, 16), np.float32)
+    for i in range(10):
+        connector.inject(FRAME_TOPIC,
+                         {**encode_frame(frame), "meta": {"seq": i}})
+    emb = RNG.normal(size=(2, DIM)).astype(np.float32)
+    state.append_enrollment(emb, np.zeros(2, np.int32), subject="a", label=0,
+                            apply_fn=lambda: gallery.add(emb, np.zeros(2, np.int32)))
+    report = graceful_shutdown(service, state=state, drain_timeout=30.0)
+    assert report["clean"], report
+    assert report["ledger"]["in_system"] == 0
+    assert len(connector.messages(RESULT_TOPIC)) == 10  # all published
+    assert report["final_checkpoint"] is True
+    assert list(EnrollmentWAL(os.path.join(str(tmp_path),
+                                           "enroll.wal")).enrollments()) == []
+    # Restart recovers the enrollment from the final checkpoint alone.
+    g2 = _gallery(mesh)
+    rep = StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, [])
+    assert rep["replayed_records"] == 0 and g2.size == 2
+
+
+def test_sigterm_subprocess_drains_and_exits_zero(tmp_path, mesh):
+    """Real-signal end-to-end: a serving process over the fake backend
+    gets SIGTERM mid-stream and must drain, write a final checkpoint, and
+    exit 0 — the deploy-level stop contract."""
+    script = f"""
+import os, signal, sys, threading, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.runtime import (
+    FakeConnector, RecognizerService, StateLifecycle, graceful_shutdown)
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+
+term = threading.Event()
+signal.signal(signal.SIGTERM, lambda s, f: term.set())
+gallery = ShardedGallery(capacity=32, dim=8, mesh=make_mesh())
+pipe = InstantPipeline((16, 16))
+pipe.gallery = gallery
+state = StateLifecycle({str(tmp_path)!r})
+connector = FakeConnector()
+service = RecognizerService(pipe, connector, batch_size=2,
+                            frame_shape=(16, 16), flush_timeout=0.02,
+                            state_store=state)
+service.start(warmup=False)
+frame = np.zeros((16, 16), np.float32)
+emb = np.ones((1, 8), np.float32)
+state.append_enrollment(emb, np.zeros(1, np.int32), subject="s", label=0,
+                        apply_fn=lambda: gallery.add(emb, np.zeros(1, np.int32)))
+print("READY", flush=True)
+i = 0
+while not term.is_set():
+    connector.inject(FRAME_TOPIC, dict(encode_frame(frame), meta=dict(seq=i)))
+    i += 1
+    time.sleep(0.01)
+report = graceful_shutdown(service, state=state, drain_timeout=30.0)
+print("REPORT", report["clean"], report["ledger"]["in_system"], flush=True)
+sys.exit(0 if report["clean"] else 3)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", script], cwd=REPO_ROOT,
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env={**os.environ,
+                                            "JAX_PLATFORMS": "cpu"})
+    try:
+        deadline = time.monotonic() + 120
+        line = ""
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if "READY" in line:
+                break
+        assert "READY" in line, "subprocess never came up"
+        time.sleep(0.3)  # let some frames flow
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, (out, err)
+    assert "REPORT True" in out, (out, err)
+    # The state dir holds a verified final checkpoint.
+    report = verify_checkpoint.verify_state_dir(str(tmp_path))
+    assert report["ok"], report
+    g2 = _gallery(mesh)
+    rep = StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, [])
+    assert g2.size == 1 and rep["replayed_records"] == 0
+
+
+# ---------- offline verification ----------
+
+
+def test_verify_checkpoint_is_strictly_read_only(tmp_path, mesh):
+    """Review fix: the offline verifier must not mutate the state it
+    verifies — in particular it must NOT seal a torn WAL tail (a live
+    writer could be mid-append on those exact bytes)."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    st.checkpoint_now(wait=True)
+    wal_path = os.path.join(str(tmp_path), "enroll.wal")
+    with open(wal_path, "a") as fh:
+        fh.write('{"kind": "enroll", "seq": 99, "torn...')  # no newline
+    before = open(wal_path, "rb").read()
+    mtimes = {p: os.path.getmtime(p)
+              for _s, p in st.store.checkpoint_files()}
+    report = verify_checkpoint.verify_state_dir(str(tmp_path))
+    assert open(wal_path, "rb").read() == before  # byte-identical
+    for _s, p in st.store.checkpoint_files():
+        assert os.path.getmtime(p) == mtimes[p]
+    assert report["ok"]  # a torn line is the expected crash signature
+    assert report["wal"]["torn_lines"] == 1
+    assert report["wal"]["corrupt_records"] == 0
+
+
+def test_verify_checkpoint_wal_semantics(tmp_path, mesh):
+    """Review fix: a SEALED torn line mid-file (crash remnant + restart +
+    later appends) stays a warning — only a parseable-but-crc-broken
+    (i.e. acknowledged, now unreadable) record fails verification."""
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    emb = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(1, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(1, np.int32)))
+    wal_path = os.path.join(str(tmp_path), "enroll.wal")
+    with open(wal_path, "a") as fh:
+        fh.write('{"kind": "enroll", "seq": 9, "torn...')  # crash remnant
+    st.wal.close()
+    # Restart seals the torn tail; a post-restart enrollment appends
+    # AFTER it — the torn line is now mid-file.
+    st2 = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st2.bind(g, [])
+    st2._wal_seq = 1
+    emb2 = RNG.normal(size=(1, DIM)).astype(np.float32)
+    st2.append_enrollment(emb2, np.zeros(1, np.int32),
+                          apply_fn=lambda: g.add(emb2, np.zeros(1, np.int32)))
+    report = verify_checkpoint.verify_state_dir(str(tmp_path))
+    assert report["ok"], report  # healthy despite the sealed remnant
+    assert report["wal"]["torn_lines"] == 1
+    assert report["wal"]["valid_records"] == 2
+    # Now bitflip an ACKED record's payload: real corruption, rc 2.
+    lines = open(wal_path).read().splitlines()
+    rec = json.loads(lines[0])
+    rec["emb"] = ("A" if rec["emb"][0] != "A" else "B") + rec["emb"][1:]
+    lines[0] = json.dumps(rec)
+    open(wal_path, "w").write("\n".join(lines) + "\n")
+    assert verify_checkpoint.main([str(tmp_path)]) == 2
+
+
+def test_verify_checkpoint_script_rc_semantics(tmp_path, mesh):
+    g = _gallery(mesh)
+    st = StateLifecycle(str(tmp_path), metrics=Metrics())
+    st.bind(g, [])
+    emb = RNG.normal(size=(2, DIM)).astype(np.float32)
+    st.append_enrollment(emb, np.zeros(2, np.int32),
+                         apply_fn=lambda: g.add(emb, np.zeros(2, np.int32)))
+    assert st.checkpoint_now(wait=True)
+    assert verify_checkpoint.main([str(tmp_path)]) == 0
+    # Corrupt the installed checkpoint: rc must flip nonzero.
+    seq, path = st.store.checkpoint_files()[0]
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) - 7])
+    assert verify_checkpoint.main([str(tmp_path)]) == 2
+
+
+# ---------- the chaos scenario ----------
+
+
+def test_recovery_scenario_fast_deterministic():
+    """Tier-1 variant of ``--scenario recovery``: pinned seed whose kill
+    schedule covers EVERY durability kill point — torn/crash WAL appends,
+    torn/crash/late checkpoints, post-rename media corruption with
+    fallback, mid-restore kills — and still recovers every acknowledged
+    enrollment bit-exactly, then passes the graceful-drain phase."""
+    report = chaos_soak.run_recovery(seconds=4.0, seed=1)
+    assert report["ok"], report["failures"]
+    counts = report["counts"]
+    for key in ("wal_torn", "wal_crash", "ckpt_torn", "ckpt_crash",
+                "ckpt_late", "media_corrupt", "mid_restore_kills"):
+        assert counts[key] >= 1, (key, counts)
+    assert counts["checkpoints_corrupt"] >= 1  # fallback actually exercised
+    assert report["verify"]["ok"]
+    assert report["drain"]["results"] == report["drain"]["sent"]
+
+
+@pytest.mark.slow
+def test_recovery_scenario_long_randomized():
+    report = chaos_soak.run_recovery(seconds=12.0)
+    assert report["ok"], report["failures"]
