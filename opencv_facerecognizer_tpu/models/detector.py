@@ -395,8 +395,9 @@ class CNNFaceDetector:
             },
             "params": jax.tree_util.tree_map(np.asarray, self._params),
         }
-        with open(path, "wb") as fh:
-            fh.write(flax_serialization.msgpack_serialize(payload))
+        from opencv_facerecognizer_tpu.utils.serialization import atomic_write_bytes
+
+        atomic_write_bytes(path, flax_serialization.msgpack_serialize(payload))
 
     @classmethod
     def load(cls, path: str) -> "CNNFaceDetector":

@@ -717,8 +717,8 @@ class ShardedGallery:
         for hook in list(self.evict_hooks):
             try:
                 hook(below_capacity)
-            except Exception:  # eviction is best-effort bookkeeping;
-                pass  # serving must never die to a cleanup hook
+            except Exception:  # ocvf-lint: disable=swallowed-exception -- eviction is best-effort cache bookkeeping; a raising hook costs warm-cache memory, never correctness, and serving must never die to cleanup
+                pass
 
     def reset(self) -> None:
         with self._write_lock:

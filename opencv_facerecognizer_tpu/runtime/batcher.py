@@ -58,6 +58,7 @@ from collections import deque
 from typing import Any, List, NamedTuple, Optional, Tuple
 
 import numpy as np
+from opencv_facerecognizer_tpu.utils import metric_names as mn
 
 
 class Batch(NamedTuple):
@@ -146,7 +147,7 @@ class FrameBatcher:
         """Enqueue one frame (smaller ``priority`` = more important);
         returns False when dropped (malformed/closed/rejected-at-overflow)."""
         if self.metrics is not None:
-            self.metrics.incr("batcher_frames_offered")
+            self.metrics.incr(mn.BATCHER_FRAMES_OFFERED)
         if self._faults is not None:
             frame = self._faults.on_put(frame)
         frame = np.asarray(frame)
@@ -154,14 +155,14 @@ class FrameBatcher:
             with self._lock:
                 self._dropped_malformed += 1
             if self.metrics is not None:
-                self.metrics.incr("batcher_dropped_malformed")
+                self.metrics.incr(mn.BATCHER_DROPPED_MALFORMED)
             return False
         dropped = None  # (reason, entry) settled outside the lock
         accepted = True
         with self._not_empty:
             if self._closed:
                 if self.metrics is not None:
-                    self.metrics.incr("batcher_dropped_closed")
+                    self.metrics.incr(mn.BATCHER_DROPPED_CLOSED)
                 return False
             if len(self._frames) >= self.max_pending:
                 dropped = self._evict_for(int(priority))
@@ -184,13 +185,13 @@ class FrameBatcher:
             with self._lock:
                 self._dropped_overflow += 1
             if self.metrics is not None:
-                self.metrics.incr("batcher_dropped_overflow")
+                self.metrics.incr(mn.BATCHER_DROPPED_OVERFLOW)
             self._log_drop("overflow", [(meta, None, int(priority))])
             return False
         if dropped is not None:
             reason, entry = dropped
             if self.metrics is not None:
-                self.metrics.incr(f"batcher_dropped_{reason}")
+                self.metrics.incr(mn.BATCHER_DROPPED_PREFIX + reason)
             self._log_drop(reason, [entry])
         return True
 
@@ -232,8 +233,12 @@ class FrameBatcher:
                    for meta, ts, pri in items]
         try:
             self._drop_log(reason, entries)
-        except Exception:  # noqa: BLE001 — observer bugs stay theirs
-            pass
+        except Exception:  # noqa: BLE001 — observer bugs stay theirs, but a
+            # lost journal write must leave a trace: the soak's "journal
+            # covers every shed frame" check needs to know entries went
+            # missing (ocvf-lint swallowed-exception).
+            if self.metrics is not None:
+                self.metrics.incr(mn.JOURNAL_ERRORS)
 
     def close(self) -> None:
         with self._not_empty:
@@ -265,7 +270,7 @@ class FrameBatcher:
         deadline = min(self.flush_timeout,
                        max(self.min_deadline_s, self.target_latency_s - est))
         if self.metrics is not None:
-            self.metrics.set_gauge("batcher_flush_deadline_ms", deadline * 1e3)
+            self.metrics.set_gauge(mn.BATCHER_FLUSH_DEADLINE_MS, deadline * 1e3)
         return deadline
 
     # ---- buffer pool (host-side donated staging) ----
@@ -297,17 +302,17 @@ class FrameBatcher:
         finally:
             if stale:
                 if self.metrics is not None:
-                    self.metrics.incr("batcher_dropped_stale", len(stale))
+                    self.metrics.incr(mn.BATCHER_DROPPED_STALE, len(stale))
                 self._log_drop("stale", stale)
         if popped is None:
             return None
         items, count, full, buf = popped
         if self.metrics is not None:
-            self.metrics.incr("batcher_batches_size" if full
-                              else "batcher_batches_deadline")
-            self.metrics.incr("batcher_frames_batched", count)
+            self.metrics.incr(mn.BATCHER_BATCHES_SIZE if full
+                              else mn.BATCHER_BATCHES_DEADLINE)
+            self.metrics.incr(mn.BATCHER_FRAMES_BATCHED, count)
             if buf is not None:
-                self.metrics.incr("batcher_buffer_reuse")
+                self.metrics.incr(mn.BATCHER_BUFFER_REUSE)
         if buf is None:
             frames = np.zeros((self.batch_size, *self.frame_shape), dtype=self.dtype)
         else:

@@ -100,6 +100,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from opencv_facerecognizer_tpu.utils import metric_names as mn
 from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
 from opencv_facerecognizer_tpu.runtime.admission import (
     PRIORITY_INTERACTIVE,
@@ -177,7 +178,7 @@ class _ReadbackBlocker:
             try:
                 arr.block_until_ready()
                 self._ok = True
-            except Exception:  # noqa: BLE001 — classified by the caller
+            except Exception:  # ocvf-lint: disable=swallowed-exception -- failure IS recorded: _ok=False is read by block(), whose caller classifies the outage and dead-letters the batch
                 self._ok = False
             with self._cv:
                 self._pending = None
@@ -385,7 +386,7 @@ class RecognizerService:
                          getattr(self.pipeline, "mesh_a", None)):
                 if mesh is not None:
                     divisor = max(divisor, int(mesh.shape[DP_AXIS]))
-        except Exception:  # noqa: BLE001 — stub pipelines have no mesh
+        except Exception:  # ocvf-lint: disable=swallowed-exception -- config probe at construction: stub/fake pipelines legitimately have no mesh, divisor=1 is the documented fallback
             divisor = 1
         ladder = {int(b) for b in (bucket_sizes or ())
                   if 0 < int(b) < batch_size and int(b) % divisor == 0}
@@ -407,15 +408,15 @@ class RecognizerService:
     #: Pre-admission rejections (``frames_rejected_*``) are outside by
     #: design — a rejected frame never entered.
     LEDGER_DROP_COUNTERS = (
-        "frames_malformed",            # admitted, then failed to decode
-        "batcher_dropped_malformed",   # poisoned at the put boundary
-        "batcher_dropped_overflow",    # priority-aware overflow eviction
-        "batcher_dropped_stale",       # outlived shed_stale_after_s queued
-        "batcher_dropped_closed",      # arrived during shutdown
-        "frames_dropped_brownout",     # shed by the brownout controller
-        "frames_dead_lettered",        # readback outlived its deadline
-        "frames_failed",               # dispatch abandoned (retry budget)
-        "frames_dropped_crashed",      # lost to a serving-thread crash
+        mn.FRAMES_MALFORMED,            # admitted, then failed to decode
+        mn.BATCHER_DROPPED_MALFORMED,   # poisoned at the put boundary
+        mn.BATCHER_DROPPED_OVERFLOW,    # priority-aware overflow eviction
+        mn.BATCHER_DROPPED_STALE,       # outlived shed_stale_after_s queued
+        mn.BATCHER_DROPPED_CLOSED,      # arrived during shutdown
+        mn.FRAMES_DROPPED_BROWNOUT,     # shed by the brownout controller
+        mn.FRAMES_DEAD_LETTERED,        # readback outlived its deadline
+        mn.FRAMES_FAILED,               # dispatch abandoned (retry budget)
+        mn.FRAMES_DROPPED_CRASHED,      # lost to a serving-thread crash
     )
 
     def ledger(self) -> Dict[str, Any]:
@@ -428,8 +429,8 @@ class RecognizerService:
         c = self.metrics.counters()
         drops = {name: c[name] for name in self.LEDGER_DROP_COUNTERS
                  if c.get(name)}
-        admitted = c.get("frames_admitted", 0.0)
-        completed = c.get("frames_completed", 0.0)
+        admitted = c.get(mn.FRAMES_ADMITTED, 0.0)
+        completed = c.get(mn.FRAMES_COMPLETED, 0.0)
         return {
             "admitted": admitted,
             "completed": completed,
@@ -445,8 +446,8 @@ class RecognizerService:
         between buckets — fine for a bound, exactness is only claimed at
         quiescence."""
         return max(0.0, self.metrics.sum_counters(
-            ("frames_admitted",),
-            ("frames_completed",) + self.LEDGER_DROP_COUNTERS))
+            (mn.FRAMES_ADMITTED,),
+            (mn.FRAMES_COMPLETED,) + self.LEDGER_DROP_COUNTERS))
 
     def _journal_drop(self, reason: str, entries: List[Dict[str, Any]],
                       **extra) -> None:
@@ -462,7 +463,7 @@ class RecognizerService:
         it carries no per-frame fields (an aggregated window mixes
         priorities; stamping one would mislead a consumer throttling a
         specific producer class)."""
-        self.metrics.incr(f"frames_rejected_{reason}")
+        self.metrics.incr(mn.FRAMES_REJECTED_PREFIX + reason)
         now = time.monotonic()
         with self._reject_lock:
             self._reject_pending[reason] = self._reject_pending.get(reason, 0) + 1
@@ -525,13 +526,13 @@ class RecognizerService:
     def _set_brownout(self, level: int, ewma: float) -> None:
         self._brownout_level = level
         self._brownout_changed_at = time.monotonic()
-        self.metrics.set_gauge("brownout_level", level)
+        self.metrics.set_gauge(mn.BROWNOUT_LEVEL, level)
         if level > 0:
-            self.metrics.incr("brownout_transitions")
+            self.metrics.incr(mn.BROWNOUT_TRANSITIONS)
             self._publish_status({"status": "brownout", "level": level,
                                   "queue_wait_ewma_ms": round(ewma * 1e3, 2)})
         else:
-            self.metrics.incr("brownout_recoveries")
+            self.metrics.incr(mn.BROWNOUT_RECOVERIES)
             self._publish_status({"status": "brownout_recovered",
                                   "queue_wait_ewma_ms": round(ewma * 1e3, 2)})
 
@@ -588,23 +589,23 @@ class RecognizerService:
                     continue
             # Admitted: from here on the frame is the ledger's problem —
             # it must end as completed or as exactly one counted drop.
-            self.metrics.incr("frames_admitted")
+            self.metrics.incr(mn.FRAMES_ADMITTED)
             try:
                 frame = decode_frame(msg) if "__frame__" in msg else np.asarray(
                     msg["frame"]
                 )
             except Exception:
-                self.metrics.incr("frames_malformed")
+                self.metrics.incr(mn.FRAMES_MALFORMED)
                 continue
             if self._brownout_sheds_intake(priority):
-                self.metrics.incr("frames_dropped_brownout")
+                self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT)
                 self._journal_drop("brownout", [
                     {"meta": msg.get("meta"), "enqueue_ts": None,
                      "priority": priority}], level=self._brownout_level)
                 continue
             if not self.batcher.put(frame, meta=msg.get("meta"),
                                     priority=priority):
-                self.metrics.incr("frames_dropped")
+                self.metrics.incr(mn.FRAMES_DROPPED)
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
         cmd = message.get("cmd")
@@ -676,7 +677,7 @@ class RecognizerService:
         emb = self._run_embed_chunk(self.pipeline.embed_params, chunk)
         if hasattr(emb, "block_until_ready"):
             emb.block_until_ready()
-        self.metrics.observe("warmup", time.perf_counter() - t0)
+        self.metrics.observe(mn.WARMUP, time.perf_counter() - t0)
 
     def drain(self, timeout: float = 120.0) -> bool:
         """Block until every accepted frame has been batched, computed, AND
@@ -777,7 +778,7 @@ class RecognizerService:
             self._serve_loop()
         except Exception:  # noqa: BLE001 — flag the crash for the supervisor
             logging.getLogger(__name__).exception("serving loop crashed")
-            self.metrics.incr("loop_crashes")
+            self.metrics.incr(mn.LOOP_CRASHES)
             self._crashed = True
             self._publish_status({"status": "crashed"})
 
@@ -816,7 +817,7 @@ class RecognizerService:
         # and the brownout controller's load signal (batch mean).
         now_mono = time.monotonic()
         for ts in batch.enqueue_ts:
-            self.metrics.observe("queue_wait", now_mono - ts)
+            self.metrics.observe(mn.QUEUE_WAIT, now_mono - ts)
         if batch.enqueue_ts:
             self._note_queue_wait(
                 sum(now_mono - ts for ts in batch.enqueue_ts)
@@ -828,7 +829,7 @@ class RecognizerService:
         if cap is not None and count > cap:
             shed_metas = metas[cap:count]
             shed_ts = batch.enqueue_ts[cap:count]
-            self.metrics.incr("frames_dropped_brownout", count - cap)
+            self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT, count - cap)
             self._journal_drop("brownout", [
                 {"meta": m, "enqueue_ts": ts, "priority": None}
                 for m, ts in zip(shed_metas, shed_ts)],
@@ -847,7 +848,7 @@ class RecognizerService:
                 # batch): abandoned, not published — but still completed
                 # for drain() accounting (and an explicit per-frame drop
                 # in the admission ledger + journal).
-                self.metrics.incr("frames_failed", count)
+                self.metrics.incr(mn.FRAMES_FAILED, count)
                 self._journal_drop("failed", [
                     {"meta": m, "enqueue_ts": ts, "priority": None}
                     for m, ts in zip(metas[:count], batch.enqueue_ts[:count])])
@@ -858,7 +859,7 @@ class RecognizerService:
             # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
             # — never device compute, which is async from here).
             t_disp = time.perf_counter()
-            self.metrics.observe("dispatch", t_disp - t0)
+            self.metrics.observe(mn.DISPATCH, t_disp - t0)
             deadline = time.monotonic() + self.resilience.readback_deadline_s
             with self._inflight_cv:
                 self._inflight.append((packed, frames, metas, count,
@@ -871,13 +872,13 @@ class RecognizerService:
                 # drain()'s delivered==completed stays solvable after the
                 # supervisor restarts the loop — and its frames land in
                 # the ledger's crash bucket, not in limbo.
-                self.metrics.incr("frames_dropped_crashed", count)
+                self.metrics.incr(mn.FRAMES_DROPPED_CRASHED, count)
                 self._mark_completed()
             raise
-        self.metrics.incr("batches_dispatched")
-        self.metrics.incr("frames_processed", count)
+        self.metrics.incr(mn.BATCHES_DISPATCHED)
+        self.metrics.incr(mn.FRAMES_PROCESSED, count)
         if bucket < self.batcher.batch_size:
-            self.metrics.incr("batches_bucketed")
+            self.metrics.incr(mn.BATCHES_BUCKETED)
         if self._use_worker:
             # Backpressure: beyond inflight_depth undrained batches, wait
             # for the readback worker to free a slot (it notifies the cv on
@@ -915,7 +916,7 @@ class RecognizerService:
                 packed = self.pipeline.recognize_batch_packed(frames)
                 packed.copy_to_host_async()
             except Exception as exc:  # noqa: BLE001 — classified below
-                self.metrics.incr("dispatch_failures")
+                self.metrics.incr(mn.DISPATCH_FAILURES)
                 self._consecutive_dispatch_failures += 1
                 if (self._consecutive_dispatch_failures >= policy.degraded_after
                         and not self._degraded):
@@ -925,13 +926,13 @@ class RecognizerService:
                     logging.getLogger(__name__).exception(
                         "recognition batch abandoned (%s, attempt %d)",
                         "transient" if transient else "permanent", attempt)
-                    self.metrics.incr("batches_failed")
+                    self.metrics.incr(mn.BATCHES_FAILED)
                     return None
-                self.metrics.incr("dispatch_retries")
+                self.metrics.incr(mn.DISPATCH_RETRIES)
                 self._backoff_wait(policy.backoff(attempt))
                 attempt += 1
                 if not self._running:
-                    self.metrics.incr("batches_failed")
+                    self.metrics.incr(mn.BATCHES_FAILED)
                     return None
                 continue
             if self._consecutive_dispatch_failures:
@@ -959,7 +960,7 @@ class RecognizerService:
 
     def _enter_degraded(self, exc: BaseException) -> None:
         self._degraded = True
-        self.metrics.incr("degraded_transitions")
+        self.metrics.incr(mn.DEGRADED_TRANSITIONS)
         status = {
             "status": "degraded",
             "consecutive_failures": self._consecutive_dispatch_failures,
@@ -972,7 +973,7 @@ class RecognizerService:
             if not usable and self._cpu_fallback is not None:
                 try:
                     self._cpu_fallback(self)
-                    self.metrics.incr("cpu_fallbacks")
+                    self.metrics.incr(mn.CPU_FALLBACKS)
                     status["cpu_fallback"] = True
                 except Exception:  # noqa: BLE001 — fallback is best-effort
                     logging.getLogger(__name__).exception("cpu fallback failed")
@@ -981,7 +982,7 @@ class RecognizerService:
 
     def _exit_degraded(self) -> None:
         self._degraded = False
-        self.metrics.incr("degraded_recoveries")
+        self.metrics.incr(mn.DEGRADED_RECOVERIES)
         status = {"status": "recovered"}
         if self._embed_device is not None:
             # "Recovered" only in the sense that dispatches succeed again —
@@ -1020,8 +1021,8 @@ class RecognizerService:
         status message carries the dead frames' ids (their ``meta``) and
         enqueue timestamps so producers can retry, and the same entries
         land in the dead-letter journal."""
-        self.metrics.incr("batches_dead_lettered")
-        self.metrics.incr("frames_dead_lettered", count)
+        self.metrics.incr(mn.BATCHES_DEAD_LETTERED)
+        self.metrics.incr(mn.FRAMES_DEAD_LETTERED, count)
         self._mark_completed()
         entries = [{
             "meta": metas[i] if metas is not None else None,
@@ -1049,7 +1050,7 @@ class RecognizerService:
             return bool(packed.is_ready())
         except (AttributeError, NotImplementedError):
             return True
-        except Exception:  # noqa: BLE001 — outage-shaped; classify at materialize
+        except Exception:  # ocvf-lint: disable=swallowed-exception -- deliberate defer: reporting ready makes materialize re-raise on the classifying path, where _complete_head dead-letters with full accounting
             return True
 
     # ---- the readback worker (threaded path) ----
@@ -1059,7 +1060,7 @@ class RecognizerService:
             self._readback_loop()
         except Exception:  # noqa: BLE001 — flag the crash for the supervisor
             logging.getLogger(__name__).exception("readback worker crashed")
-            self.metrics.incr("loop_crashes")
+            self.metrics.incr(mn.LOOP_CRASHES)
             self._crashed = True
             self._publish_status({"status": "crashed"})
 
@@ -1088,7 +1089,7 @@ class RecognizerService:
                 # the supervisor's bounded restarts on an outage the
                 # dispatch side survives via retry/degraded mode).
                 logging.getLogger(__name__).exception("readback wait failed")
-                self.metrics.incr("readback_errors")
+                self.metrics.incr(mn.READBACK_ERRORS)
                 ready = False
             with self._inflight_cv:
                 self._inflight.popleft()
@@ -1201,11 +1202,11 @@ class RecognizerService:
         except Exception:  # noqa: BLE001 — outage error carried by the array
             logging.getLogger(__name__).exception(
                 "readback materialize failed")
-            self.metrics.incr("readback_errors")
+            self.metrics.incr(mn.READBACK_ERRORS)
             # completed++, no recycle (see above)
             self._dead_letter(count, metas, enqueue_ts)
             return
-        self.metrics.observe("ready_wait", time.perf_counter() - t_disp)
+        self.metrics.observe(mn.READY_WAIT, time.perf_counter() - t_disp)
         t_pub = time.perf_counter()
         try:
             self._publish(arr, frames, metas, count)
@@ -1214,8 +1215,8 @@ class RecognizerService:
             raise
         self._mark_completed()
         now = time.perf_counter()
-        self.metrics.observe("publish", now - t_pub)
-        self.metrics.observe("batch_latency", now - t0)
+        self.metrics.observe(mn.PUBLISH, now - t_pub)
+        self.metrics.observe(mn.BATCH_LATENCY, now - t0)
         # Feed the continuous batcher's adaptive deadline with the
         # realized downstream time (pop -> published).
         self.batcher.report_service_time(now - t0)
@@ -1261,16 +1262,16 @@ class RecognizerService:
                 self._maybe_collect_enrolment(frames[i], faces)
                 self.connector.publish(RESULT_TOPIC, {"meta": metas[i], "faces": faces})
                 published += 1
-                self.metrics.incr("faces_found", len(faces))
+                self.metrics.incr(mn.FACES_FOUND, len(faces))
         finally:
             # Ledger settlement happens HERE, per batch, whatever exits:
             # frames that made it out are completed; on a crash escaping
             # mid-batch the remainder lands in the crash bucket (the
             # publishing thread dies, the supervisor restarts it — the
             # frames must not stay in limbo between those events).
-            self.metrics.incr("frames_completed", published)
+            self.metrics.incr(mn.FRAMES_COMPLETED, published)
             if published < count:
-                self.metrics.incr("frames_dropped_crashed", count - published)
+                self.metrics.incr(mn.FRAMES_DROPPED_CRASHED, count - published)
 
     # ---- enrolment (interactive-trainer protocol) ----
 
@@ -1341,7 +1342,7 @@ class RecognizerService:
             if grown:
                 # Auto-grow saved the enrolment but forced a recompile-sized
                 # stall on the next match — surface it so operators pre-size.
-                self.metrics.incr("gallery_grown", grown)
+                self.metrics.incr(mn.GALLERY_GROWN, grown)
         except Exception:
             # Roll back a name we just reserved: the gallery has no rows
             # for it, so leaving it would skew label->name indices.
@@ -1350,7 +1351,7 @@ class RecognizerService:
                         and self.subject_names[label] == enrolment.subject_name):
                     self.subject_names.pop()
             raise
-        self.metrics.incr("subjects_enrolled")
+        self.metrics.incr(mn.SUBJECTS_ENROLLED)
         self.connector.publish(
             STATUS_TOPIC,
             {

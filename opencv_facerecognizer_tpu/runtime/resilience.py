@@ -36,6 +36,7 @@ import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple
+from opencv_facerecognizer_tpu.utils import metric_names as mn
 
 #: substrings (lowercased) that mark an exception as outage-shaped and
 #: therefore worth retrying. "unavailable" covers both the real PJRT
@@ -323,7 +324,7 @@ class ServiceSupervisor:
             self._snapshot_wal_seq = None
             self._snapshot = self.service.pipeline.gallery.snapshot()
             self._subject_names = list(self.service.subject_names)
-        self.service.metrics.incr("supervisor_checkpoints")
+        self.service.metrics.incr(mn.SUPERVISOR_CHECKPOINTS)
 
     def _on_commit(self) -> None:
         """Advance last-known-good after a committed gallery change. Runs
@@ -367,7 +368,7 @@ class ServiceSupervisor:
             if self.restarts >= self.max_restarts:
                 if not self.gave_up:
                     self.gave_up = True
-                    service.metrics.incr("supervisor_gave_up")
+                    service.metrics.incr(mn.SUPERVISOR_GAVE_UP)
                     self._publish(STATUS_TOPIC, {
                         "status": "supervisor_gave_up",
                         "restarts": self.restarts,
@@ -387,7 +388,7 @@ class ServiceSupervisor:
             # Counter flips only once the restore + restart are done, so a
             # watcher seeing it can rely on the last-known-good gallery
             # already being live (the chaos test's synchronization point).
-            service.metrics.incr("supervisor_restarts")
+            service.metrics.incr(mn.SUPERVISOR_RESTARTS)
             self._publish(STATUS_TOPIC, {
                 "status": "supervisor_restart",
                 "restarts": self.restarts,
@@ -416,7 +417,7 @@ class ServiceSupervisor:
                 and service.batcher.pending > 0
                 and now - self._last_progress_t > self.stall_warn_s):
             self._stall_warned = True
-            service.metrics.incr("supervisor_stalls")
+            service.metrics.incr(mn.SUPERVISOR_STALLS)
             self._publish(status_topic, {
                 "status": "stalled",
                 "pending_frames": service.batcher.pending,
@@ -452,7 +453,7 @@ class ServiceSupervisor:
         try:
             self.state.recover(self.service.pipeline.gallery,
                                self.service.subject_names)
-            self.service.metrics.incr("supervisor_durable_restores")
+            self.service.metrics.incr(mn.SUPERVISOR_DURABLE_RESTORES)
             return True
         except Exception:  # noqa: BLE001 — restore is best-effort here
             logging.getLogger(__name__).exception("durable restore failed")

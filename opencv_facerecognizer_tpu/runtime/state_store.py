@@ -66,6 +66,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from opencv_facerecognizer_tpu.utils import metric_names as mn
 from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
 from opencv_facerecognizer_tpu.runtime.journal import RotatingJournal
 from opencv_facerecognizer_tpu.utils.serialization import (
@@ -203,7 +204,7 @@ class CheckpointStore:
         ``torn`` persists a partial tmp then raises, ``crash`` completes
         the tmp but raises before the rename — both leave the previous
         checkpoint as the newest installed one."""
-        with self._lock:
+        with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- single-flight checkpoint writer: the store lock serializes save/load/retention and runs on the background checkpointer thread, never the serving loop
             seq = self.next_seq()
             header = {
                 "format_version": CHECKPOINT_FORMAT_VERSION,
@@ -233,7 +234,7 @@ class CheckpointStore:
                 raise InjectedCrashError("crash before checkpoint rename")
             atomic_write_bytes(path, blob)
             if self.metrics is not None:
-                self.metrics.incr("checkpoints_written")
+                self.metrics.incr(mn.CHECKPOINTS_WRITTEN)
             self._prune_locked()
             return path
 
@@ -249,7 +250,11 @@ class CheckpointStore:
             names = os.listdir(self.directory)
         except OSError:
             return
-        stale_tmp = [n for n in names if n.endswith(".tmp")]
+        # atomic_write_bytes stages as '<name>.tmp.<pid>' (pid-unique so
+        # concurrent writers can't share a staging file); fault-injection
+        # paths still write bare '<name>.tmp' — sweep both shapes, or
+        # crashed saves leak multi-MB orphans forever
+        stale_tmp = [n for n in names if n.endswith(".tmp") or ".tmp." in n]
         quarantined = sorted(n for n in names if n.endswith(QUARANTINE_SUFFIX))
         for name in stale_tmp + quarantined[:-self.keep or None]:
             try:
@@ -268,7 +273,7 @@ class CheckpointStore:
         READ error (OSError) raises instead: it proves nothing about the
         bytes, and quarantining on it could demote a valid checkpoint
         whose WAL delta is already truncated."""
-        with self._lock:
+        with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- startup/supervisor recovery path: reads must see a settled file set, and nothing latency-sensitive contends here
             for _seq, path in self.checkpoint_files():
                 try:
                     with open(path, "rb") as fh:
@@ -283,7 +288,7 @@ class CheckpointStore:
                     logging.getLogger(__name__).exception(
                         "checkpoint read failed (NOT corruption): %s", path)
                     if self.metrics is not None:
-                        self.metrics.incr("checkpoint_read_errors")
+                        self.metrics.incr(mn.CHECKPOINT_READ_ERRORS)
                     raise
                 try:
                     header, payload = _decode_checkpoint(blob, path)
@@ -296,12 +301,12 @@ class CheckpointStore:
                         "newer-format checkpoint skipped (NOT quarantined)"
                         ": %s", exc)
                     if self.metrics is not None:
-                        self.metrics.incr("checkpoints_version_skipped")
+                        self.metrics.incr(mn.CHECKPOINTS_VERSION_SKIPPED)
                 except CheckpointCorruptError as exc:
                     logging.getLogger(__name__).warning(
                         "corrupt checkpoint skipped: %s", exc)
                     if self.metrics is not None:
-                        self.metrics.incr("checkpoints_corrupt")
+                        self.metrics.incr(mn.CHECKPOINTS_CORRUPT)
                     self.quarantine(path)
             return None
 
@@ -405,7 +410,7 @@ class EnrollmentWAL(RotatingJournal):
             return
         self._warned_over_bytes = True
         if self.metrics is not None:
-            self.metrics.incr("wal_over_bytes")
+            self.metrics.incr(mn.WAL_OVER_BYTES)
         logging.getLogger(__name__).warning(
             "enrollment WAL exceeds %d bytes without a checkpoint "
             "truncating it — checkpoints failing, or thresholds too "
@@ -418,7 +423,7 @@ class EnrollmentWAL(RotatingJournal):
         brand-new acknowledged record. Seal the torn tail with a newline
         at open so it stays an isolated unparseable line (skipped on
         replay, visible to forensics) and new appends start clean."""
-        with self._lock:
+        with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- torn-tail seal runs once at open, before any appender exists; the seal must be durable before replay trusts the file
             try:
                 if not os.path.exists(self.path) or not os.path.getsize(self.path):
                     return
@@ -429,10 +434,10 @@ class EnrollmentWAL(RotatingJournal):
                         fh.flush()
                         os.fsync(fh.fileno())
                         if self.metrics is not None:
-                            self.metrics.incr("wal_torn_tails_sealed")
+                            self.metrics.incr(mn.WAL_TORN_TAILS_SEALED)
             except OSError:
                 if self.metrics is not None:
-                    self.metrics.incr("journal_errors")
+                    self.metrics.incr(mn.JOURNAL_ERRORS)
 
     def append_enroll(self, seq: int, embeddings: np.ndarray,
                       labels: np.ndarray, subject: Optional[str] = None,
@@ -472,8 +477,8 @@ class EnrollmentWAL(RotatingJournal):
             raise InjectedCrashError("torn WAL append")
         self.append_line(line, strict=True)
         if self.metrics is not None:
-            self.metrics.incr("wal_appends")
-            self.metrics.incr("wal_rows_appended", emb.shape[0])
+            self.metrics.incr(mn.WAL_APPENDS)
+            self.metrics.incr(mn.WAL_ROWS_APPENDED, emb.shape[0])
 
     def scan(self) -> Tuple[List[Dict[str, Any]], int]:
         """ONE parse of the whole WAL -> (surviving decoded enrollments
@@ -505,7 +510,7 @@ class EnrollmentWAL(RotatingJournal):
             decoded = decode_enroll_record(record)
             if decoded is None:
                 if self.metrics is not None:
-                    self.metrics.incr("wal_corrupt_records")
+                    self.metrics.incr(mn.WAL_CORRUPT_RECORDS)
                 continue
             out.append(decoded)
         return out, highest
@@ -525,7 +530,7 @@ class EnrollmentWAL(RotatingJournal):
         self.append_line(json.dumps({"kind": "abort", "seq": int(seq),
                                      "ts": time.time()}), strict=False)
         if self.metrics is not None:
-            self.metrics.incr("wal_aborts")
+            self.metrics.incr(mn.WAL_ABORTS)
 
     def enrollments(self) -> Iterator[Dict[str, Any]]:
         """Decoded enrollment records oldest-first, with aborted sequences
@@ -541,7 +546,7 @@ class EnrollmentWAL(RotatingJournal):
         Correctness never depends on this running — replay dedups against
         the checkpoint's ``wal_seq`` either way; truncation only bounds
         disk."""
-        with self._lock:
+        with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- WAL compaction: appenders MUST be excluded while the file is rewritten and swapped, or acked rows could vanish; bounded by WAL size and off the serving path
             if self._fh is not None:
                 self._fh.flush()
                 self._fh.close()
@@ -570,7 +575,7 @@ class EnrollmentWAL(RotatingJournal):
                 self._warned_over_bytes = False  # compacted: re-arm
             except OSError:
                 if self.metrics is not None:
-                    self.metrics.incr("journal_errors")
+                    self.metrics.incr(mn.JOURNAL_ERRORS)
 
 
 class StateLifecycle:
@@ -693,15 +698,15 @@ class StateLifecycle:
                 if seq <= base_seq:
                     report["skipped_records"] += 1
                     if self.metrics is not None:
-                        self.metrics.incr("wal_skipped_records")
+                        self.metrics.incr(mn.WAL_SKIPPED_RECORDS)
                     continue
                 gallery.add(record["embeddings"], record["labels_np"])
                 self._grow_names(names, record)
                 report["replayed_records"] += 1
                 report["replayed_rows"] += int(record["n"])
                 if self.metrics is not None:
-                    self.metrics.incr("wal_replayed_records")
-                    self.metrics.incr("wal_replayed_rows", int(record["n"]))
+                    self.metrics.incr(mn.WAL_REPLAYED_RECORDS)
+                    self.metrics.incr(mn.WAL_REPLAYED_ROWS, int(record["n"]))
             # Seed the sequence from EVERY record — aborts and corrupt-
             # but-parseable ones included (wal.scan docstring): seeding
             # from surviving enrollments alone would reuse a tombstoned
@@ -713,8 +718,8 @@ class StateLifecycle:
             wait_ready(timeout=300.0)
         self._last_ckpt_t = time.monotonic()
         if self.metrics is not None:
-            self.metrics.incr("state_recoveries")
-            self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+            self.metrics.incr(mn.STATE_RECOVERIES)
+            self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         report["gallery_size"] = gallery.size
         return report
 
@@ -750,7 +755,7 @@ class StateLifecycle:
                     "checkpoint %s payload decode failed (%r); falling "
                     "back to the previous checkpoint", path, exc)
                 if self.metrics is not None:
-                    self.metrics.incr("checkpoints_corrupt")
+                    self.metrics.incr(mn.CHECKPOINTS_CORRUPT)
                 report.setdefault("payload_decode_errors", []).append(repr(exc))
                 self.store.quarantine(path)
                 continue
@@ -821,7 +826,7 @@ class StateLifecycle:
                     raise
             self._rows_since_ckpt += n
         if self.metrics is not None:
-            self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+            self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         self.maybe_checkpoint()
         return seq
 
@@ -855,7 +860,7 @@ class StateLifecycle:
                 self._grow_names(names, record)
                 rows += int(record["n"])
         if rows and self.metrics is not None:
-            self.metrics.incr("wal_tail_replayed_rows", rows)
+            self.metrics.incr(mn.WAL_TAIL_REPLAYED_ROWS, rows)
         return rows
 
     # ---- checkpointing ----
@@ -893,7 +898,7 @@ class StateLifecycle:
             return False
         if self._ckpt_lock.locked():
             if self.metrics is not None:
-                self.metrics.incr("checkpoints_skipped_inflight")
+                self.metrics.incr(mn.CHECKPOINTS_SKIPPED_INFLIGHT)
             return False
         threading.Thread(target=self.checkpoint_now, daemon=True,
                          name="state-checkpoint").start()
@@ -912,7 +917,7 @@ class StateLifecycle:
         simulated kill, not a failure to handle."""
         if not self._ckpt_lock.acquire(blocking=wait):
             if self.metrics is not None:
-                self.metrics.incr("checkpoints_skipped_inflight")
+                self.metrics.incr(mn.CHECKPOINTS_SKIPPED_INFLIGHT)
             return False
         # Claim any pending force request BEFORE snapshotting: this
         # attempt's snapshot postdates the request, so success satisfies
@@ -941,7 +946,7 @@ class StateLifecycle:
                 # checkpoint + full WAL stay consistent.
                 if getattr(gallery, "pending_rows", 0):
                     if self.metrics is not None:
-                        self.metrics.incr("checkpoints_deferred_pending")
+                        self.metrics.incr(mn.CHECKPOINTS_DEFERRED_PENDING)
                     logging.getLogger(__name__).warning(
                         "checkpoint deferred: %d staged rows not yet "
                         "landed", gallery.pending_rows)
@@ -976,7 +981,7 @@ class StateLifecycle:
             except Exception:  # noqa: BLE001 — disk full, perms, ...
                 logging.getLogger(__name__).exception("checkpoint save failed")
                 if self.metrics is not None:
-                    self.metrics.incr("checkpoint_failures")
+                    self.metrics.incr(mn.CHECKPOINT_FAILURES)
                 # Exponential retry backoff: a persistently failing save
                 # (full/unwritable dir) must not re-run a whole-gallery
                 # snapshot + serialize on every serving-loop tick.
@@ -998,7 +1003,7 @@ class StateLifecycle:
             self._ckpt_retry_backoff_s = 1.0
             self._ckpt_retry_at = 0.0
             if self.metrics is not None:
-                self.metrics.set_gauge("wal_rows", self._rows_since_ckpt)
+                self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
             return True
         finally:
             self._ckpt_lock.release()

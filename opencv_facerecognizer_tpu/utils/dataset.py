@@ -41,7 +41,7 @@ def _imread_gray(path: str) -> Optional[np.ndarray]:
 
         with Image.open(path) as im:
             return np.asarray(im.convert("L"), dtype=np.float32)
-    except Exception:
+    except Exception:  # ocvf-lint: disable=swallowed-exception -- None is this loader's documented contract: the dataset walker skips unreadable files, and a corrupt image in a training dir is data, not a fault
         return None
 
 

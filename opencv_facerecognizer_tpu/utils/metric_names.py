@@ -1,0 +1,128 @@
+"""Canonical registry of every metric name on the shared ``Metrics``
+surface.
+
+The chaos soaks, the admission ledger, the overload bench and the tests
+all compare counters *by string name* across a dozen files; a single typo
+silently breaks an accounting invariant with no error anywhere.  Every
+``incr``/``observe``/``set_gauge`` (and read-side ``counter``/
+``percentile``/``counters_with_prefix``) call must use a constant from
+this module, or a literal whose value appears here — enforced statically
+by ``python -m tools.ocvf_lint`` (rule ``metrics-registry``).
+
+Constants ending in ``_PREFIX`` name families whose suffix is dynamic
+(``frames_rejected_<reason>``); the prefix itself is what gets validated.
+
+Adding a metric: add the constant here first, then use it at the call
+site.  Never inline a new name string at a call site.
+"""
+
+# ---- serving loop: frame lifecycle counters -------------------------------
+FRAMES_ADMITTED = "frames_admitted"
+FRAMES_COMPLETED = "frames_completed"
+FRAMES_PROCESSED = "frames_processed"
+FRAMES_MALFORMED = "frames_malformed"
+FRAMES_DROPPED = "frames_dropped"
+FRAMES_DROPPED_BROWNOUT = "frames_dropped_brownout"
+FRAMES_DROPPED_CRASHED = "frames_dropped_crashed"
+FRAMES_FAILED = "frames_failed"
+FRAMES_DEAD_LETTERED = "frames_dead_lettered"
+FACES_FOUND = "faces_found"
+SUBJECTS_ENROLLED = "subjects_enrolled"
+GALLERY_GROWN = "gallery_grown"
+
+# ---- serving loop: batch counters -----------------------------------------
+BATCHES_DISPATCHED = "batches_dispatched"
+BATCHES_BUCKETED = "batches_bucketed"
+BATCHES_FAILED = "batches_failed"
+BATCHES_DEAD_LETTERED = "batches_dead_lettered"
+LOOP_CRASHES = "loop_crashes"
+DISPATCH_FAILURES = "dispatch_failures"
+DISPATCH_RETRIES = "dispatch_retries"
+READBACK_ERRORS = "readback_errors"
+CPU_FALLBACKS = "cpu_fallbacks"
+DEGRADED_TRANSITIONS = "degraded_transitions"
+DEGRADED_RECOVERIES = "degraded_recoveries"
+
+# ---- serving loop: latency windows (observe) ------------------------------
+WARMUP = "warmup"
+QUEUE_WAIT = "queue_wait"
+DISPATCH = "dispatch"
+PUBLISH = "publish"
+BATCH_LATENCY = "batch_latency"
+READY_WAIT = "ready_wait"
+
+# ---- admission / brownout (overload layer) --------------------------------
+#: per-reason rejection family: ``frames_rejected_<reason>``
+FRAMES_REJECTED_PREFIX = "frames_rejected_"
+BROWNOUT_LEVEL = "brownout_level"
+BROWNOUT_TRANSITIONS = "brownout_transitions"
+BROWNOUT_RECOVERIES = "brownout_recoveries"
+
+# ---- batcher ---------------------------------------------------------------
+BATCHER_FRAMES_OFFERED = "batcher_frames_offered"
+BATCHER_FRAMES_BATCHED = "batcher_frames_batched"
+#: per-reason drop family: ``batcher_dropped_<reason>``
+BATCHER_DROPPED_PREFIX = "batcher_dropped_"
+BATCHER_DROPPED_MALFORMED = "batcher_dropped_malformed"
+BATCHER_DROPPED_CLOSED = "batcher_dropped_closed"
+BATCHER_DROPPED_OVERFLOW = "batcher_dropped_overflow"
+BATCHER_DROPPED_STALE = "batcher_dropped_stale"
+BATCHER_BATCHES_SIZE = "batcher_batches_size"
+BATCHER_BATCHES_DEADLINE = "batcher_batches_deadline"
+BATCHER_BUFFER_REUSE = "batcher_buffer_reuse"
+BATCHER_FLUSH_DEADLINE_MS = "batcher_flush_deadline_ms"
+
+# ---- connectors ------------------------------------------------------------
+CONNECTOR_MALFORMED_LINES = "connector_malformed_lines"
+CONNECTOR_PEER_DISCONNECTS = "connector_peer_disconnects"
+CONNECTOR_RECONNECTS = "connector_reconnects"
+CONNECTOR_RECONNECT_FAILURES = "connector_reconnect_failures"
+CONNECTOR_STALLED_CLIENTS_DROPPED = "connector_stalled_clients_dropped"
+
+# ---- dead-letter journal ---------------------------------------------------
+JOURNAL_ERRORS = "journal_errors"
+JOURNAL_RECORDS = "journal_records"
+JOURNAL_FRAMES = "journal_frames"
+
+# ---- durable state: checkpoints --------------------------------------------
+CHECKPOINTS_WRITTEN = "checkpoints_written"
+CHECKPOINTS_CORRUPT = "checkpoints_corrupt"
+CHECKPOINTS_VERSION_SKIPPED = "checkpoints_version_skipped"
+CHECKPOINT_READ_ERRORS = "checkpoint_read_errors"
+CHECKPOINT_FAILURES = "checkpoint_failures"
+CHECKPOINTS_SKIPPED_INFLIGHT = "checkpoints_skipped_inflight"
+CHECKPOINTS_DEFERRED_PENDING = "checkpoints_deferred_pending"
+
+# ---- durable state: enrollment WAL -----------------------------------------
+WAL_APPENDS = "wal_appends"
+WAL_ROWS_APPENDED = "wal_rows_appended"
+WAL_ABORTS = "wal_aborts"
+WAL_CORRUPT_RECORDS = "wal_corrupt_records"
+WAL_SKIPPED_RECORDS = "wal_skipped_records"
+WAL_REPLAYED_RECORDS = "wal_replayed_records"
+WAL_REPLAYED_ROWS = "wal_replayed_rows"
+WAL_TAIL_REPLAYED_ROWS = "wal_tail_replayed_rows"
+WAL_TORN_TAILS_SEALED = "wal_torn_tails_sealed"
+WAL_OVER_BYTES = "wal_over_bytes"
+WAL_ROWS = "wal_rows"
+STATE_RECOVERIES = "state_recoveries"
+
+# ---- supervisor ------------------------------------------------------------
+SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"
+SUPERVISOR_RESTARTS = "supervisor_restarts"
+SUPERVISOR_STALLS = "supervisor_stalls"
+SUPERVISOR_GAVE_UP = "supervisor_gave_up"
+SUPERVISOR_DURABLE_RESTORES = "supervisor_durable_restores"
+
+
+def all_names():
+    """Every registered full metric name (prefix families excluded) —
+    used by tests to assert the registry has no duplicate values."""
+    return sorted(v for k, v in globals().items()
+                  if k.isupper() and not k.endswith("_PREFIX")
+                  and isinstance(v, str))
+
+
+def all_prefixes():
+    return sorted(v for k, v in globals().items()
+                  if k.endswith("_PREFIX") and isinstance(v, str))

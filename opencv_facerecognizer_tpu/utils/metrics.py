@@ -17,6 +17,11 @@ class Metrics:
 
     def __init__(self, sink: Optional[IO[str]] = None, window: int = 512):
         self._lock = threading.Lock()
+        # The sink gets its OWN lock: a slow JSONL sink (disk stall, full
+        # pipe) must serialize log lines against each other, but it must
+        # never stall every counter incr on the serving hot path behind a
+        # write(2) — found by ocvf-lint blocking-under-lock.
+        self._sink_lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
         self._latencies: Dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
@@ -82,7 +87,10 @@ class Metrics:
             return
         record = {"ts": time.time(), "event": event, **fields}
         line = json.dumps(record)
-        with self._lock:
+        # I/O deliberately held under the sink lock: serializing writers is
+        # this lock's entire purpose and nothing on the counter path ever
+        # takes it.
+        with self._sink_lock:  # ocvf-lint: disable-block=blocking-under-lock -- sink lock exists solely to serialize sink writes; counter paths never take it
             self._sink.write(line + "\n")
             self._sink.flush()
 

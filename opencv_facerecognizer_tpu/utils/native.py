@@ -46,7 +46,7 @@ def _build() -> bool:
         )
         os.replace(tmp, _SO)
         return True
-    except Exception:
+    except Exception:  # ocvf-lint: disable=swallowed-exception -- optional-acceleration probe: no compiler / failed build means the pure-NumPy path serves, and False is the recorded verdict
         try:
             os.unlink(tmp)
         except OSError:

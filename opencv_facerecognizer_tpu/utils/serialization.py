@@ -64,7 +64,10 @@ def atomic_write_bytes(filename: str, blob: bytes,
     stale-only-under-``.1``."""
     filename = str(filename)
     directory = os.path.dirname(os.path.abspath(filename))
-    tmp = filename + ".tmp"
+    # pid-unique tmp: two concurrent writers of the same target must not
+    # share a staging file, or one's os.replace could install the other's
+    # half-written bytes — the exact torn-file class this helper prevents
+    tmp = f"{filename}.tmp.{os.getpid()}"
     fh = open(tmp, "wb")
     try:
         fh.write(blob)
@@ -76,6 +79,25 @@ def atomic_write_bytes(filename: str, blob: bytes,
         rotate_backups(filename, keep_previous)
     os.replace(tmp, filename)
     fsync_directory(directory)
+
+
+def atomic_write_text(filename: str, text: str,
+                      keep_previous: int = 0) -> None:
+    """``atomic_write_bytes`` for text — the required way to write reports,
+    JSON artifacts and any other file whose torn half-write would be read
+    later (ocvf-lint rule ``non-atomic-write`` flags bare ``open(.., 'w')``)."""
+    atomic_write_bytes(filename, text.encode("utf-8"),
+                       keep_previous=keep_previous)
+
+
+def atomic_write_json(filename: str, obj: Any, *, indent: int = 2,
+                      sort_keys: bool = False, keep_previous: int = 0) -> None:
+    """Crash-safe ``json.dump`` replacement: serialize fully in memory, then
+    one atomic tmp+fsync+rename install.  ``json.dump(obj, fh)`` writes
+    incrementally, so a crash mid-dump leaves a truncated-but-parseable-
+    prefix trap; this never does."""
+    text = json.dumps(obj, indent=indent, sort_keys=sort_keys)
+    atomic_write_text(filename, text + "\n", keep_previous=keep_previous)
 
 
 def rotate_backups(filename: str, keep: int) -> None:

@@ -116,8 +116,9 @@ def main():
     except (OSError, json.JSONDecodeError):
         detail = {}
     detail["gallery_dtype"] = result
-    with open(path, "w") as fh:
-        json.dump(detail, fh, indent=2)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(path, detail)
     _log("merged gallery_dtype into BENCH_DETAIL.json")
     print(json.dumps(result, indent=2))
 

@@ -221,8 +221,9 @@ def main():
                  "path) / install (the atomic publish)"),
         **result,
     }
-    with open(detail_path, "w") as fh:
-        json.dump(detail, fh, indent=2)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(detail_path, detail)
     _log("merged lifecycle section into BENCH_DETAIL.json")
     print(json.dumps(detail["lifecycle"], indent=2))
 

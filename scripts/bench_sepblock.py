@@ -139,8 +139,9 @@ def main(argv=None):
     except (OSError, json.JSONDecodeError):
         detail = {}
     detail["sepblock_fused"] = doc
-    with open(detail_path, "w") as fh:
-        json.dump(detail, fh, indent=2)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(detail_path, detail)
     _log("merged sepblock_fused into BENCH_DETAIL.json")
 
 

@@ -621,6 +621,7 @@ def run_recovery(seconds: float = 4.0, seed: int | None = None,
                             if files:
                                 path = files[0][1]
                                 blob = open(path, "rb").read()
+                                # ocvf-lint: disable=non-atomic-write -- deliberately injecting a torn checkpoint: the whole point is to corrupt the newest file and prove recovery falls back past it
                                 with open(path, "wb") as fh:
                                     fh.write(blob[:int(len(blob) * 0.6)])
                                 counts["media_corrupt"] += 1

@@ -207,10 +207,9 @@ def main(argv=None):
         results[result_key] = {**thunk(), **stamp}  # per-row provenance
 
     results["_meta"] = dict(stamp)
-    tmp = f"{CACHE}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(results, f, indent=2)
-    os.replace(tmp, CACHE)  # atomic: a killed run can't truncate the cache
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(CACHE, results)  # atomic: a killed run can't truncate the cache
     print(json.dumps(results, indent=2))
 
     all_rows = [
@@ -254,7 +253,9 @@ def main(argv=None):
                       text, flags=re.S)
     else:
         text = text.rstrip() + "\n\n## Measured accuracy (this framework)\n\n" + block + "\n"
-    open(path, "w").write(text)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_text
+
+    atomic_write_text(path, text)
     print(f"BASELINE.md measured block updated", file=sys.stderr)
 
 

@@ -336,10 +336,9 @@ def main(argv=None):
                 f"delta {row['delta']:+.4f}" if "framework" in row else ""))
 
     results["_meta"] = {"date": time.strftime("%Y-%m-%d")}
-    tmp = f"{CACHE}.tmp.{os.getpid()}"
-    with open(tmp, "w") as f:
-        json.dump(results, f, indent=2)
-    os.replace(tmp, CACHE)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(CACHE, results)
     print(json.dumps(results, indent=2))
 
     # -- render the BASELINE.md ORACLE block --
@@ -384,7 +383,9 @@ def main(argv=None):
         text = (text.rstrip()
                 + "\n\n## Oracle parity (classic models, same data/folds)\n\n"
                 + block + "\n")
-    open(path, "w").write(text)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_text
+
+    atomic_write_text(path, text)
     _log("BASELINE.md oracle block updated")
 
 

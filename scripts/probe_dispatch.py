@@ -176,8 +176,9 @@ def main(argv=None):
     except (OSError, json.JSONDecodeError):
         doc = {}
     doc.setdefault("dispatch_decomposition", {})[str(batch)] = result
-    with open(path, "w") as fh:
-        json.dump(doc, fh, indent=2)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(path, doc)
     _log("merged dispatch_decomposition into BENCH_SERVING.json")
     print(json.dumps(result, indent=2))
 

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# ocvf-lint wrapper with stable exit codes, for CI and the verify recipe.
+#
+#   ./scripts/run_lint.sh            # lint the package + scripts (the gate)
+#   ./scripts/run_lint.sh PATH...    # lint specific files/dirs
+#   ./scripts/run_lint.sh --json     # machine-readable output
+#
+# Exit codes (the CLI's contract, passed through verbatim):
+#   0  clean — no findings
+#   1  findings reported (see stdout)
+#   2  internal error (linter crash, bad path, bad invocation)
+set -u
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO" || exit 2
+
+args=()
+paths=0
+expect_value=0
+for a in "$@"; do
+    args+=("$a")
+    if [ "$expect_value" -eq 1 ]; then
+        expect_value=0           # this token is an option's value, not a path
+        continue
+    fi
+    case "$a" in
+        --rules) expect_value=1 ;;   # space-separated value follows
+        --*) ;;
+        *) paths=1 ;;
+    esac
+done
+if [ "$paths" -eq 0 ]; then
+    args+=(opencv_facerecognizer_tpu scripts)
+fi
+
+python -m tools.ocvf_lint "${args[@]}"
+exit $?

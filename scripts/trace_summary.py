@@ -224,8 +224,9 @@ def main(argv=None):
     key = ("trace_summary" if args.batch == 32
            else f"trace_summary_b{args.batch}")
     detail[key] = summary
-    with open(detail_path, "w") as fh:
-        json.dump(detail, fh, indent=2)
+    from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+    atomic_write_json(detail_path, detail)
     _log(f"merged {key} into BENCH_DETAIL.json")
     print(json.dumps(summary, indent=2)[:4000])
 

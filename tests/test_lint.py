@@ -1,0 +1,602 @@
+"""ocvf-lint framework tests: per-rule fixture snippets (positive, negative,
+suppressed), suppression hygiene, CLI exit-code contract, and the tier-1
+gate that the real tree is clean.
+
+The fixture tests assert exact (rule, line) pairs — the acceptance bar is
+that a deliberately seeded violation of every rule is detected at the
+correct file:line, not merely that "something" fires."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.ocvf_lint import core  # noqa: E402
+
+
+def lint_tree(tmp_path, files, rules=None):
+    """Write {relpath: source} under tmp_path and lint the tree."""
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+    return core.run([str(tmp_path)], rules=rules).findings
+
+
+def lint_source(tmp_path, source, rules=None):
+    return lint_tree(tmp_path, {"mod.py": source}, rules=rules)
+
+
+def rules_and_lines(findings):
+    return [(f.rule, f.line) for f in findings]
+
+
+# ---------------- blocking-under-lock ----------------
+
+
+def test_blocking_under_lock_positive(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        class S:
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """, rules=["blocking-under-lock"])
+    assert rules_and_lines(findings) == [("blocking-under-lock", 6)]
+
+
+def test_blocking_under_lock_negatives(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        class S:
+            def sleep_outside(self):
+                with self._lock:
+                    x = 1
+                time.sleep(0.1)
+
+            def nested_def_resets(self):
+                with self._lock:
+                    def later():
+                        time.sleep(0.1)  # runs outside the lock
+                    self.hook = later
+
+            def str_join_is_not_io(self):
+                with self._lock:
+                    return ", ".join(["a"])
+        """, rules=["blocking-under-lock"])
+    assert findings == []
+
+
+def test_blocking_under_lock_io_and_suppression(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import os
+
+        class S:
+            def fsyncs(self, fh):
+                with self._lock:
+                    os.fsync(fh.fileno())
+
+            def justified(self, fh):
+                with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- this lock exists to serialize these writes
+                    fh.write(b"x")
+                    fh.flush()
+        """, rules=["blocking-under-lock"])
+    assert rules_and_lines(findings) == [("blocking-under-lock", 6)]
+
+
+# ---------------- lock-order ----------------
+
+
+def test_lock_order_inversion_detected(tmp_path):
+    findings = lint_source(tmp_path, """\
+        class S:
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """, rules=["lock-order"])
+    assert len(findings) == 1
+    assert findings[0].rule == "lock-order"
+    assert findings[0].line == 4  # the first edge site
+    assert "inversion" in findings[0].message
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    findings = lint_source(tmp_path, """\
+        class S:
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+        """, rules=["lock-order"])
+    assert findings == []
+
+
+def test_lock_order_re_entry_detected(tmp_path):
+    findings = lint_source(tmp_path, """\
+        class S:
+            def re_enter(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+        """, rules=["lock-order"])
+    assert rules_and_lines(findings) == [("lock-order", 4)]
+    assert "re-acquired" in findings[0].message
+
+
+def test_lock_order_call_propagation(tmp_path):
+    """An inversion only visible through a method call: ab() nests
+    lexically, ba() holds b and CALLS a helper that takes a."""
+    findings = lint_source(tmp_path, """\
+        class S:
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def take_a(self):
+                with self._a_lock:
+                    pass
+
+            def ba(self):
+                with self._b_lock:
+                    self.take_a()
+        """, rules=["lock-order"])
+    assert len(findings) == 1
+    assert "inversion" in findings[0].message
+
+
+def test_lock_order_suppression_at_any_edge(tmp_path):
+    findings = lint_source(tmp_path, """\
+        class S:
+            def ab(self):
+                with self._a_lock:
+                    with self._b_lock:  # ocvf-lint: disable=lock-order -- ordered handoff proven safe by construction here
+                        pass
+
+            def ba(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """, rules=["lock-order"])
+    assert findings == []
+
+
+# ---------------- non-atomic-write ----------------
+
+
+def test_non_atomic_write_positive(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import json
+
+        def save(path, obj):
+            with open(path, "w") as fh:
+                json.dump(obj, fh)
+        """, rules=["non-atomic-write"])
+    assert rules_and_lines(findings) == [("non-atomic-write", 4)]
+
+
+def test_non_atomic_write_negatives(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def fine(path):
+            with open(path) as fh:
+                data = fh.read()
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            with open(path, "a") as fh:  # append = journal-style, exempt
+                fh.write("x")
+            return data, blob
+        """, rules=["non-atomic-write"])
+    assert findings == []
+
+
+def test_non_atomic_write_exempt_layers_and_suppression(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/serialization.py": """\
+            def atomic_write_bytes(path, blob):
+                with open(path + ".tmp", "wb") as fh:  # the helper itself
+                    fh.write(blob)
+            """,
+        "app.py": """\
+            def dump(path, text):
+                # ocvf-lint: disable=non-atomic-write -- throwaway debug artifact, torn file is harmless
+                with open(path, "w") as fh:
+                    fh.write(text)
+            """,
+        "pathlib_user.py": """\
+            def bad(p):
+                p.write_text("hello")
+            """,
+    }, rules=["non-atomic-write"])
+    assert [(f.rule, os.path.basename(f.path), f.line) for f in findings] == [
+        ("non-atomic-write", "pathlib_user.py", 2)]
+
+
+# ---------------- metrics-registry ----------------
+
+METRIC_FIXTURE_REGISTRY = """\
+    GOOD = "good_metric"
+    OTHER = "other_metric"
+    FAMILY_PREFIX = "fam_"
+    """
+
+
+def test_metrics_registry_literals(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": METRIC_FIXTURE_REGISTRY,
+        "app.py": """\
+            def f(metrics, reason):
+                metrics.incr("good_metric")
+                metrics.incr("bad_typo_metric")
+                metrics.observe("other_metric", 1.0)
+                metrics.incr(f"fam_{reason}")
+                metrics.incr(f"unregistered_{reason}")
+            """,
+    }, rules=["metrics-registry"])
+    assert rules_and_lines(findings) == [("metrics-registry", 3),
+                                         ("metrics-registry", 6)]
+
+
+def test_metrics_registry_constants_and_prefix_concat(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": METRIC_FIXTURE_REGISTRY,
+        "app.py": """\
+            import utils.metric_names as mn
+            from utils.metric_names import GOOD
+
+            def f(metrics, reason, name):
+                metrics.incr(mn.GOOD)
+                metrics.incr(GOOD)
+                metrics.incr(mn.FAMILY_PREFIX + reason)
+                metrics.incr(mn.DOES_NOT_EXIST)
+                metrics.incr(name)
+            """,
+    }, rules=["metrics-registry"])
+    assert rules_and_lines(findings) == [("metrics-registry", 8),
+                                         ("metrics-registry", 9)]
+
+
+def test_metrics_registry_prefix_strictness(tmp_path):
+    """Prefix/name pools stay disjoint: a bare prefix is not a counter
+    name, a full name is not a prefix, and concatenation requires a
+    *_PREFIX constant (or its literal value) on the left."""
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": METRIC_FIXTURE_REGISTRY,
+        "app.py": """\
+            import utils.metric_names as mn
+
+            def f(metrics, reason):
+                metrics.incr("fam_" + reason)          # literal prefix: ok
+                metrics.incr(mn.FAMILY_PREFIX + reason)
+                metrics.incr(mn.GOOD + reason)          # full name + x: drift
+                metrics.incr("fam_")                    # bare prefix as name
+                metrics.counters_with_prefix("good_metric")  # name as prefix
+            """,
+    }, rules=["metrics-registry"])
+    assert rules_and_lines(findings) == [("metrics-registry", 6),
+                                         ("metrics-registry", 7),
+                                         ("metrics-registry", 8)]
+
+
+def test_metrics_registry_checks_count_shim_sites(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": METRIC_FIXTURE_REGISTRY,
+        "app.py": """\
+            def f(conn):
+                conn._count("good_metric")
+                conn._count("conector_reconects")  # the typo class
+            """,
+    }, rules=["metrics-registry"])
+    assert rules_and_lines(findings) == [("metrics-registry", 3)]
+
+
+def test_metrics_registry_read_sites_and_np_percentile(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": METRIC_FIXTURE_REGISTRY,
+        "app.py": """\
+            import numpy as np
+
+            def f(metrics, ts):
+                metrics.counter("good_metric")
+                metrics.counter("typo_metric")
+                metrics.counters_with_prefix("fam_")
+                return np.percentile(ts, 50)  # not a Metrics read
+            """,
+    }, rules=["metrics-registry"])
+    assert rules_and_lines(findings) == [("metrics-registry", 5)]
+
+
+# ---------------- swallowed-exception ----------------
+
+
+def test_swallowed_exception_positive(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+            try:
+                work()
+            except:
+                return None
+        """, rules=["swallowed-exception"])
+    assert rules_and_lines(findings) == [("swallowed-exception", 4),
+                                         ("swallowed-exception", 8)]
+
+
+def test_swallowed_exception_accounted_forms_pass(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f(metrics, log, q):
+            try:
+                work()
+            except Exception:
+                metrics.incr("errors")
+            try:
+                work()
+            except Exception:
+                raise RuntimeError("wrapped")
+            try:
+                work()
+            except Exception as e:
+                q["error"] = repr(e)  # exception is read -> recorded
+            try:
+                work()
+            except ValueError:
+                pass  # narrow except is out of scope for this rule
+        """, rules=["swallowed-exception"])
+    assert findings == []
+
+
+def test_swallowed_exception_suppression(tmp_path):
+    findings = lint_source(tmp_path, """\
+        def f():
+            try:
+                work()
+            except Exception:  # ocvf-lint: disable=swallowed-exception -- teardown is best-effort by contract
+                pass
+        """, rules=["swallowed-exception"])
+    assert findings == []
+
+
+# ---------------- suppression hygiene ----------------
+
+
+def test_bare_suppression_is_inert_and_flagged(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        class S:
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)  # ocvf-lint: disable=blocking-under-lock
+        """, rules=["blocking-under-lock"])
+    got = rules_and_lines(findings)
+    assert ("suppression", 6) in got          # the bare disable is a finding
+    assert ("blocking-under-lock", 6) in got  # and it suppressed NOTHING
+
+
+def test_short_justification_counts_as_bare(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import time
+
+        class S:
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)  # ocvf-lint: disable=blocking-under-lock -- ok
+        """, rules=["blocking-under-lock"])
+    assert ("suppression", 6) in rules_and_lines(findings)
+
+
+def test_unknown_rule_in_suppression_flagged(tmp_path):
+    findings = lint_source(tmp_path, """\
+        x = 1  # ocvf-lint: disable=no-such-rule -- justification text here
+        """)
+    assert [(f.rule, f.line) for f in findings] == [("suppression", 1)]
+    assert "unknown rule" in findings[0].message
+
+
+def test_disable_file_covers_everything(tmp_path):
+    findings = lint_source(tmp_path, """\
+        # ocvf-lint: disable-file=non-atomic-write -- scratch artifact writer, torn output is harmless
+        def a(p):
+            open(p, "w").write("x")
+
+        def b(p):
+            open(p, "w").write("y")
+        """, rules=["non-atomic-write"])
+    assert findings == []
+
+
+def test_disable_block_covers_whole_statement(tmp_path):
+    findings = lint_source(tmp_path, """\
+        import os
+
+        class S:
+            def f(self, fh):
+                with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- serializing these writes is the purpose of this lock
+                    fh.write(b"a")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                with self._lock:
+                    fh.write(b"b")
+        """, rules=["blocking-under-lock"])
+    assert rules_and_lines(findings) == [("blocking-under-lock", 10)]
+
+
+def test_suppression_meta_rule_cannot_be_suppressed(tmp_path):
+    findings = lint_source(tmp_path, """\
+        x = 1  # ocvf-lint: disable=unknown-thing -- long enough justification ; ocvf-lint: disable=suppression -- nice try
+        """)
+    assert any(f.rule == "suppression" for f in findings)
+
+
+def test_parse_error_is_a_finding_not_a_crash(tmp_path):
+    findings = lint_source(tmp_path, "def broken(:\n")
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+# ---------------- CLI contract ----------------
+
+
+def _cli(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.ocvf_lint", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT
+             + os.pathsep + os.environ.get("PYTHONPATH", "")})
+
+
+def test_cli_exit_0_on_clean(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = _cli(str(clean))
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_exit_1_on_findings_and_json(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text('def f(p):\n    open(p, "w").write("x")\n')
+    proc = _cli("--json", str(bad))
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    assert doc["findings"][0]["rule"] == "non-atomic-write"
+    assert doc["findings"][0]["line"] == 2
+
+
+def test_cli_exit_2_on_internal_error(tmp_path):
+    proc = _cli(str(tmp_path / "does-not-exist"))
+    assert proc.returncode == 2
+
+
+def test_cli_list_rules_names_all_five(tmp_path):
+    proc = _cli("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("lock-order", "blocking-under-lock", "non-atomic-write",
+                 "metrics-registry", "swallowed-exception"):
+        assert rule in proc.stdout
+
+
+# ---------------- the tier-1 gate: the real tree is clean ----------------
+
+
+def test_real_tree_has_zero_findings():
+    """The acceptance bar: ``python -m tools.ocvf_lint
+    opencv_facerecognizer_tpu scripts`` exits 0 at head, with all five
+    rules active and every suppression justified."""
+    proc = _cli("opencv_facerecognizer_tpu", "scripts", "--json")
+    assert proc.returncode == 0, f"lint found issues:\n{proc.stdout}\n{proc.stderr}"
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == []
+    assert set(doc["rules"]) >= {"lock-order", "blocking-under-lock",
+                                 "non-atomic-write", "metrics-registry",
+                                 "swallowed-exception"}
+    assert doc["files_scanned"] > 40
+
+
+def test_real_lock_graph_is_nonempty_and_acyclic():
+    """The static inter-module lock graph over the real runtime must keep
+    seeing the known edges (StateLifecycle -> WAL/journal/gallery/metrics)
+    — if this goes empty the lock-order rule has silently gone blind."""
+    from tools.ocvf_lint.checkers.lock_order import build_lock_graph
+
+    edges = set(build_lock_graph(
+        [os.path.join(REPO_ROOT, "opencv_facerecognizer_tpu")]))
+    assert any(a.endswith("StateLifecycle._enroll_lock") for a, _ in edges)
+    assert any(b.endswith("Metrics._lock") for _, b in edges)
+    inverted = [(a, b) for (a, b) in edges if a != b and (b, a) in edges]
+    assert not inverted
+
+
+# ---------------- metric_names registry sanity ----------------
+
+
+def test_metric_names_registry_no_duplicates():
+    from opencv_facerecognizer_tpu.utils import metric_names as mn
+
+    names = mn.all_names()
+    assert len(names) == len(set(names)), "duplicate metric name values"
+    assert len(names) > 50
+    prefixes = mn.all_prefixes()
+    assert all(p.endswith("_") for p in prefixes)
+    # no full name may collide into a prefix family ambiguously with itself
+    assert len(prefixes) == len(set(prefixes))
+
+
+# ---------------- DebugLock dynamic backstop unit tests ----------------
+
+
+def test_debug_lock_records_edges_and_detects_inversion():
+    from opencv_facerecognizer_tpu.utils.debug_lock import (
+        DebugLock, LockOrderError, LockOrderMonitor)
+
+    monitor = LockOrderMonitor()
+    a = monitor.debug_lock("A")
+    b = monitor.debug_lock("B")
+    with a:
+        with b:
+            pass
+    assert monitor.edges() == {("A", "B")}
+    monitor.check()  # consistent so far
+    with b:
+        with a:
+            pass
+    assert monitor.inversions() == [("A", "B")]
+    with pytest.raises(LockOrderError):
+        monitor.check()
+
+
+def test_debug_lock_re_entry_raises_immediately():
+    from opencv_facerecognizer_tpu.utils.debug_lock import (
+        LockOrderError, LockOrderMonitor)
+
+    monitor = LockOrderMonitor()
+    a = monitor.debug_lock("A")
+    with a:
+        with pytest.raises(LockOrderError):
+            a.acquire()
+
+
+def test_debug_lock_backs_a_condition_variable():
+    from opencv_facerecognizer_tpu.utils.debug_lock import LockOrderMonitor
+
+    monitor = LockOrderMonitor()
+    inner = monitor.debug_lock("CV")
+    cv = threading.Condition(inner)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        hits.append(1)
+        cv.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    monitor.check()

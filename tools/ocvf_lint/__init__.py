@@ -1,0 +1,28 @@
+"""ocvf-lint — AST-based concurrency & durability analysis for the serving
+runtime (stdlib only, no third-party deps).
+
+The serving stack's correctness rests on hand-maintained invariants: lock
+acquisition order, no blocking calls under a held lock, atomic
+tmp+fsync+rename state writes, canonical metric names, and no silently
+swallowed exceptions in supervised threads.  This package checks those
+invariants statically so they scale with the codebase instead of with
+reviewer vigilance.
+
+Usage:  ``python -m tools.ocvf_lint [--json] PATH...``
+
+Exit codes: 0 clean, 1 findings, 2 internal error.
+
+Suppressions (justification after ``--`` is mandatory — a bare disable is
+itself a finding and suppresses nothing):
+
+    some_call()  # ocvf-lint: disable=blocking-under-lock -- WAL ack==durable
+    with lock:  # ocvf-lint: disable-block=blocking-under-lock -- whole block
+    # ocvf-lint: disable-file=non-atomic-write -- bench report, torn ok
+"""
+
+from tools.ocvf_lint.core import (  # noqa: F401
+    Checker,
+    Finding,
+    REGISTRY,
+    run,
+)

@@ -1,0 +1,73 @@
+"""CLI: ``python -m tools.ocvf_lint [--json] [--rules a,b] PATH...``
+
+Exit codes (stable, scripted against by scripts/run_lint.sh and CI):
+  0 — clean (no findings)
+  1 — findings reported
+  2 — internal error (bad invocation, crash in the linter itself)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import traceback
+
+from tools.ocvf_lint import core
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.ocvf_lint",
+        description="AST-based concurrency & durability lint for the "
+                    "opencv_facerecognizer_tpu serving runtime.")
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output on stdout")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print registered rules and exit 0")
+    args = parser.parse_args(argv)
+
+    try:
+        core._load_builtin_checkers()
+        if args.list_rules:
+            for rule in sorted(core.REGISTRY):
+                print(f"{rule}: {core.REGISTRY[rule].description}")
+            return 0
+        if not args.paths:
+            parser.error("no paths given (or use --list-rules)")
+        rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
+        if rules:
+            unknown = [r for r in rules if r not in core.REGISTRY]
+            if unknown:
+                print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+                return 2
+        result = core.run(args.paths, rules=rules)
+    except SystemExit:
+        raise
+    except FileNotFoundError as exc:
+        print(f"ocvf-lint: {exc}", file=sys.stderr)
+        return 2
+    except Exception:  # noqa: BLE001 — any linter crash is exit 2 by contract
+        traceback.print_exc()
+        return 2
+
+    if args.json:
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+    else:
+        for finding in result.findings:
+            print(finding.format())
+            for path, line in finding.also:
+                print(f"    also involves {path}:{line}")
+        print(f"ocvf-lint: {len(result.findings)} finding(s) in "
+              f"{result.files_scanned} file(s) scanned "
+              f"({result.suppressions_used} justified suppression(s) honored; "
+              f"rules: {', '.join(result.rules)})",
+              file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

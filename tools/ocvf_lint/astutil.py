@@ -1,0 +1,105 @@
+"""Shared AST helpers: recognizing lock acquisitions and walking statement
+bodies with the lexically-held lock stack."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+#: An attribute/name is treated as a lock (or condition variable — acquiring
+#: one acquires its underlying lock) when it matches this.  Covers the
+#: runtime's ``_lock``/``_write_lock``/``_enroll_lock``/``_reject_lock``,
+#: bare ``lock``, and the CV names ``_cv``/``_cond``/``_not_empty``.
+LOCK_NAME_RE = re.compile(r"lock|mutex|(^|_)(cv|cond|not_empty)$")
+
+
+def lock_attr_name(expr: ast.expr) -> Optional[str]:
+    """The lock-ish terminal name of ``expr``, or None if it doesn't look
+    like a lock."""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    else:
+        return None
+    return name if LOCK_NAME_RE.search(name) else None
+
+
+def lock_base_is_self(expr: ast.expr) -> bool:
+    return (isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self")
+
+
+def with_lock_items(node: ast.stmt) -> List[Tuple[ast.expr, str]]:
+    """The ``(expr, lock_name)`` pairs of a With/AsyncWith statement's items
+    that look like lock acquisitions (``with self._lock:``, ``with lock:``).
+    Calls like ``with open(...)`` never match."""
+    if not isinstance(node, (ast.With, ast.AsyncWith)):
+        return []
+    out = []
+    for item in node.items:
+        name = lock_attr_name(item.context_expr)
+        if name is not None:
+            out.append((item.context_expr, name))
+    return out
+
+
+def walk_with_lock_stack(body: List[ast.stmt],
+                         stack: Tuple[str, ...] = (),
+                         ) -> Iterator[Tuple[ast.AST, Tuple[str, ...]]]:
+    """Yield ``(node, held_lock_names)`` for every expression-level node,
+    tracking the lexical ``with <lock>`` nesting.  Nested function/lambda
+    bodies restart with an empty stack — code defined under a lock does not
+    *run* under it."""
+    for stmt in body:
+        yield from _walk_stmt(stmt, stack)
+
+
+def _walk_stmt(node: ast.AST, stack: Tuple[str, ...]):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        yield node, stack
+        yield from walk_with_lock_stack(node.body, ())
+        return
+    locks = with_lock_items(node) if isinstance(node, (ast.With, ast.AsyncWith)) else []
+    if locks:
+        yield node, stack
+        inner = stack + tuple(name for _, name in locks)
+        for child in node.body:
+            yield from _walk_stmt(child, inner)
+        return
+    yield node, stack
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.Lambda):
+            yield child, stack
+            yield from _walk_stmt(child.body, ())
+        elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _walk_stmt(child, stack)
+        elif isinstance(child, ast.stmt):
+            yield from _walk_stmt(child, stack)
+        else:
+            yield from _walk_expr(child, stack)
+
+
+def _walk_expr(node: ast.AST, stack: Tuple[str, ...]):
+    yield node, stack
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, ast.Lambda):
+            yield child, stack
+            yield from _walk_stmt(child.body, ())
+        else:
+            yield from _walk_expr(child, stack)
+
+
+def dotted_call_name(func: ast.expr) -> Optional[str]:
+    """``a.b.c`` for an Attribute chain of Names, else None."""
+    parts: List[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None

@@ -1,0 +1,9 @@
+"""Importing this package registers every built-in checker."""
+
+from tools.ocvf_lint.checkers import (  # noqa: F401
+    blocking_under_lock,
+    lock_order,
+    metrics_registry,
+    non_atomic_write,
+    swallowed_exception,
+)

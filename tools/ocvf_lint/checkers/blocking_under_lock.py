@@ -1,0 +1,58 @@
+"""blocking-under-lock: a call that can block the thread (sleep, file or
+socket I/O, fsync, JAX device sync) made while a lock is lexically held.
+
+A blocking call under a lock turns every other thread contending for that
+lock into a convoy — the PR-2 overlap work exists precisely so the serving
+loop never sleeps while holding shared state.  Sites where holding the lock
+through the I/O *is* the invariant (the WAL's fsync-before-ack, a transport
+lock that exists to serialize stream writes) carry justified suppressions —
+that audit trail is the point of the rule."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ocvf_lint import astutil
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+#: Attribute names whose call plausibly blocks (``x.sleep(...)``,
+#: ``fh.write(...)``, ``sock.recv(...)``, ``arr.block_until_ready()``).
+BLOCKING_ATTRS = frozenset({
+    "sleep", "fsync", "recv", "recv_into", "recvfrom", "sendall", "send",
+    "accept", "connect", "select", "block_until_ready", "device_get",
+    "write", "flush", "read", "readline", "readlines",
+})
+
+#: Bare-name calls that block.
+BLOCKING_NAMES = frozenset({"open", "sleep", "fsync_directory", "input"})
+
+
+@register
+class BlockingUnderLockChecker(Checker):
+    rule = "blocking-under-lock"
+    description = ("time.sleep / file or socket I/O / fsync / JAX dispatch "
+                   "inside a held-lock region")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node, stack in astutil.walk_with_lock_stack(ctx.tree.body):
+            if not stack or not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = None
+            if isinstance(func, ast.Attribute) and func.attr in BLOCKING_ATTRS:
+                # str.join-style noise guard: skip attribute calls whose base
+                # is a string/bytes literal.
+                if isinstance(func.value, ast.Constant):
+                    continue
+                name = astutil.dotted_call_name(func) or f"<expr>.{func.attr}"
+            elif isinstance(func, ast.Name) and func.id in BLOCKING_NAMES:
+                name = func.id
+            if name is not None:
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"potentially blocking call {name}() while holding "
+                    f"{stack[-1]!r} (locks held: {', '.join(stack)}) — move the "
+                    f"I/O outside the lock or justify with a suppression"))
+        return findings

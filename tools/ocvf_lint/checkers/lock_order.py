@@ -1,0 +1,368 @@
+"""lock-order: build the inter-module lock-acquisition graph and flag
+cycles (inversions) and nested re-acquisition of the same lock.
+
+Edges come from two sources:
+
+1. **Lexical nesting** — ``with self._a: ... with self._b:`` adds a->b.
+2. **Calls under a lock** — a call made while holding lock ``a`` to a
+   callable that (transitively, bounded depth) acquires lock ``b`` adds
+   a->b.  Callees are resolved heuristically: ``self.m()`` through the
+   class and its project-local bases, bare ``f()`` through the module, and
+   ``<...>.attr.m()`` through ``ATTR_HINTS`` (the runtime's known wiring:
+   ``self.metrics`` is a ``utils.metrics.Metrics``, etc.), which is what
+   makes the graph *inter-module*.
+
+Lock identity is ``module.Class.attr`` for ``self._lock`` and
+``module[.func].name`` otherwise — instances of one class share a node,
+which over-approximates (two distinct FrameBatcher instances cannot
+deadlock each other) but is the right conservatism for a discipline
+checker.  A two-node cycle is the classic AB/BA inversion; any larger SCC
+is reported once with every participating edge site."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.ocvf_lint import astutil
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+#: Known wiring of ``self.<attr>`` (or any ``x.<attr>``) to the class whose
+#: methods it dispatches to — the cross-module edges of the serving stack.
+ATTR_HINTS: Dict[str, str] = {
+    "metrics": "Metrics",
+    "batcher": "FrameBatcher",
+    "gallery": "ShardedGallery",
+    "journal": "DeadLetterJournal",
+    "drop_log": "DeadLetterJournal",
+    "wal": "EnrollmentWAL",
+    "state": "StateLifecycle",
+    "state_store": "StateLifecycle",
+    "checkpoints": "CheckpointStore",
+    "admission": "AdmissionController",
+    "connector": "JSONLConnector",
+}
+
+_CALL_DEPTH = 4
+
+
+@dataclasses.dataclass
+class CallableInfo:
+    module: str
+    cls: Optional[str]
+    name: str
+    #: (lock_id, line, lock-ids held when acquiring)
+    acquisitions: List[Tuple[str, int, Tuple[str, ...]]]
+    #: (descriptor, lock-ids held at the call, line)
+    calls: List[Tuple[Tuple[str, ...], Tuple[str, ...], int]]
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: Tuple[str, ...]
+    methods: Dict[str, CallableInfo]
+
+
+@register
+class LockOrderChecker(Checker):
+    rule = "lock-order"
+    description = ("inter-module lock-acquisition graph cycles/inversions "
+                   "and nested same-lock re-acquisition")
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, List[ClassInfo]] = {}  # class name -> defs
+        self.functions: Dict[Tuple[str, str], CallableInfo] = {}
+        self.callables: List[CallableInfo] = []
+
+    # ---------------- collection ----------------
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        self._module_paths[ctx.module] = ctx.path
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                info = ClassInfo(
+                    module=ctx.module, name=stmt.name,
+                    bases=tuple(b.id for b in stmt.bases if isinstance(b, ast.Name)),
+                    methods={})
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        ci = self._collect(ctx, sub, cls=stmt.name)
+                        info.methods[sub.name] = ci
+                self.classes.setdefault(stmt.name, []).append(info)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci = self._collect(ctx, stmt, cls=None)
+                self.functions[(ctx.module, stmt.name)] = ci
+        return []
+
+    def _lock_id(self, ctx: FileContext, cls: Optional[str], fn: str,
+                 expr: ast.expr, name: str) -> str:
+        if astutil.lock_base_is_self(expr) and cls is not None:
+            return f"{ctx.module}.{cls}.{name}"
+        if isinstance(expr, ast.Name):
+            return f"{ctx.module}.{name}"
+        # non-self attribute chain (rare): qualify by terminal attr only
+        return f"{ctx.module}.{fn}.{name}"
+
+    def _collect(self, ctx: FileContext, fn: ast.AST,
+                 cls: Optional[str]) -> CallableInfo:
+        info = CallableInfo(module=ctx.module, cls=cls, name=fn.name,
+                            acquisitions=[], calls=[])
+        self.callables.append(info)
+        self._walk(ctx, cls, fn, fn.body, (), info)
+        return info
+
+    def _walk(self, ctx, cls, fn, body, stack, info) -> None:
+        """Like astutil.walk_with_lock_stack but tracking lock *ids* (not
+        just names) and recording acquisitions/calls on ``info``."""
+        for stmt in body:
+            self._walk_node(ctx, cls, fn, stmt, stack, info)
+
+    def _walk_node(self, ctx, cls, fn, node, stack, info) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # Nested definitions run later, with no locks lexically held.
+            # They are not independently callable by name here, so fold their
+            # acquisitions into the enclosing callable with an empty stack —
+            # transitive call analysis still sees them.
+            body = node.body if isinstance(node.body, list) else [ast.Expr(node.body)]
+            self._walk(ctx, cls, fn, body, (), info)
+            return
+        locks = astutil.with_lock_items(node)
+        if locks:
+            ids = []
+            for expr, name in locks:
+                lock_id = self._lock_id(ctx, cls, fn.name, expr, name)
+                info.acquisitions.append((lock_id, node.lineno, stack))
+                ids.append(lock_id)
+            inner_stack = stack + tuple(ids)
+            for child in node.body:
+                self._walk_node(ctx, cls, fn, child, inner_stack, info)
+            return
+        if isinstance(node, ast.Call):
+            desc = self._call_descriptor(node)
+            if desc is not None:
+                info.calls.append((desc, stack, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(ctx, cls, fn, child, stack, info)
+
+    @staticmethod
+    def _call_descriptor(node: ast.Call) -> Optional[Tuple[str, ...]]:
+        func = node.func
+        if isinstance(func, ast.Name):
+            return ("func", func.id)
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                return ("self", func.attr)
+            # terminal attribute before the method: self.pipeline.gallery.m()
+            # -> ("attr", "gallery", "m"); plain name base works too.
+            if isinstance(base, ast.Attribute):
+                return ("attr", base.attr, func.attr)
+            if isinstance(base, ast.Name):
+                return ("attr", base.id, func.attr)
+        return None
+
+    # ---------------- resolution ----------------
+
+    def _resolve_method(self, cls_name: str, method: str, module: str,
+                        _seen=None) -> Optional[CallableInfo]:
+        if _seen is None:
+            _seen = set()
+        if cls_name in _seen:
+            return None
+        _seen.add(cls_name)
+        defs = self.classes.get(cls_name, [])
+        ordered = sorted(defs, key=lambda c: c.module != module)
+        for cdef in ordered:
+            if method in cdef.methods:
+                return cdef.methods[method]
+        for cdef in ordered:
+            for base in cdef.bases:
+                found = self._resolve_method(base, method, module, _seen)
+                if found is not None:
+                    return found
+        return None
+
+    def _resolve(self, desc: Tuple[str, ...], caller: CallableInfo
+                 ) -> Optional[CallableInfo]:
+        kind = desc[0]
+        if kind == "self" and caller.cls is not None:
+            return self._resolve_method(caller.cls, desc[1], caller.module)
+        if kind == "func":
+            return self.functions.get((caller.module, desc[1]))
+        if kind == "attr":
+            hint = ATTR_HINTS.get(desc[1])
+            if hint is not None:
+                return self._resolve_method(hint, desc[2], caller.module)
+        return None
+
+    def _locks_acquired(self, info: CallableInfo, depth: int,
+                        seen: Set[int]) -> Set[str]:
+        if id(info) in seen or depth <= 0:
+            return set()
+        seen.add(id(info))
+        out = {lock for lock, _, _ in info.acquisitions}
+        for desc, _, _ in info.calls:
+            target = self._resolve(desc, info)
+            if target is not None:
+                out |= self._locks_acquired(target, depth - 1, seen)
+        return out
+
+    # ---------------- graph + findings ----------------
+
+    def derive_edges(self) -> Dict[Tuple[str, str],
+                                   List[Tuple[str, int, str]]]:
+        """The (held, acquired) -> [(module, line, note)] edge map — the ONE
+        derivation, shared by ``finalize`` (findings) and
+        ``build_lock_graph`` (the DebugLock backstop's cross-check), so the
+        graph the tests validate can never diverge from the graph the
+        linter enforces."""
+        edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+
+        def add_edge(a: str, b: str, info: CallableInfo, line: int, note: str):
+            edges.setdefault((a, b), []).append((info.module, line, note))
+
+        for info in self.callables:
+            for lock, line, stack in info.acquisitions:
+                if stack:
+                    add_edge(stack[-1], lock, info, line,
+                             f"nested with in {info.qualname()}")
+            for desc, stack, line in info.calls:
+                if not stack:
+                    continue
+                target = self._resolve(desc, info)
+                if target is None:
+                    continue
+                for lock in self._locks_acquired(target, _CALL_DEPTH, set()):
+                    add_edge(stack[-1], lock, info, line,
+                             f"call to {target.qualname()} from {info.qualname()}")
+        return edges
+
+    def finalize(self) -> List[Finding]:
+        edges = self.derive_edges()
+        findings: List[Finding] = []
+
+        # self-loops: nested or indirect re-acquisition of one lock
+        for (a, b), elist in sorted(edges.items()):
+            if a == b:
+                mod, line, note = elist[0]
+                findings.append(Finding(
+                    self.rule, self._path_for(mod), line, 0,
+                    f"lock {a} may be re-acquired while already held "
+                    f"({note}) — deadlock unless it is an RLock",
+                    also=tuple((self._path_for(m), l) for m, l, _ in elist[1:])))
+
+        # inversions: SCCs of size >= 2 in the directed graph
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            if a != b:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        for scc in _tarjan(graph):
+            if len(scc) < 2:
+                continue
+            scc_set = set(scc)
+            cycle_edges = sorted((a, b) for (a, b) in edges
+                                 if a in scc_set and b in scc_set and a != b)
+            all_sites = [s for e in cycle_edges for s in edges[e]]
+            mod, line, _ = all_sites[0]
+            detail = "; ".join(
+                f"{a} -> {b} at {self._path_for(edges[(a, b)][0][0])}:"
+                f"{edges[(a, b)][0][1]} ({edges[(a, b)][0][2]})"
+                for a, b in cycle_edges)
+            findings.append(Finding(
+                self.rule, self._path_for(mod), line, 0,
+                f"lock-order inversion among {{{', '.join(sorted(scc_set))}}}: "
+                f"{detail}",
+                also=tuple((self._path_for(m), l) for m, l, _ in all_sites[1:])))
+        return findings
+
+    def _path_for(self, module: str) -> str:
+        return self._module_paths.get(module, module)
+
+    # module -> path bookkeeping, filled lazily by check_file
+    @property
+    def _module_paths(self) -> Dict[str, str]:
+        paths = getattr(self, "_module_paths_cache", None)
+        if paths is None:
+            paths = {}
+            self._module_paths_cache = paths
+        return paths
+
+
+def _tarjan(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC."""
+    index_counter = [0]
+    index: Dict[str, int] = {}
+    lowlink: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+
+    for root in sorted(graph):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(graph.get(root, ()))))]
+        index[root] = lowlink[root] = index_counter[0]
+        index_counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = lowlink[succ] = index_counter[0]
+                    index_counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(sorted(graph.get(succ, ())))))
+                    advanced = True
+                    break
+                elif succ in on_stack:
+                    lowlink[node] = min(lowlink[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+            if lowlink[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+    return sccs
+
+
+def _qualname(self: CallableInfo) -> str:
+    return (f"{self.module}.{self.cls}.{self.name}" if self.cls
+            else f"{self.module}.{self.name}")
+
+
+CallableInfo.qualname = _qualname
+
+
+def build_lock_graph(paths) -> Dict[Tuple[str, str], List[Tuple[str, int, str]]]:
+    """Standalone API: the (a, b) -> sites edge map for ``paths``.  Used by
+    the DebugLock dynamic backstop in tests to cross-check observed
+    acquisition order against the static graph.  Same derivation as the
+    lock-order rule itself (``derive_edges``)."""
+    from tools.ocvf_lint import core as _core
+
+    checker = LockOrderChecker()
+    for path in _core.iter_py_files(paths):
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue
+        checker.check_file(_core.FileContext(path, source, tree))
+    return checker.derive_edges()

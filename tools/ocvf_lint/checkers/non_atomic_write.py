@@ -1,0 +1,78 @@
+"""non-atomic-write: opening a file for (truncating) write outside the
+designated durability layers.
+
+A bare ``open(path, "w")`` + write can leave a torn half-file after a crash
+— the seed's checkpointing bug.  All durable state must flow through
+``utils/serialization.py`` (``atomic_write_bytes``/``atomic_write_text``,
+tmp+fsync+rename) or ``runtime/state_store.py``; those two files are the
+only ones allowed to open for write.  Deliberate non-durable writes (a
+chaos script injecting corruption, a throwaway debug dump) carry justified
+suppressions."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+#: The durability layers themselves — the helpers everyone else must use.
+EXEMPT_SUFFIXES = (
+    "utils/serialization.py",
+    "runtime/state_store.py",
+)
+
+WRITE_ATTRS = frozenset({"write_text", "write_bytes"})
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The mode string if this ``open()`` call truncates/creates, else None.
+    Append mode ('a') is journal-style and exempt by design."""
+    mode_node: Optional[ast.expr] = None
+    if len(call.args) >= 2:
+        mode_node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None  # default 'r'
+    if isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str):
+        mode = mode_node.value
+        if "w" in mode or "x" in mode:
+            return mode
+        return None
+    return "<dynamic>"  # non-literal mode: flag it, prove it or suppress
+
+
+@register
+class NonAtomicWriteChecker(Checker):
+    rule = "non-atomic-write"
+    description = ("open(..., 'w')-style truncating writes outside the "
+                   "atomic tmp+fsync+rename helpers in utils/serialization "
+                   "and runtime/state_store")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        norm = ctx.path.replace("\\", "/")
+        if any(norm.endswith(suffix) for suffix in EXEMPT_SUFFIXES):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "open":
+                mode = _write_mode(node)
+                if mode is not None:
+                    findings.append(ctx.finding(
+                        self.rule, node,
+                        f"open(..., {mode!r}) writes non-atomically — a crash "
+                        f"can leave a torn file; use "
+                        f"utils.serialization.atomic_write_bytes/"
+                        f"atomic_write_text (or suppress with justification "
+                        f"if a torn file is genuinely harmless)"))
+            elif isinstance(func, ast.Attribute) and func.attr in WRITE_ATTRS:
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"Path.{func.attr}() writes non-atomically — use "
+                    f"utils.serialization.atomic_write_bytes/atomic_write_text"))
+        return findings

@@ -1,0 +1,95 @@
+"""swallowed-exception: a broad ``except`` that makes the failure invisible.
+
+The serving stack runs supervised threads whose loop bodies catch
+``Exception`` by design — that is fine *as long as the failure is
+accounted for*: re-raised, counted on the shared Metrics surface,
+dead-lettered/journaled, logged, or at minimum the caught exception object
+is actually read (stored into a status dict, formatted into an
+announcement).  A handler that does none of those turns a real fault into
+silence; under chaos soak that is the difference between an exact ledger
+and an unexplainable wedge.
+
+A handler passes if ANY of:
+- it re-raises (bare ``raise`` or ``raise X``),
+- it calls an accounting sink: ``*.incr/observe/log/warning/error/
+  exception/critical/dead_letter/_dead_letter/record*`` or ``print``,
+- it binds the exception (``as e``) and reads it somewhere in the body.
+
+Intentional best-effort swallows (teardown paths) carry justified
+suppressions."""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+BROAD_NAMES = frozenset({"Exception", "BaseException"})
+
+ACCOUNTING_ATTRS = frozenset({
+    "incr", "observe", "set_gauge", "log", "warning", "error", "exception",
+    "critical", "dead_letter", "_dead_letter", "record", "record_drop",
+    "_count",      # the connectors' metrics shim (None-guarded incr)
+    "put_nowait",  # pushing the failure onto a result/status queue
+})
+ACCOUNTING_NAMES = frozenset({"print"})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in BROAD_NAMES
+    if isinstance(t, ast.Attribute):
+        return t.attr in BROAD_NAMES
+    if isinstance(t, ast.Tuple):
+        return any(_is_broad(ast.ExceptHandler(type=e, name=None, body=[]))
+                   for e in t.elts)
+    return False
+
+
+def _accounts(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in ACCOUNTING_ATTRS:
+                return True
+            if isinstance(func, ast.Name) and func.id in ACCOUNTING_NAMES:
+                return True
+    if handler.name:
+        for node in ast.walk(ast.Module(body=handler.body, type_ignores=[])):
+            if isinstance(node, ast.Name) and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+    return False
+
+
+@register
+class SwallowedExceptionChecker(Checker):
+    rule = "swallowed-exception"
+    description = ("broad except that neither re-raises, counts, "
+                   "dead-letters, logs, nor reads the caught exception")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if _accounts(node):
+                continue
+            caught = "bare except" if node.type is None else \
+                f"except {ast.unparse(node.type)}" if hasattr(ast, "unparse") \
+                else "broad except"
+            findings.append(ctx.finding(
+                self.rule, node,
+                f"{caught} swallows the failure silently — re-raise, count it "
+                f"on Metrics, dead-letter it, or read the exception into a "
+                f"status; if best-effort-by-design, suppress with a "
+                f"justification"))
+        return findings

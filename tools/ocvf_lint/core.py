@@ -1,0 +1,302 @@
+"""Framework core: findings, the checker registry, suppression parsing and
+the run loop.  Checkers live in ``tools.ocvf_lint.checkers`` and register
+themselves via the ``@register`` decorator; everything here is
+checker-agnostic."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: ``# ocvf-lint: disable=rule1,rule2 -- justification``  (line-level; covers
+#: the comment's own line and the line directly below it, so it works both
+#: trailing the offending statement and on its own line above it),
+#: ``# ocvf-lint: disable-block=rule -- justification`` (covers the innermost
+#: statement enclosing the comment — put it on a ``with`` header to cover the
+#: whole block), or
+#: ``# ocvf-lint: disable-file=rule -- justification`` (whole file).
+SUPPRESS_RE = re.compile(
+    r"#\s*ocvf-lint:\s*(?P<kind>disable-file|disable-block|disable)\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,-]+)"
+    r"(?:\s*--\s*(?P<why>.*\S))?"
+)
+
+#: A justification shorter than this is treated as absent — "ok" or "x" is
+#: not an explanation the next reader can act on.
+MIN_JUSTIFICATION = 8
+
+#: The meta-rule enforcing suppression hygiene; never itself suppressible.
+SUPPRESSION_RULE = "suppression"
+
+#: Files that fail ``ast.parse`` get a finding under this rule.
+PARSE_RULE = "parse-error"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete file:line.
+
+    ``also`` lists additional participating sites (e.g. the other edges of a
+    lock-order cycle); a suppression at any of them silences the finding."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    also: Tuple[Tuple[str, int], ...] = ()
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        out = {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+        if self.also:
+            out["also"] = [{"path": p, "line": l} for p, l in self.also]
+        return out
+
+
+@dataclasses.dataclass
+class Suppression:
+    rules: Tuple[str, ...]
+    line: int
+    kind: str  # "disable" | "disable-block" | "disable-file"
+    justification: str
+    #: inclusive line span this suppression covers (block spans are resolved
+    #: against the AST once the file parses; file-level covers everything)
+    start: int = 0
+    end: int = 0
+    used: bool = False
+
+    @property
+    def file_level(self) -> bool:
+        return self.kind == "disable-file"
+
+    @property
+    def justified(self) -> bool:
+        return len(self.justification.strip()) >= MIN_JUSTIFICATION
+
+    def covers(self, line: int) -> bool:
+        return self.file_level or self.start <= line <= self.end
+
+
+class FileContext:
+    """Everything a checker needs about one source file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.module = module_name(path)
+
+    def finding(self, rule: str, node: ast.AST, message: str,
+                also: Tuple[Tuple[str, int], ...] = ()) -> Finding:
+        return Finding(rule, self.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message, also)
+
+
+class Checker:
+    """Base checker.  ``check_file`` runs once per file; ``finalize`` runs
+    after every file has been seen (for project-wide rules like the lock
+    graph)."""
+
+    rule: str = ""
+    description: str = ""
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        return []
+
+    def finalize(self) -> List[Finding]:
+        return []
+
+
+REGISTRY: Dict[str, type] = {}
+
+
+def register(cls: type) -> type:
+    if not cls.rule:
+        raise ValueError(f"checker {cls.__name__} has no rule name")
+    if cls.rule in REGISTRY:
+        raise ValueError(f"duplicate checker rule {cls.rule!r}")
+    REGISTRY[cls.rule] = cls
+    return cls
+
+
+def module_name(path: str) -> str:
+    """Stable dotted module id from a file path: strip ``.py`` and anchor at
+    the package directory when present, so relative and absolute paths map
+    to the SAME id — ``/any/checkout/opencv_facerecognizer_tpu/runtime/
+    batcher.py`` and ``opencv_facerecognizer_tpu/runtime/batcher.py`` both
+    become ``runtime.batcher``.  (The dynamic DebugLock cross-check names
+    its locks with these ids; a checkout-dir-dependent prefix would silently
+    disconnect the two graphs.)  Outside the package, the last components
+    are used as-is."""
+    parts = os.path.normpath(path).replace("\\", "/").split("/")
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if "opencv_facerecognizer_tpu" in parts:
+        anchor = len(parts) - 1 - parts[::-1].index("opencv_facerecognizer_tpu")
+        parts = parts[anchor + 1:]
+    parts = [p for p in parts if p not in ("", ".", "..")]
+    return ".".join(parts[-3:]) if parts else "<unknown>"
+
+
+def parse_suppressions(source: str) -> List[Suppression]:
+    out: List[Suppression] = []
+    reader = io.StringIO(source).readline
+    try:
+        tokens = tokenize.generate_tokens(reader)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group("rules").split(",") if r.strip())
+            line = tok.start[0]
+            out.append(Suppression(
+                rules=rules,
+                line=line,
+                kind=m.group("kind"),
+                justification=m.group("why") or "",
+                start=line,
+                end=line + 1,  # block spans widened once the AST is known
+            ))
+    except tokenize.TokenError:
+        pass  # a finding for the parse failure is emitted separately
+    return out
+
+
+def _enclosing_stmt_span(tree: ast.Module, line: int) -> Tuple[int, int]:
+    """Inclusive line span of the innermost statement whose extent contains
+    ``line`` — how ``disable-block`` suppressions resolve their coverage."""
+    best: Optional[Tuple[int, int]] = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", None)
+        if end is None or not (node.lineno <= line <= end):
+            continue
+        if best is None or (end - node.lineno) < (best[1] - best[0]):
+            best = (node.lineno, end)
+    return best if best is not None else (line, line + 1)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    files: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                files.append(path)
+        elif os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".") and d != "__pycache__")
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(root, name))
+        # nonexistent paths are reported by the caller
+    return files
+
+
+@dataclasses.dataclass
+class RunResult:
+    findings: List[Finding]
+    files_scanned: int
+    rules: List[str]
+    suppressions_used: int
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "files_scanned": self.files_scanned,
+            "rules": self.rules,
+            "suppressions_used": self.suppressions_used,
+        }
+
+
+def _load_builtin_checkers() -> None:
+    from tools.ocvf_lint import checkers  # noqa: F401 — import registers
+
+
+def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResult:
+    """Lint every ``.py`` file under ``paths``.  Returns all unsuppressed
+    findings, sorted by (path, line)."""
+    _load_builtin_checkers()
+    selected = sorted(REGISTRY) if rules is None else [r for r in sorted(REGISTRY)
+                                                      if r in set(rules)]
+    checkers = [REGISTRY[name]() for name in selected]
+
+    for path in paths:
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"lint path does not exist: {path}")
+
+    findings: List[Finding] = []
+    suppressions: Dict[str, List[Suppression]] = {}
+    contexts: List[FileContext] = []
+    files = iter_py_files(paths)
+    for path in files:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            source = fh.read()
+        suppressions[path] = parse_suppressions(source)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as exc:
+            findings.append(Finding(PARSE_RULE, path, exc.lineno or 1,
+                                    exc.offset or 0, f"file does not parse: {exc.msg}"))
+            continue
+        for s in suppressions[path]:
+            if s.kind == "disable-block":
+                s.start, s.end = _enclosing_stmt_span(tree, s.line)
+        contexts.append(FileContext(path, source, tree))
+
+    for checker in checkers:
+        for ctx in contexts:
+            findings.extend(checker.check_file(ctx))
+        findings.extend(checker.finalize())
+
+    # Suppression hygiene: a disable without justification is a finding in
+    # its own right, and suppresses nothing.  Unknown rule names are typos.
+    known = set(REGISTRY) | {PARSE_RULE}
+    for path, supps in suppressions.items():
+        for s in supps:
+            if not s.justified:
+                findings.append(Finding(
+                    SUPPRESSION_RULE, path, s.line, 0,
+                    f"suppression for {','.join(s.rules)} lacks a justification "
+                    f"(append ' -- <why this is safe>'); it is ignored"))
+            for r in s.rules:
+                if r not in known:
+                    findings.append(Finding(
+                        SUPPRESSION_RULE, path, s.line, 0,
+                        f"suppression names unknown rule {r!r} "
+                        f"(known: {', '.join(sorted(known))})"))
+
+    def suppressed(f: Finding) -> bool:
+        if f.rule == SUPPRESSION_RULE:
+            return False
+        for path, line in ((f.path, f.line),) + f.also:
+            for s in suppressions.get(path, ()):
+                if not s.justified or f.rule not in s.rules:
+                    continue
+                if s.covers(line):
+                    s.used = True
+                    return True
+        return False
+
+    kept = [f for f in findings if not suppressed(f)]
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    used = sum(1 for supps in suppressions.values() for s in supps if s.used)
+    return RunResult(findings=kept, files_scanned=len(files),
+                     rules=selected, suppressions_used=used)
