@@ -103,6 +103,27 @@ def build_parser() -> argparse.ArgumentParser:
                         "(measured, BENCH_DETAIL.json:gallery_dtype), "
                         "numerically identical — both matchers compute "
                         "bf16 x bf16 -> f32 regardless of storage")
+    # ---- large-gallery matching (parallel.quantizer / ops.ivf_match;
+    # README "Large-gallery matching") ----
+    p.add_argument("--match-mode", choices=["auto", "exact", "ivf"],
+                   default="auto",
+                   help="gallery matcher selection. auto (default): exact "
+                        "scan below the IVF capacity threshold (262k "
+                        "rows), two-stage IVF shortlist + exact rerank "
+                        "above it; exact: always brute-force; ivf: "
+                        "two-stage whenever the quantizer is trained "
+                        "(falls back to exact until then). The exact "
+                        "scan is linear in gallery size — million-"
+                        "identity galleries need ivf/auto")
+    p.add_argument("--ivf-nlist", type=int, default=0,
+                   help="k-means cell count of the IVF coarse quantizer; "
+                        "0 = auto (~4*sqrt(capacity), power of two). More "
+                        "cells = smaller rerank buckets but a costlier "
+                        "stage-1 scan and retrain")
+    p.add_argument("--ivf-nprobe", type=int, default=8,
+                   help="shortlisted cells per query: the recall-vs-"
+                        "latency knob (each probe adds one cell's rows "
+                        "to the exact rerank bucket)")
     p.add_argument("--async-grow", action="store_true",
                    help="gallery auto-grow compiles + installs the next "
                         "tier on a background thread: overflowing "
@@ -210,6 +231,10 @@ def _load_stack(args):
     if args.fused_embedder and args.parallel == "pp":
         raise SystemExit("--fused-embedder applies to --parallel fused only "
                          "(stage-B meshes aren't single-device)")
+    if args.match_mode == "ivf" and args.parallel == "pp":
+        raise SystemExit("--match-mode ivf applies to --parallel fused only "
+                         "(the two-stage path is single-device, like the "
+                         "pallas streaming matcher)")
 
     serialization.register(CNNEmbedding)
     model = serialization.load_model(args.model)
@@ -254,6 +279,35 @@ def _load_stack(args):
                                           if args.gallery_dtype == "bf16"
                                           else jnp.float32))
     gallery.add(emb, labels)
+    if args.match_mode == "ivf" and gallery_mesh.size > 1:
+        # Fail fast, like the pp guard above: the two-stage path is
+        # single-device (GSPMD cannot partition the bucket gather +
+        # pallas rerank), and silently serving the linear exact scan
+        # under an explicit --match-mode ivf would blow the very
+        # deadlines the flag exists to protect.
+        raise SystemExit("--match-mode ivf requires a single-device mesh "
+                         f"(got {gallery_mesh.size} devices); use "
+                         "--match-mode auto/exact on this host")
+    if (args.match_mode != "exact" and mesh_a is None
+            and gallery_mesh.size == 1):
+        # Attach the IVF coarse quantizer AFTER the startup enrolment:
+        # pre-build incremental assignment is a no-op, and attaching late
+        # keeps the one explicit startup build (main(), post state
+        # recovery) from racing an add-triggered background one.
+        from opencv_facerecognizer_tpu.parallel.quantizer import CoarseQuantizer
+
+        gallery.attach_quantizer(
+            CoarseQuantizer(
+                nlist=(args.ivf_nlist
+                       or CoarseQuantizer.default_nlist(gallery.capacity)),
+                nprobe=args.ivf_nprobe,
+                # --ivf-nlist 0: re-derive the cell count from the actual
+                # row set at every (re)build — state recovery or runtime
+                # growth must not freeze the startup capacity guess.
+                auto_nlist=not args.ivf_nlist,
+            ),
+            mode=args.match_mode,
+        )
     if mesh_a is not None:
         from opencv_facerecognizer_tpu.parallel import TwoStagePipeline
 
@@ -290,6 +344,9 @@ def main(argv=None) -> int:
     pipeline, names = _load_stack(args)
     metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
     metrics = Metrics(sink=metrics_sink)
+    quantizer = getattr(pipeline.gallery, "quantizer", None)
+    if quantizer is not None:
+        quantizer.metrics = metrics
 
     admission = None
     if args.max_inflight_frames > 0 or args.rate_limit_fps > 0:
@@ -321,6 +378,19 @@ def main(argv=None) -> int:
             # durable NOW, so a crash before the first enrollment still
             # restarts into a serving gallery.
             state.checkpoint_now(wait=True)
+
+    if (quantizer is not None and not quantizer.ready
+            and pipeline.gallery._ivf_wanted()):
+        # Sidecar missed (or no --state-dir): train the shortlist before
+        # serving starts — predictable startup beats a recall-free window.
+        # skip_if_ready rides out the background build a recovery poke
+        # may already have fired instead of training a second time.
+        # --match-mode auto below the capacity threshold skips this and
+        # lets the staleness poke build it if the gallery ever grows there.
+        print("training IVF coarse quantizer "
+              f"(nlist={quantizer.nlist})...", file=sys.stderr)
+        quantizer.rebuild_now(wait=True, skip_if_ready=True)
+        print(f"IVF quantizer: {quantizer.stats()}", file=sys.stderr)
 
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)

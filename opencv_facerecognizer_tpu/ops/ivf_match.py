@@ -1,0 +1,146 @@
+"""Two-stage IVF match: centroid shortlist -> exact Pallas rerank.
+
+The device-side half of the million-identity gallery subsystem
+(``parallel.quantizer`` owns the state; this module owns the math). The
+"shortlist + exact rerank" structure follows PAPERS.md's *Fast Matching by
+2 Lines of Code for Large Scale Face Recognition Systems* (1302.7180):
+
+- **Stage 1** scores the query batch against the ``nlist`` k-means
+  centroids (one tiny bf16 matmul: Q x nlist x D, ~1000x smaller than the
+  gallery scan) and shortlists each query's top-``nprobe`` cells.
+- **Stage 2** takes the batch-level UNION of shortlisted cells — cells
+  gather as dense [max_cell, D] int8 blocks because the inverted lists
+  are cell-resident — dequantizes them into one padded candidate bucket,
+  appends the always-scanned spill, and reranks the bucket with the
+  EXISTING exact streaming kernel (``ops.pallas_match.
+  streaming_match_topk``). One kernel call serves the whole query batch.
+
+Tie-breaking: the bucket is ordered by ascending gallery row id before
+the kernel runs, so the kernel's deterministic lowest-LOCAL-index
+tie-break (PR-2) is exactly a lowest-GALLERY-index tie-break — duplicate
+rows quantize identically, score identically, and resolve to the same
+winner the brute-force scan picks.
+
+Cost model (why the union): per query the candidate set is ~``nprobe *
+max_cell`` rows; the union dedups cells shared across the batch and lets
+the bucket gather run as contiguous cell blocks instead of per-query
+scattered row reads. Against a capacity-C gallery the exact scan streams
+``C * D`` bytes per batch; the two-stage path streams ``nlist * D``
+(stage 1) + ``|union| * max_cell * D`` int8 bytes — sublinear in C once
+``nlist`` scales with sqrt(C) (the ``bench.py`` ivf ladder measures the
+crossover; the recall gate in tests pins the accuracy side).
+
+Also home to the **tie-aware comparators** used by the recall gate and by
+``bench.py``'s kernel-parity check: BENCH_r05 reported ``idx match
+0.6914`` with ``max |sim diff| 0.00e+00`` — pure tie-position divergence
+counted as error. A comparison between two matchers is only meaningful
+modulo ties: any index attaining the max similarity is a correct answer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opencv_facerecognizer_tpu.ops.pallas_match import streaming_match_topk
+
+_INT32_MAX = np.int32(2**31 - 1)
+
+
+def ivf_match_topk(q, valid, ivf, *, k: int = 1, nprobe: int = 8,
+                   interpret: bool = False):
+    """Two-stage top-k over an IVF-quantized gallery.
+
+    q [Q, D] float queries; valid [capacity] bool — the GALLERY's validity
+    mask (row ids in the lists index into it); ivf — an
+    ``parallel.quantizer.IVFDeviceData`` (or any 7-tuple of its fields).
+    Returns (sims [Q, k] f32, gallery row indices [Q, k] int32) with the
+    same sentinel contract as ``streaming_match_topk``: empty slots carry
+    sim -1e30 and index -1. Traceable under jit; every intermediate shape
+    is static (union size = min(nlist, Q * nprobe) cells).
+    """
+    (centroids, cell_rows, cell_q8, cell_scale,
+     spill_rows, spill_q8, spill_scale) = tuple(ivf)[:7]
+    q = jnp.asarray(q, jnp.float32)
+    qn = q.shape[0]
+    nlist, max_cell, d = cell_q8.shape
+    p = min(int(nprobe), nlist)
+
+    # ---- stage 1: query-vs-centroid scores -> per-query top-P cells ----
+    scores = jax.lax.dot_general(
+        q.astype(jnp.bfloat16), centroids.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )  # [Q, nlist]
+    _, cells = jax.lax.top_k(scores, p)  # [Q, P]
+
+    # ---- batch union of shortlisted cells, ascending cell id ----
+    # Static size U >= the number of distinct probed cells, so no query's
+    # cell is ever dropped; unprobed slots pad with the sentinel nlist.
+    u = min(nlist, qn * p)
+    mark = jnp.zeros((nlist,), bool).at[cells.reshape(-1)].set(True)
+    sel_key = jnp.where(mark, jnp.arange(nlist, dtype=jnp.int32),
+                        jnp.int32(nlist))
+    sel = jnp.sort(sel_key)[:u]  # [U] probed cell ids first, pads last
+    pad_cell = sel >= nlist
+    selc = jnp.minimum(sel, nlist - 1)
+
+    # ---- gather cell-resident blocks + spill into one bucket ----
+    ids = jnp.where(jnp.repeat(pad_cell, max_cell),
+                    jnp.int32(-1), cell_rows[selc].reshape(u * max_cell))
+    all_ids = jnp.concatenate([ids, spill_rows])
+    all_q8 = jnp.concatenate([cell_q8[selc].reshape(u * max_cell, d),
+                              spill_q8])
+    all_scale = jnp.concatenate([cell_scale[selc].reshape(u * max_cell),
+                                 spill_scale])
+    # Ascending gallery row id: the exact kernel's lowest-local-index
+    # tie-break becomes a lowest-gallery-index tie-break (pads sort last).
+    order = jnp.argsort(jnp.where(all_ids < 0, _INT32_MAX, all_ids))
+    all_ids = jnp.take(all_ids, order)
+    bucket = (jnp.take(all_q8, order, axis=0).astype(jnp.bfloat16)
+              * jnp.take(all_scale, order).astype(jnp.bfloat16)[:, None])
+    # Bounds-mask, never clip: a list entry whose id exceeds THIS gallery
+    # snapshot's capacity (the reader paired a fresher quantizer with an
+    # older same-epoch gallery snapshot across a concurrent grow) must be
+    # skipped — a clipped gather would score row capacity-1 and report
+    # its label for a different row entirely.
+    in_range = (all_ids >= 0) & (all_ids < valid.shape[0])
+    bvalid = in_range & jnp.take(valid, jnp.clip(all_ids, 0,
+                                                 valid.shape[0] - 1))
+
+    # ---- stage 2: exact rerank with the existing streaming kernel ----
+    vals, lidx = streaming_match_topk(q, bucket, bvalid, k=k,
+                                      interpret=interpret)
+    gidx = jnp.where(lidx < 0, jnp.int32(-1),
+                     jnp.take(all_ids, jnp.maximum(lidx, 0)))
+    return vals, gidx
+
+
+# ---- tie-aware matcher comparison (shared by bench.py and the tests) ----
+
+def tie_aware_mismatch(vals_a, idx_a, vals_b, idx_b,
+                       atol: float = 2e-2) -> np.ndarray:
+    """Boolean mask of REAL top-1 disagreements between two matchers.
+
+    A row disagrees only when the indices differ AND the similarities the
+    two matchers report for their own winners differ beyond ``atol`` —
+    equal-valued different indices are ties, and any index attaining the
+    max similarity is a correct answer (the BENCH_r05 ``idx match
+    0.6914 / |sim diff| 0.00e+00`` artifact was exactly tie positions
+    counted as errors). Accepts [Q] or [Q, 1] shaped columns.
+    """
+    vals_a = np.asarray(vals_a, np.float32).reshape(-1)
+    vals_b = np.asarray(vals_b, np.float32).reshape(-1)
+    idx_a = np.asarray(idx_a).reshape(-1)
+    idx_b = np.asarray(idx_b).reshape(-1)
+    return (idx_a != idx_b) & (np.abs(vals_a - vals_b) > atol)
+
+
+def tie_aware_agreement(vals_a, idx_a, vals_b, idx_b,
+                        atol: float = 2e-2) -> float:
+    """Fraction of rows whose top-1 agrees modulo ties — the comparator
+    behind both the bench parity metric and the IVF recall gate (recall
+    == agreement of the two-stage result against tie-aware brute force).
+    """
+    mism = tie_aware_mismatch(vals_a, idx_a, vals_b, idx_b, atol=atol)
+    return float(1.0 - mism.mean()) if mism.size else 1.0

@@ -15,14 +15,20 @@ host-level application transport stays a separate layer (``runtime``).
 from opencv_facerecognizer_tpu.parallel.mesh import initialize_multihost, make_mesh
 from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
 
-__all__ = ["ShardedGallery", "TwoStagePipeline", "initialize_multihost", "make_mesh", "split_mesh"]
+__all__ = ["CoarseQuantizer", "ShardedGallery", "TwoStagePipeline",
+           "initialize_multihost", "make_mesh", "split_mesh"]
 
 
 def __getattr__(name):
     # pp pulls the full flax model stack; keep `parallel` import light for
     # mesh/gallery-only consumers (enrolment tooling, multi-host bootstrap).
+    # quantizer is lazy for the same reason (it imports jax at build time).
     if name in ("TwoStagePipeline", "split_mesh"):
         from opencv_facerecognizer_tpu.parallel import pp
 
         return getattr(pp, name)
+    if name == "CoarseQuantizer":
+        from opencv_facerecognizer_tpu.parallel.quantizer import CoarseQuantizer
+
+        return CoarseQuantizer
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

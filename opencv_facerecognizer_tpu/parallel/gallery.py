@@ -181,6 +181,12 @@ class GalleryData(NamedTuple):
     labels: jnp.ndarray  # [capacity], replicated
     valid: jnp.ndarray  # [capacity], P(tp)
     size: int
+    #: gallery ``_epoch`` at snapshot build — reset/swap_from/load_snapshot
+    #: bump it. Pairs this snapshot with derived state (the IVF quantizer
+    #: stamps its publishes with the same counter): a reader that took the
+    #: two snapshots non-atomically rejects a cross-epoch pair instead of
+    #: matching one row set against another's inverted lists.
+    epoch: int = 0
 
     @property
     def capacity(self) -> int:
@@ -199,6 +205,14 @@ class ShardedGallery:
     #: materialize+top_k path on real hardware (measured on v5e: 1.08x at
     #: 131k rows, 1.73x at 1M; parity/noise at 16k).
     PALLAS_MIN_CAPACITY = 65536
+
+    #: capacity above which ``match_mode="auto"`` switches to the
+    #: two-stage IVF path (when a ready quantizer is attached): the exact
+    #: scan is linear in capacity (BENCH_r05: 1.356 ms/batch at 262k,
+    #: 3.607 at 1M) while the shortlist+rerank cost scales with the
+    #: probed cells — below this tier the exact scan is already cheap
+    #: and the IVF recall trade buys nothing.
+    IVF_MIN_CAPACITY = 262144
 
     #: start background-compiling the next tier once fill crosses this
     #: fraction (async_grow mode), so the eventual grow swaps to an
@@ -273,6 +287,14 @@ class ShardedGallery:
         self._chunk_jit = None  # (key, zeros, update) for _chunked_emb_put
         self._bitcast_jit = None  # u16 -> bf16 device bitcast (_put_emb)
         self.last_grow_info: dict = {}
+        # ---- optional IVF coarse quantizer (parallel.quantizer) ----
+        # Derived state: the gallery drives every lifecycle edge —
+        # incremental assignment on add, invalidation on reset/
+        # load_snapshot/swap_from/async-grow splice, staleness pokes.
+        # ``match_mode``: "exact" never uses it, "ivf" always (when
+        # ready), "auto" switches at IVF_MIN_CAPACITY.
+        self.quantizer = None
+        self.match_mode = "exact"
         self._data = GalleryData(
             embeddings=jax.device_put(
                 jnp.zeros((self.capacity, dim), self.store_dtype),
@@ -427,10 +449,21 @@ class ShardedGallery:
                 self._host_emb[size : size + n] = embeddings
                 self._host_lab[size : size + n] = labels
                 self._host_val[size : size + n] = True
+                if self.quantizer is not None:
+                    # Incremental IVF assignment, under the same write
+                    # lock as the mirror update: the rows land in their
+                    # cells (or the spill) before the snapshot below
+                    # publishes them as matchable, so the two-stage path
+                    # never misses a row the exact path would find.
+                    self.quantizer.on_rows_added(embeddings, size)
                 self._install(self._host_emb, self._host_lab, self._host_val,
                               size + n)
         if evict_below is not None:
             self._evict_stale(evict_below)
+        if not self._growing:
+            # Staleness poke outside the lock (a retrain mid-grow would
+            # only be invalidated by the splice anyway).
+            self._poke_quantizer()
         if start_worker:
             self._grow_thread = threading.Thread(
                 target=self._grow_worker, daemon=True, name="gallery-grow"
@@ -644,7 +677,8 @@ class ShardedGallery:
                 t0 = _time.perf_counter()
                 new_data = self._build_snapshot(
                     emb, lab, val, pos, chunked=True,
-                    cancel=lambda: self._epoch != epoch, info=info)
+                    cancel=lambda: self._epoch != epoch, info=info,
+                    epoch=epoch)
                 if not self._await_residency(new_data, self.RESIDENCY_TIMEOUT_S,
                                              cancel=lambda: self._epoch != epoch,
                                              info=info):
@@ -660,12 +694,20 @@ class ShardedGallery:
                     self.capacity = target
                     self.grow_count += 1
                     self._pending_count -= n_fit
+                    if self.quantizer is not None:
+                        # A splice lands a large staged row set at once —
+                        # invalidate instead of assigning thousands of
+                        # rows under the write lock; serving falls back
+                        # to the exact matcher until the background
+                        # retrain (poked below) republishes.
+                        self.quantizer.invalidate()
                     self._data = new_data
                     spliced = None  # published: nothing to restore
                 info["install_s"] = round(_time.perf_counter() - t0, 3)
                 # Outside the lock: drop compiled entries for tiers below
                 # the one just replaced (see evict_hooks).
                 self._evict_stale(old_cap)
+                self._poke_quantizer()
         except Exception as e:  # never leave waiters hanging
             info["error"] = repr(e)
             with self._write_lock:
@@ -725,6 +767,8 @@ class ShardedGallery:
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
             self._pending_count = 0
+            if self.quantizer is not None:
+                self.quantizer.invalidate()
             self._host_emb = np.zeros((self.capacity, self.dim), np.float32)
             self._host_lab = np.full((self.capacity,), self.labels_pad, np.int32)
             self._host_val = np.zeros((self.capacity,), bool)
@@ -821,7 +865,7 @@ class ShardedGallery:
     def _build_snapshot(self, emb: np.ndarray, lab: np.ndarray,
                         val: np.ndarray, size: int,
                         chunked: bool = False, cancel=None,
-                        info=None) -> GalleryData:
+                        info=None, epoch: Optional[int] = None) -> GalleryData:
         """Device-put the arrays WITHOUT publishing (the async grow worker
         waits for residency between build and publish). ``chunked`` (grow
         worker only) paces the big embedding upload so concurrent serving
@@ -846,6 +890,7 @@ class ShardedGallery:
             labels=jax.device_put(jnp.asarray(lab), self._lab_sharding),
             valid=jax.device_put(jnp.asarray(val), self._valid_sharding),
             size=size,
+            epoch=self._epoch if epoch is None else epoch,
         )
 
     def _install(self, emb: np.ndarray, lab: np.ndarray, val: np.ndarray, size: int) -> None:
@@ -895,6 +940,12 @@ class ShardedGallery:
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
             self._pending_count = 0
+            if self.quantizer is not None:
+                # Derived state: the snapshot's rows share nothing with
+                # the trained cells. Recovery reinstates the quantizer
+                # from its wal_seq-keyed sidecar or retrains (see
+                # runtime.state_store); until then serving is exact.
+                self.quantizer.invalidate()
             self.capacity = emb.shape[0]
             self._host_emb = emb
             self._host_lab = np.array(lab, np.int32, copy=True)
@@ -923,6 +974,8 @@ class ShardedGallery:
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
             self._pending_count = 0
+            if self.quantizer is not None:
+                self.quantizer.invalidate()
             if other.capacity != self.capacity:
                 self.capacity = other.capacity
             self._host_emb = other._host_emb
@@ -936,8 +989,95 @@ class ShardedGallery:
             else:
                 # Device-visible swap is the single _data assignment (last,
                 # so the host mirrors are already consistent when readers
-                # see it).
-                self._data = other._data
+                # see it) — restamped with THIS gallery's epoch: the donor
+                # snapshot carries the donor's counter, and a stale stamp
+                # would make every post-swap quantizer publish (stamped
+                # with the bumped epoch) fail the _ivf_data pairing check
+                # forever, silently pinning serving to the exact path.
+                self._data = other._data._replace(epoch=self._epoch)
+        # The swapped-in rows need fresh cells: retrain in the background
+        # (single-flight); exact matching serves the interim.
+        self._poke_quantizer()
+
+    # ---- IVF coarse quantizer (parallel.quantizer) ----
+
+    def attach_quantizer(self, quantizer, mode: str = "auto") -> None:
+        """Wire a ``CoarseQuantizer`` as this gallery's shortlist front
+        end and select the match mode: ``"auto"`` (exact below
+        ``IVF_MIN_CAPACITY``, two-stage above — the serving default),
+        ``"ivf"`` (two-stage whenever the quantizer is ready), or
+        ``"exact"`` (attached but never consulted). The quantizer is
+        derived state: this gallery drives its whole lifecycle (add ->
+        incremental assign; reset/load_snapshot/swap_from/grow-splice ->
+        invalidate; staleness -> background retrain)."""
+        if mode not in ("auto", "ivf", "exact"):
+            raise ValueError(f"match mode must be auto|ivf|exact, got {mode!r}")
+        quantizer._gallery = self
+        self.quantizer = quantizer
+        self.match_mode = mode
+
+    def run_locked(self, fn):
+        """Run ``fn`` under the write lock — the quantizer's publish path
+        (its mutations are serialized by THIS lock, not one of its own,
+        so the PR-5 lock-order graph stays a tree rooted here)."""
+        with self._write_lock:
+            return fn()
+
+    def snapshot_quantizer(self):
+        """Atomic (vs. enrolments and retrain publishes) host copy of the
+        quantizer's sidecar payload, or None when absent/not ready — the
+        checkpoint writer captures this in the same critical section as
+        the gallery snapshot so the sidecar can be keyed to the
+        checkpoint's ``wal_seq``."""
+        if self.quantizer is None:
+            return None
+        with self._write_lock:
+            return self.quantizer.sidecar_payload_locked()
+
+    def _ivf_wanted(self, capacity: Optional[int] = None) -> bool:
+        """Would this gallery USE a ready quantizer at ``capacity``?
+        (Mode/threshold/mesh gates, ignoring readiness — the build
+        trigger needs the answer before any build exists.)"""
+        if self.quantizer is None or self.match_mode == "exact":
+            return False
+        if self.mesh.size != 1:
+            return False  # two-stage path is single-device, like pallas
+        if self.match_mode == "ivf":
+            return True
+        return ((self.capacity if capacity is None else capacity)
+                >= self.IVF_MIN_CAPACITY)
+
+    def _ivf_enabled(self, capacity: Optional[int] = None) -> bool:
+        return self._ivf_wanted(capacity) and self.quantizer.ready
+
+    def _ivf_data(self, data: GalleryData):
+        """The quantizer snapshot to pair with the ALREADY-TAKEN gallery
+        snapshot ``data``, or None for the exact path — ONE read of
+        ``quantizer.data`` so the enabled-check and the arrays can never
+        straddle an invalidation, and an epoch cross-check so the two
+        non-atomic reads can never pair one row set's gallery arrays
+        with another's inverted lists (a swap_from + fast retrain
+        between the reads would otherwise score the OLD rows against
+        the NEW lists — plausible sims, wrong identities)."""
+        if not self._ivf_wanted(data.capacity):
+            return None
+        ivf = self.quantizer.data  # None when invalidated/not built
+        if ivf is None or ivf.gallery_epoch != data.epoch:
+            return None
+        return ivf
+
+    def _poke_quantizer(self) -> None:
+        """Fire the background (re)build when the quantizer is missing-
+        but-wanted or stale — the single-flight retrain trigger, called
+        after enrolments and swaps (never on the match path)."""
+        q = self.quantizer
+        if q is None:
+            return
+        if not q.ready:
+            if self._ivf_wanted() and self.size > 0:
+                q.maybe_rebuild_async()
+        elif q.stale():
+            q.maybe_rebuild_async()
 
     # ---- matching (device-side) ----
 
@@ -957,15 +1097,47 @@ class ShardedGallery:
             >= self.PALLAS_MIN_CAPACITY
         )
 
-    def match_fn(self, k: int, capacity: Optional[int] = None):
-        """Pure ``(q, emb, valid, labels) -> (labels, sims, idx)`` match
-        function with the pallas-vs-GSPMD selection applied — shared by
+    def match_fn(self, k: int, capacity: Optional[int] = None,
+                 use_ivf: Optional[bool] = None):
+        """Pure match function with the mode selection applied — shared by
         ``match()`` and the fused pipeline step (``parallel.pipeline``), so
-        every caller of the hot op gets the streaming fast path, not just
-        direct ``gallery.match()`` users. Not jitted here: callers inline
-        it into their own jitted graphs. ``capacity`` only influences the
+        every caller of the hot op gets the right path, not just direct
+        ``gallery.match()`` users. Not jitted here: callers inline it into
+        their own jitted graphs. ``capacity`` only influences the
         selection (the fn itself is shape-polymorphic) — prewarm passes
-        the future tier's."""
+        the future tier's.
+
+        Three tiers of selection:
+
+        - **ivf** (``_ivf_enabled``): two-stage shortlist + exact rerank
+          (``ops.ivf_match``). Signature gains a 5th argument —
+          ``(q, emb, valid, labels, ivf)`` where ``ivf`` is the
+          ``IVFDeviceData`` snapshot from ``_ivf_data`` — because the
+          quantizer arrays must flow as jit ARGUMENTS (an incremental
+          assignment publishes new arrays; a closure would freeze them).
+          Callers branch on ``_ivf_enabled(capacity)`` for the arity and
+          PIN their choice via ``use_ivf`` so a concurrent invalidation
+          between their check and this call cannot flip the arity under
+          them (``None`` re-derives the selection — the legacy shape).
+        - **pallas streaming** single-chip exact.
+        - **GSPMD global view** multi-chip exact.
+        """
+        if self._ivf_enabled(capacity) if use_ivf is None else use_ivf:
+            from opencv_facerecognizer_tpu.ops.ivf_match import ivf_match_topk
+
+            interpret = self.mesh.devices.flat[0].platform != "tpu"
+            labels_pad = self.labels_pad
+            nprobe = self.quantizer.nprobe
+
+            def ivf_fn(q, g, valid, labels, ivf):
+                # ``g`` rides along unused for signature symmetry with the
+                # exact paths (XLA drops it); stage 2 reranks the int8
+                # cell-resident rows, ``valid``/``labels`` stay authoritative.
+                vals, idx = ivf_match_topk(q, valid, ivf, k=k, nprobe=nprobe,
+                                           interpret=interpret)
+                return take_labels_with_sentinel(labels, idx, labels_pad), vals, idx
+
+            return ivf_fn
         if self._pallas_enabled(capacity):
             from opencv_facerecognizer_tpu.ops.pallas_match import (
                 streaming_match_topk,
@@ -983,24 +1155,40 @@ class ShardedGallery:
             return fn
         return functools.partial(match_global, k=k, mesh=self.mesh)
 
-    def _matcher(self, k: int, data: GalleryData):
-        # Keyed by (k, capacity/pallas) DERIVED FROM THE SNAPSHOT being
-        # matched — a separate self.capacity read could straddle a
-        # concurrent grow and pair tier B's key with tier A's arrays
-        # (pipeline._step_key has the same rule). A grow changes the
-        # static gallery shape, but the old tier's compiled matcher stays
-        # valid for any in-flight readers and the new tier gets its own
-        # entry (eviction in _evict_stale, not clear() — prewarmed entries
-        # survive the swap).
+    def _matcher(self, k: int, data: GalleryData, ivf=None):
+        # Keyed by (k, capacity/pallas/ivf shapes) DERIVED FROM THE
+        # SNAPSHOTS being matched — a separate self.capacity read could
+        # straddle a concurrent grow and pair tier B's key with tier A's
+        # arrays (pipeline._step_key has the same rule). A grow changes
+        # the static gallery shape, but the old tier's compiled matcher
+        # stays valid for any in-flight readers and the new tier gets its
+        # own entry (eviction in _evict_stale, not clear() — prewarmed
+        # entries survive the swap). An IVF retrain that changes the list
+        # shapes (max_cell/spill growth) lands in a fresh entry the same
+        # way; same-shape republishes reuse the compiled matcher, with
+        # the new arrays flowing as arguments.
         capacity = data.capacity
-        key = (k, capacity, self._pallas_enabled(capacity))
+        ivf_sig = None if ivf is None else ivf.shape_signature()
+        key = (k, capacity, self._pallas_enabled(capacity), ivf_sig)
         fn = self._match_cache.get(key)  # fetch once (evict race)
         if fn is None:
-            if self._pallas_enabled(capacity):
-                fn = jax.jit(self.match_fn(k, capacity))
+            if ivf is not None:
+                # A retrain that changed the list shapes orphaned the
+                # previous signature's executable at this (k, capacity):
+                # purge it, or every staleness retrain leaks a compiled
+                # matcher for the process lifetime (capacity-threshold
+                # eviction never sees same-capacity signature churn).
+                # In-flight calls already hold their function references.
+                for stale in [k2 for k2 in list(self._match_cache)
+                              if k2[:3] == key[:3]
+                              and k2[3] not in (None, ivf_sig)]:
+                    self._match_cache.pop(stale, None)
+                fn = jax.jit(self.match_fn(k, capacity, use_ivf=True))
+            elif self._pallas_enabled(capacity):
+                fn = jax.jit(self.match_fn(k, capacity, use_ivf=False))
             else:
                 fn = jax.jit(
-                    self.match_fn(k, capacity),
+                    self.match_fn(k, capacity, use_ivf=False),
                     in_shardings=(
                         NamedSharding(self.mesh, P(DP_AXIS, None)),
                         self._emb_sharding,
@@ -1021,5 +1209,9 @@ class ShardedGallery:
         if queries.shape[0] % dp:
             raise ValueError(f"query count {queries.shape[0]} not divisible by dp={dp}")
         data = self._data  # one snapshot read; never mix fields across writes
+        ivf = self._ivf_data(data)  # one epoch-checked quantizer read
+        if ivf is not None:
+            return self._matcher(int(k), data, ivf)(
+                queries, data.embeddings, data.valid, data.labels, ivf)
         return self._matcher(int(k), data)(
             queries, data.embeddings, data.valid, data.labels)

@@ -121,7 +121,7 @@ class RecognitionPipeline:
         gallery.evict_hooks.append(self.evict_below)
 
     def _build_step(self, batch: int, height: int, width: int,
-                    capacity: Optional[int] = None):
+                    capacity: Optional[int] = None, use_ivf: bool = False):
         mesh = self.gallery.mesh
         det = self.detector
         k = self.top_k
@@ -134,13 +134,17 @@ class RecognitionPipeline:
                 embedder_mod.fused_forward, embed_net, interpret=interpret)
         else:
             embed_apply = lambda p, x: embed_net.apply({"params": p}, x)  # noqa: E731
-        # The gallery owns matcher selection (pallas streaming vs GSPMD
-        # global view) — the fused step inherits whichever fits the mesh
-        # and capacity; _step_key re-selects if the gallery grows, and
-        # prewarm passes the FUTURE capacity explicitly.
-        match = self.gallery.match_fn(k, capacity)
+        # The gallery owns matcher selection (two-stage ivf vs pallas
+        # streaming vs GSPMD global view) — the fused step inherits
+        # whichever fits the mesh and capacity; _step_key re-selects if
+        # the gallery grows or the quantizer (in)validates, and prewarm
+        # passes the FUTURE capacity explicitly. ``use_ivf`` is pinned by
+        # the caller's snapshot so a concurrent quantizer flip can't
+        # change the match arity mid-build.
+        match = self.gallery.match_fn(k, capacity, use_ivf=use_ivf)
 
-        def step(det_params, emb_params, gallery_emb, gallery_valid, gallery_labels, frames):
+        def step(det_params, emb_params, gallery_emb, gallery_valid,
+                 gallery_labels, frames, ivf=()):
             # Camera frames ride host->device as uint8 when the caller has
             # them that way (4x less PCIe/tunnel traffic than f32 — H2D,
             # not compute, dominates the serving e2e estimate); the cast
@@ -160,10 +164,16 @@ class RecognitionPipeline:
                 emb_params, embedder_mod.normalize_faces(flat, face_size)
             )  # [B*K, E] unit-norm
             # 4) match against the gallery (selection in gallery.match_fn:
+            # two-stage ivf for a ready quantizer above its threshold,
             # GSPMD global view when sharded, pallas streaming single-chip)
-            labels, sims, _ = match(
-                emb, gallery_emb, gallery_valid, gallery_labels
-            )
+            if use_ivf:
+                labels, sims, _ = match(
+                    emb, gallery_emb, gallery_valid, gallery_labels, ivf
+                )
+            else:
+                labels, sims, _ = match(
+                    emb, gallery_emb, gallery_valid, gallery_labels
+                )
             return RecognitionResult(
                 boxes=boxes,
                 det_scores=det_scores,
@@ -173,22 +183,26 @@ class RecognitionPipeline:
             )
 
         frames_sharding = NamedSharding(mesh, P(DP_AXIS, None, None))
-        return jax.jit(step, in_shardings=(None, None, None, None, None, frames_sharding))
+        return jax.jit(step, in_shardings=(None, None, None, None, None,
+                                           frames_sharding, None))
 
-    def _step_key(self, frames: jnp.ndarray, data) -> Tuple:
-        # Gallery capacity (and with it the pallas/GSPMD selection) can
-        # change at runtime via auto-grow — bake both into the cache key so
-        # a grown gallery re-selects its matcher instead of re-tracing the
-        # old closure at the new shapes. Both derive from the SAME
-        # GalleryData snapshot the call will feed: reading
-        # ``gallery.capacity`` separately could pair a stale key with
-        # new-tier arrays across a concurrent grow install, forcing the
-        # retrace (and, with GSPMD at 1M rows, the [Q, capacity] HBM
-        # materialization) that prewarm exists to avoid. Input dtype is a
-        # trace shape too (uint8 fast transfer vs f32).
+    def _step_key(self, frames: jnp.ndarray, data, ivf=None) -> Tuple:
+        # Gallery capacity (and with it the pallas/GSPMD/ivf selection)
+        # can change at runtime via auto-grow — bake both into the cache
+        # key so a grown gallery re-selects its matcher instead of
+        # re-tracing the old closure at the new shapes. All derive from
+        # the SAME GalleryData/IVFDeviceData snapshots the call will
+        # feed: reading ``gallery.capacity`` separately could pair a
+        # stale key with new-tier arrays across a concurrent grow
+        # install, forcing the retrace (and, with GSPMD at 1M rows, the
+        # [Q, capacity] HBM materialization) that prewarm exists to
+        # avoid. Input dtype is a trace shape too (uint8 fast transfer
+        # vs f32). The ivf signature is the quantizer's static shapes —
+        # a same-shape retrain republish reuses the compiled step.
         capacity = data.capacity
         return (*frames.shape, str(frames.dtype), capacity,
-                self.gallery._pallas_enabled(capacity))
+                self.gallery._pallas_enabled(capacity),
+                None if ivf is None else ivf.shape_signature())
 
     @staticmethod
     def _as_device_frames(frames) -> jnp.ndarray:
@@ -207,14 +221,17 @@ class RecognitionPipeline:
             self.fault_injector.on_dispatch()
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
-        key = self._step_key(frames, data)
+        ivf = self.gallery._ivf_data(data)  # one epoch-checked quantizer read
+        key = self._step_key(frames, data, ivf)
         # Fetch ONCE and hold the reference: a concurrent double-grow can
         # evict this tier's entry between a membership check and a second
         # subscript (evict_below runs on the grow worker).
         step = self._step_cache.get(key)
         if step is None:
+            self._evict_stale_ivf(key)
             step = self._step_cache[key] = self._build_step(
-                *frames.shape, capacity=data.capacity)
+                *frames.shape, capacity=data.capacity,
+                use_ivf=ivf is not None)
         return step(
             self.detector.params,
             self.embed_params,
@@ -222,6 +239,7 @@ class RecognitionPipeline:
             data.valid,
             data.labels,
             frames,
+            ivf if ivf is not None else (),
         )
 
     def recognize_batch_packed(self, frames: jnp.ndarray) -> jnp.ndarray:
@@ -232,16 +250,20 @@ class RecognitionPipeline:
             self.fault_injector.on_dispatch()
         frames = self._as_device_frames(frames)
         data = self.gallery.data  # one atomic snapshot (see GalleryData)
-        key = self._step_key(frames, data)
+        ivf = self.gallery._ivf_data(data)  # one epoch-checked quantizer read
+        key = self._step_key(frames, data, ivf)
         packed = self._packed_cache.get(key)  # fetch once (evict race)
         if packed is None:
+            self._evict_stale_ivf(key)
             step = self._step_cache.get(key)
             if step is None:
                 step = self._step_cache[key] = self._build_step(
-                    *frames.shape, capacity=data.capacity)
+                    *frames.shape, capacity=data.capacity,
+                    use_ivf=ivf is not None)
 
-            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr):
-                return pack_result(step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
+            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr, iv):
+                return pack_result(step(det_p, emb_p, g_emb, g_valid,
+                                        g_lab, fr, iv))
 
             packed = self._packed_cache[key] = jax.jit(packed_step)
         return packed(
@@ -251,6 +273,7 @@ class RecognitionPipeline:
             data.valid,
             data.labels,
             frames,
+            ivf if ivf is not None else (),
         )
 
     def prewarm_batch_shapes(self, batch_sizes, frame_shape,
@@ -290,6 +313,19 @@ class RecognitionPipeline:
         """
         g = self.gallery
         pallas = g._pallas_enabled(capacity)
+        # Warm the EXACT-arity step for the future tier, never the ivf
+        # one: prewarm's only consumers are the grow worker and the
+        # early-warm thread, and the grow SPLICE invalidates the
+        # quantizer (gallery._grow_worker) — so the first post-swap
+        # lookup is always (ivf_sig=None, exact). Warming at the current
+        # ivf signature would compile a step the swap can never hit
+        # while the real post-swap key misses cold on the serving
+        # thread. (The retrain that later re-enables ivf republishes
+        # with fresh list shapes; its first serving call does pay a
+        # compile — a known, bounded cost every first ivf enablement
+        # shares, separate from the grow path this warms.)
+        ivf = None
+        ivf_sig = None
         served = {
             (key[0], key[1], key[2], key[3])
             for key in list(self._packed_cache) + list(self._step_cache)
@@ -309,31 +345,49 @@ class RecognitionPipeline:
             jnp.zeros((capacity,), bool), g._valid_sharding
         )
         for batch, height, width, dtype in served:
-            new_key = (batch, height, width, dtype, capacity, pallas)
+            new_key = (batch, height, width, dtype, capacity, pallas, ivf_sig)
             if new_key in self._packed_cache:
                 continue
             step = self._step_cache.get(new_key)
             if step is None:
-                step = self._build_step(batch, height, width, capacity)
+                step = self._build_step(batch, height, width, capacity,
+                                        use_ivf=ivf is not None)
                 self._step_cache[new_key] = step
             frames = jnp.zeros((batch, height, width), dtype=dtype)
+            ivf_arg = ivf if ivf is not None else ()
             # Execute each once: jit compiles per concrete shape; block so
             # the caller (grow worker) only installs AFTER compiles landed.
             jax.block_until_ready(step(
                 self.detector.params, self.embed_params,
-                scratch_emb, scratch_val, scratch_lab, frames,
+                scratch_emb, scratch_val, scratch_lab, frames, ivf_arg,
             ))
 
-            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr,
+            def packed_step(det_p, emb_p, g_emb, g_valid, g_lab, fr, iv,
                             _step=step):
-                return pack_result(_step(det_p, emb_p, g_emb, g_valid, g_lab, fr))
+                return pack_result(_step(det_p, emb_p, g_emb, g_valid,
+                                         g_lab, fr, iv))
 
             packed = jax.jit(packed_step)
             packed(
                 self.detector.params, self.embed_params,
-                scratch_emb, scratch_val, scratch_lab, frames,
+                scratch_emb, scratch_val, scratch_lab, frames, ivf_arg,
             ).block_until_ready()
             self._packed_cache[new_key] = packed
+
+    def _evict_stale_ivf(self, key: Tuple) -> None:
+        """Purge cached steps whose ivf shape signature was superseded by
+        a retrain at the same (batch, frame, capacity, pallas) — the
+        capacity-threshold eviction (``evict_below``) never sees
+        same-capacity signature churn, so without this every staleness
+        retrain would leak compiled executables for the process lifetime.
+        In-flight calls already hold their function references."""
+        sig = key[6]
+        if sig is None:
+            return
+        for cache in (self._step_cache, self._packed_cache):
+            for stale in [k2 for k2 in list(cache)
+                          if k2[:6] == key[:6] and k2[6] not in (None, sig)]:
+                cache.pop(stale, None)
 
     def evict_below(self, min_capacity: int) -> None:
         """Drop compiled steps for gallery tiers strictly below

@@ -443,6 +443,13 @@ class ServiceSupervisor:
             # tail replay they would vanish from serving and the next
             # durable checkpoint would truncate their WAL records.
             self.state.replay_tail(self._snapshot_wal_seq)
+        # load_snapshot invalidated any attached IVF quantizer (derived
+        # state): schedule the background retrain here, or a match-heavy
+        # workload with no further enrolments (the other poke site) stays
+        # pinned to the linear exact scan forever.
+        poke = getattr(service.pipeline.gallery, "_poke_quantizer", None)
+        if poke is not None:
+            poke()
 
     def _restore_durable(self) -> bool:
         """Fallback restore from the durable state lifecycle (checkpoint +

@@ -82,6 +82,11 @@ CHECKPOINT_FORMAT_VERSION = 1
 CHECKPOINT_SUFFIX = ".ckpt"
 QUARANTINE_SUFFIX = ".corrupt"
 
+#: IVF quantizer sidecar (parallel.quantizer): DERIVED state persisted
+#: next to the checkpoints, keyed by the checkpoint's ``wal_seq`` — a
+#: mismatched or corrupt sidecar is ignored (retrain), never trusted.
+SIDECAR_NAME = "quantizer.ivf"
+
 
 class CheckpointVersionError(ValueError):
     """The checkpoint is from a NEWER format than this binary supports —
@@ -604,6 +609,11 @@ class StateLifecycle:
         self._faults = fault_injector
         self.store = CheckpointStore(os.path.join(self.state_dir, "checkpoints"),
                                      keep=keep_checkpoints, metrics=metrics)
+        #: IVF quantizer sidecar (derived state, keyed by checkpoint
+        #: wal_seq): written after each successful checkpoint when the
+        #: attached gallery carries a ready quantizer; consulted by
+        #: ``recover`` so startup skips the k-means retrain.
+        self.sidecar_path = os.path.join(self.state_dir, SIDECAR_NAME)
         self.wal = EnrollmentWAL(os.path.join(self.state_dir, "enroll.wal"),
                                  max_bytes=wal_max_bytes,
                                  metrics=metrics, fsync=wal_fsync,
@@ -690,6 +700,11 @@ class StateLifecycle:
                                   "replayed_rows": 0, "skipped_records": 0}
         with self._enroll_lock:
             base_seq = self._recover_checkpoint_locked(gallery, names, report)
+            # Quantizer sidecar BEFORE WAL replay: replayed enrollments
+            # then re-drive the same incremental assignments the live
+            # process made against the sidecar's centroids — identical
+            # derived state without a startup k-means.
+            self._restore_quantizer_locked(gallery, base_seq, report)
             # WAL replay: acknowledged enrollments since that checkpoint
             # (one scan pass also yields the seq high-water mark).
             surviving, highest = self.wal.scan()
@@ -721,7 +736,60 @@ class StateLifecycle:
             self.metrics.incr(mn.STATE_RECOVERIES)
             self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         report["gallery_size"] = gallery.size
+        # No (or stale) sidecar: the quantizer retrains in the background
+        # (single-flight) while the exact matcher serves — startup never
+        # blocks on a k-means.
+        poke = getattr(gallery, "_poke_quantizer", None)
+        if poke is not None:
+            poke()
         return report
+
+    def _restore_quantizer_locked(self, gallery, base_seq: int,
+                                  report: Dict[str, Any]) -> None:
+        """Reinstate the (derived) IVF quantizer from its sidecar when one
+        exists AND its ``wal_seq`` matches the recovered checkpoint's —
+        any mismatch, corruption or config drift falls back to a retrain,
+        never a half-trusted shortlist (a wrong inverted list is a silent
+        recall bug, the one failure mode this subsystem must not have)."""
+        quantizer = getattr(gallery, "quantizer", None)
+        if quantizer is None:
+            return
+        from opencv_facerecognizer_tpu.parallel.quantizer import (
+            SidecarError, decode_sidecar,
+        )
+
+        try:
+            with open(self.sidecar_path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            return  # no sidecar: the post-recovery poke retrains
+        try:
+            header, centroids, assign = decode_sidecar(blob)
+        except SidecarError as exc:
+            logging.getLogger(__name__).warning(
+                "quantizer sidecar unreadable (%s); will retrain", exc)
+            if self.metrics is not None:
+                self.metrics.incr(mn.IVF_SIDECAR_ERRORS)
+            return
+        nlist_drift = (not getattr(quantizer, "auto_nlist", False)
+                       and int(header.get("nlist", -1)) != quantizer.nlist)
+        if (int(header.get("wal_seq", -1)) != int(base_seq)
+                or nlist_drift
+                or int(header.get("seed", -1)) != quantizer.seed
+                or int(header.get("dim", -1)) != gallery.dim):
+            logging.getLogger(__name__).info(
+                "quantizer sidecar stale (wal_seq %s vs checkpoint %s); "
+                "will retrain", header.get("wal_seq"), base_seq)
+            if self.metrics is not None:
+                self.metrics.incr(mn.IVF_SIDECAR_STALE)
+            return
+        if quantizer.install_from_arrays(centroids, assign):
+            report["quantizer_sidecar"] = "loaded"
+            if self.metrics is not None:
+                self.metrics.incr(mn.IVF_SIDECAR_LOADS)
+        else:
+            if self.metrics is not None:
+                self.metrics.incr(mn.IVF_SIDECAR_STALE)
 
     def _recover_checkpoint_locked(self, gallery, names,
                                    report: Dict[str, Any]) -> int:
@@ -959,6 +1027,12 @@ class StateLifecycle:
                 rows_at = self._rows_since_ckpt
                 emb, lab, val, size = gallery.snapshot()
                 names_copy = [] if names is None else list(names)
+                # IVF sidecar payload captured in the SAME critical
+                # section: its assignments cover exactly the rows this
+                # checkpoint covers, so keying it by this wal_seq is
+                # sound (derived state; None when absent/not ready).
+                snap_q = getattr(gallery, "snapshot_quantizer", None)
+                qpayload = snap_q() if snap_q is not None else None
             from flax import serialization as flax_serialization
 
             payload = flax_serialization.msgpack_serialize(
@@ -991,6 +1065,26 @@ class StateLifecycle:
                 self._ckpt_retry_backoff_s = min(
                     60.0, self._ckpt_retry_backoff_s * 2.0)
                 return False
+            if qpayload is not None:
+                # Sidecar AFTER the checkpoint is durable (a crash in
+                # between recovers checkpoint-without-sidecar -> retrain,
+                # the safe direction); best-effort — derived state never
+                # fails a checkpoint.
+                from opencv_facerecognizer_tpu.parallel.quantizer import (
+                    encode_sidecar,
+                )
+
+                try:
+                    atomic_write_bytes(self.sidecar_path,
+                                       encode_sidecar(qpayload, wal_seq))
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.IVF_SIDECAR_WRITES)
+                except OSError:
+                    logging.getLogger(__name__).exception(
+                        "quantizer sidecar write failed (checkpoint is "
+                        "durable; recovery will retrain)")
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.IVF_SIDECAR_ERRORS)
             if fault == "late":
                 # The checkpoint landed; die before the WAL truncation —
                 # the replay-dedup window the wal_seq header exists for.

@@ -107,6 +107,18 @@ WAL_OVER_BYTES = "wal_over_bytes"
 WAL_ROWS = "wal_rows"
 STATE_RECOVERIES = "state_recoveries"
 
+# ---- IVF coarse quantizer (parallel.quantizer / ops.ivf_match) -------------
+IVF_BUILDS = "ivf_builds"
+IVF_BUILD_FAILURES = "ivf_build_failures"
+IVF_RETRAINS_SKIPPED_INFLIGHT = "ivf_retrains_skipped_inflight"
+IVF_INVALIDATIONS = "ivf_invalidations"
+IVF_INCREMENTAL_ROWS = "ivf_incremental_rows"
+IVF_SPILL_ROWS = "ivf_spill_rows"
+IVF_SIDECAR_WRITES = "ivf_sidecar_writes"
+IVF_SIDECAR_LOADS = "ivf_sidecar_loads"
+IVF_SIDECAR_STALE = "ivf_sidecar_stale"
+IVF_SIDECAR_ERRORS = "ivf_sidecar_errors"
+
 # ---- supervisor ------------------------------------------------------------
 SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"
 SUPERVISOR_RESTARTS = "supervisor_restarts"

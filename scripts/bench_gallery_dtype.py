@@ -1,9 +1,14 @@
-"""A/B the gallery store dtype (f32 vs bf16) at the 1M-row tier: in-graph
-match cost (chained differencing — block_until_ready does not await on
-this tunneled backend, see bench.py) and upload wall (device_put + the
-residency await the grow worker uses). Both matchers compute bf16 x bf16
--> f32 regardless of storage, so bf16 storage should halve HBM traffic
-and upload bytes at identical math.
+"""A/B the gallery store dtype (f32 vs bf16 vs int8) at the 1M-row tier:
+in-graph match cost (chained differencing — block_until_ready does not
+await on this tunneled backend, see bench.py) and upload wall (device_put
++ the residency await the grow worker uses). f32 and bf16 compute
+bf16 x bf16 -> f32 regardless of storage, so bf16 storage should halve
+HBM traffic and upload bytes at identical math. The int8 arm measures the
+IVF quantizer's storage format (``parallel.quantizer.quantize_rows``:
+per-row scale, dequantized to bf16 in-graph before the same exact
+kernel) — quarter the bytes of f32 with a measured, not assumed,
+accuracy column (tie-aware top-1 agreement + max |sim diff| vs the f32
+arm, the same comparator as the IVF recall gate).
 
 Run:  PYTHONPATH=. python scripts/bench_gallery_dtype.py
 Merges a "gallery_dtype" section into BENCH_DETAIL.json.
@@ -80,6 +85,33 @@ def main():
         _log(f"[{name}] install (pre-readback) {upload_s:.2f}s")
         galleries[name] = g
 
+    # int8 arm (still phase 1 — upload before any readback): the IVF
+    # quantizer's storage format, per-row scale + int8 rows.
+    from opencv_facerecognizer_tpu.parallel.quantizer import quantize_rows
+
+    gc.collect()
+    q8_host, scale_host = quantize_rows(emb)
+    t0 = time.perf_counter()
+    q8_dev = jax.device_put(q8_host)
+    scale_dev = jax.device_put(scale_host)
+    int8_ok = True
+    deadline = time.monotonic() + 600.0
+    while time.monotonic() < deadline:
+        try:
+            if q8_dev.is_ready() and scale_dev.is_ready():
+                break
+        except (AttributeError, NotImplementedError):
+            break
+        time.sleep(0.01)
+    else:
+        int8_ok = False
+    result["int8"] = {
+        "upload_s": round(time.perf_counter() - t0, 2),
+        "residency_ok": int8_ok,
+        "gallery_bytes": int(rows * dim + rows * 4),  # q8 + f32 scales
+    }
+    _log(f"[int8] install (pre-readback) {result['int8']['upload_s']:.2f}s")
+
     # PHASE 2 — chained match timing (readbacks allowed from here on).
     q_dev = jnp.asarray(q)
     for dtype, name in arms:
@@ -104,12 +136,56 @@ def main():
         ms = (min(t2s) - min(t1s)) / (k2 - k1) * 1e3
         result[name]["match_ms_per_call"] = round(ms, 3)
         _log(f"[{name}] match {ms:.3f} ms/call")
+        if name == "f32":
+            # Reference top-1 for the int8 accuracy column below.
+            f32_vals, f32_idx = (np.asarray(v) for v in
+                                 match(q_dev, g.data.embeddings,
+                                       g.data.valid, g.data.labels)[1:])
         del galleries[name], g
+
+    # int8 match arm: dequantize in-graph (bf16) then the SAME exact
+    # streaming kernel — the IVF stage-2 cost model at full-gallery scale.
+    from opencv_facerecognizer_tpu.ops.ivf_match import tie_aware_agreement
+    from opencv_facerecognizer_tpu.ops.pallas_match import streaming_match_topk
+
+    valid_dev = jnp.ones((rows,), bool)
+    interpret = jax.devices()[0].platform != "tpu"
+
+    @jax.jit
+    def int8_match(q, q8d, sd, valid):
+        gal = q8d.astype(jnp.bfloat16) * sd.astype(jnp.bfloat16)[:, None]
+        return streaming_match_topk(q, gal, valid, k=k, interpret=interpret)
+
+    def chain8(n):
+        vals, idx = int8_match(q_dev, q8_dev, scale_dev, valid_dev)
+        for _ in range(n - 1):
+            vals, idx = int8_match(q_dev + vals[0, 0] * 1e-30, q8_dev,
+                                   scale_dev, valid_dev)
+        return np.asarray(vals).sum()
+
+    chain8(2)
+    k1, k2 = 4, 64
+    t1s, t2s = [], []
+    for _ in range(3):
+        t0 = time.perf_counter(); chain8(k1); t1s.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); chain8(k2); t2s.append(time.perf_counter() - t0)
+    ms = (min(t2s) - min(t1s)) / (k2 - k1) * 1e3
+    result["int8"]["match_ms_per_call"] = round(ms, 3)
+    i8_vals, i8_idx = (np.asarray(v) for v in
+                       int8_match(q_dev, q8_dev, scale_dev, valid_dev))
+    result["int8"]["tie_aware_top1_agreement_vs_f32"] = round(
+        tie_aware_agreement(i8_vals, i8_idx, f32_vals, f32_idx), 4)
+    result["int8"]["max_abs_sim_diff_vs_f32"] = round(
+        float(np.max(np.abs(i8_vals.reshape(-1) - f32_vals.reshape(-1)))), 6)
+    _log(f"[int8] match {ms:.3f} ms/call, top-1 agreement "
+         f"{result['int8']['tie_aware_top1_agreement_vs_f32']}")
 
     f, b = result["f32"], result["bf16"]
     result["upload_speedup"] = round(f["upload_s"] / b["upload_s"], 2)
     result["match_speedup"] = round(
         f["match_ms_per_call"] / b["match_ms_per_call"], 2)
+    result["int8_match_speedup_vs_f32"] = round(
+        f["match_ms_per_call"] / result["int8"]["match_ms_per_call"], 2)
     path = os.path.join(REPO, "BENCH_DETAIL.json")
     try:
         detail = json.load(open(path))

@@ -1,0 +1,588 @@
+"""IVF quantizer subsystem: two-stage match correctness, the recall
+acceptance gate, and the derived-state lifecycle (rebuild bit-equivalence,
+WAL-replay assignment reproducibility, swap invalidation, retrain chaos).
+
+The two-stage path is single-device (like the pallas matcher), so every
+test here builds the 1x1 mesh explicitly; the pallas rerank runs in
+interpret mode on CPU, same as test_pallas_match.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from opencv_facerecognizer_tpu.ops.ivf_match import (
+    ivf_match_topk,
+    tie_aware_agreement,
+    tie_aware_mismatch,
+)
+from opencv_facerecognizer_tpu.parallel import ShardedGallery
+from opencv_facerecognizer_tpu.parallel.mesh import DP_AXIS, TP_AXIS
+from opencv_facerecognizer_tpu.parallel.quantizer import (
+    CoarseQuantizer,
+    SidecarError,
+    decode_sidecar,
+    encode_sidecar,
+)
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def mesh1():
+    return Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                (DP_AXIS, TP_AXIS))
+
+
+def _unit(x):
+    x = np.asarray(x, np.float32)
+    return x / np.maximum(np.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+
+
+def build_gallery(rows=2048, dim=32, nlist=32, nprobe=8, seed=0,
+                  mode="ivf", metrics=None, build=True):
+    rng = np.random.default_rng(seed)
+    emb = _unit(rng.normal(size=(rows, dim)))
+    labels = np.arange(rows, dtype=np.int32)
+    g = ShardedGallery(capacity=rows, dim=dim, mesh=mesh1())
+    g.add(emb, labels)
+    q = CoarseQuantizer(nlist=nlist, nprobe=nprobe, seed=seed,
+                        kmeans_iters=5, train_sample=min(rows, 4096),
+                        metrics=metrics)
+    # Attach AFTER the bulk add so no background build races the tests'
+    # explicit, deterministic rebuild_now().
+    g.attach_quantizer(q, mode=mode)
+    if build:
+        assert q.rebuild_now()
+    return g, q, emb, rng
+
+
+# ---------------------------------------------------------------- matching
+
+def test_two_stage_matches_exact_on_perturbed_queries():
+    g, q, emb, rng = build_gallery()
+    queries = _unit(emb[:16] + 0.05 * rng.normal(size=(16, emb.shape[1])))
+    assert g._ivf_enabled()
+    li, si, ii = (np.asarray(v) for v in g.match(queries, k=1))
+    g.match_mode = "exact"
+    lx, sx, ix = (np.asarray(v) for v in g.match(queries, k=1))
+    assert tie_aware_agreement(si, ii, sx, ix) == 1.0
+    # labels of agreeing rows agree too
+    agree = (ii == ix).reshape(-1)
+    assert np.array_equal(li.reshape(-1)[agree], lx.reshape(-1)[agree])
+
+
+def test_ivf_tie_break_prefers_lowest_gallery_index():
+    """Duplicate rows spread across different list positions must resolve
+    to the LOWEST gallery index, exactly like the exact kernel (PR-2) —
+    the bucket is re-sorted by global id before the rerank."""
+    rng = np.random.default_rng(3)
+    base = _unit(rng.normal(size=(8, 16)))
+    emb = np.tile(base, (16, 1))  # 128 rows, each base row appears 16x
+    g = ShardedGallery(capacity=len(emb), dim=16, mesh=mesh1())
+    g.add(emb, np.arange(len(emb), dtype=np.int32))
+    q = CoarseQuantizer(nlist=8, nprobe=8, seed=1, kmeans_iters=4,
+                        train_sample=128)
+    g.attach_quantizer(q, mode="ivf")
+    assert q.rebuild_now()
+    _l, sims, idx = (np.asarray(v) for v in g.match(base, k=4))
+    sims_full = base @ emb.T
+    oidx = np.argsort(-sims_full, axis=1, kind="stable")[:, :4]
+    assert np.array_equal(idx, oidx), (idx, oidx)
+
+
+def test_ivf_masks_invalid_rows_and_emits_sentinels():
+    """Rows the gallery marks invalid never surface; with fewer valid
+    rows than k the empty slots carry the -1 sentinel."""
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8)
+    data = g.data
+    ivf = q.data
+    valid = np.zeros(data.capacity, bool)
+    valid[:5] = True
+    import jax.numpy as jnp
+
+    vals, idx = (np.asarray(v) for v in ivf_match_topk(
+        jnp.asarray(emb[:8]), jnp.asarray(valid), ivf, k=8, nprobe=8,
+        interpret=True))
+    real = idx >= 0
+    assert np.all(idx[real] < 5)
+    assert np.all(vals[~real] < -1e29)
+    assert real.sum(axis=1).max() <= 5
+
+
+def test_incremental_add_exceeding_assign_chunk_is_chunked():
+    """One add() larger than ASSIGN_CHUNK must be sliced through the
+    batched insert (a single padded scatter would need a negative pad and
+    crash under the gallery write lock, leaving host counters claiming
+    placements the device arrays never got)."""
+    from opencv_facerecognizer_tpu.parallel.quantizer import ASSIGN_CHUNK
+
+    g, q, emb, rng = build_gallery(rows=16384, dim=16, nlist=8, seed=1)
+    n = ASSIGN_CHUNK + 64
+    new = _unit(rng.normal(size=(n, 16)))
+    start = g.size
+    g.add(new, np.arange(start, start + n, dtype=np.int32))
+    assert q.ready  # the cells absorbed the rows; no overflow-invalidate
+    assert q._assigned_rows == start + n
+    probe = np.concatenate([new[:1], new[-1:]])
+    pad = np.tile(probe[-1], (6, 1))
+    _l, _s, idx = (np.asarray(v) for v in g.match(
+        np.concatenate([probe, pad]), k=1))
+    assert idx[0, 0] == start and idx[1, 0] == start + n - 1
+
+
+def test_incremental_add_immediately_matchable():
+    g, q, emb, rng = build_gallery()
+    new = _unit(rng.normal(size=(6, emb.shape[1])))
+    start = g.size
+    g.add(new, np.arange(start, start + 6, dtype=np.int32))
+    _l, _s, idx = (np.asarray(v) for v in g.match(new, k=1))
+    assert np.array_equal(idx[:, 0], np.arange(start, start + 6))
+
+
+def test_auto_mode_threshold_selects_path():
+    g, q, emb, rng = build_gallery(mode="auto")
+    # auto below the capacity threshold: exact path despite a ready quantizer
+    assert g.capacity < ShardedGallery.IVF_MIN_CAPACITY
+    assert q.ready and not g._ivf_enabled()
+    assert g._ivf_data(g.data) is None
+    # lowering the threshold flips it to the two-stage path
+    g.IVF_MIN_CAPACITY = g.capacity
+    assert g._ivf_enabled()
+    assert g._ivf_data(g.data) is not None
+    # pinned-arity match_fn: 5-arg when ivf, 4-arg when exact
+    fn = g.match_fn(1, use_ivf=True)
+    assert fn.__code__.co_argcount == 5
+    fn = g.match_fn(1, use_ivf=False)
+
+
+def test_multi_device_mesh_never_selects_ivf():
+    from opencv_facerecognizer_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    if mesh.size == 1:
+        pytest.skip("needs the 8-virtual-device suite mesh")
+    g = ShardedGallery(capacity=256, dim=16, mesh=mesh)
+    q = CoarseQuantizer(nlist=8, nprobe=4, seed=0, kmeans_iters=2,
+                        train_sample=256)
+    g.attach_quantizer(q, mode="ivf")
+    assert not g._ivf_wanted()
+
+
+# ----------------------------------------------------------- recall gate
+
+@pytest.mark.parametrize("rows,nlist", [(262_144, 512)])
+def test_recall_gate_262k(rows, nlist):
+    """THE acceptance gate (ISSUE 6): two-stage top-1 recall >= 0.99 vs
+    tie-aware brute force on a seeded >=262k-row synthetic gallery, at
+    serving-distribution queries (perturbed enrolled rows)."""
+    # per_batch=4 keeps the batch-level cell union SMALL (<=128 of 512
+    # cells per call): the gate tests per-query shortlist quality, not
+    # the whole-table union that larger batches degenerate into.
+    dim, nprobe, n_q, per_batch = 64, 32, 64, 4
+    rng = np.random.default_rng(42)
+    emb = _unit(rng.normal(size=(rows, dim)).astype(np.float32))
+    g = ShardedGallery(capacity=rows, dim=dim, mesh=mesh1(),
+                       store_dtype="bfloat16")
+    g.add(emb, np.arange(rows, dtype=np.int32))
+    q = CoarseQuantizer(nlist=nlist, nprobe=nprobe, seed=7, kmeans_iters=6,
+                        train_sample=32768)
+    g.attach_quantizer(q, mode="ivf")
+    assert q.rebuild_now()
+    pick = rng.choice(rows, n_q, replace=False)
+    queries = _unit(emb[pick] + 0.05 * rng.normal(size=(n_q, dim)))
+    sims_i = np.empty((n_q,), np.float32)
+    idx_i = np.empty((n_q,), np.int64)
+    # Small per-call batches keep the cell union (and the interpret-mode
+    # rerank bucket) small — the union is Q*nprobe cells.
+    for off in range(0, n_q, per_batch):
+        _l, s, i = (np.asarray(v) for v in
+                    g.match(queries[off:off + per_batch], k=1))
+        sims_i[off:off + per_batch] = s[:, 0]
+        idx_i[off:off + per_batch] = i[:, 0]
+    # Brute-force oracle (f32, stable lowest-index ties).
+    sims = queries @ emb.T
+    idx_x = np.argmax(sims, axis=1)
+    vals_x = sims[np.arange(n_q), idx_x]
+    recall = tie_aware_agreement(sims_i, idx_i, vals_x, idx_x)
+    assert recall >= 0.99, (recall, int(tie_aware_mismatch(
+        sims_i, idx_i, vals_x, idx_x).sum()))
+
+
+# ------------------------------------------------- lifecycle: determinism
+
+def test_rebuild_on_snapshot_load_bit_equivalence():
+    """Same rows + same seed -> the rebuild after load_snapshot
+    reproduces centroids, assignments and packed lists bit-for-bit."""
+    g1, q1, emb, rng = build_gallery(rows=1024, dim=16, nlist=16, seed=9)
+    snap = g1.snapshot()
+    g2 = ShardedGallery(capacity=1024, dim=16, mesh=mesh1())
+    q2 = CoarseQuantizer(nlist=16, nprobe=8, seed=9, kmeans_iters=5,
+                         train_sample=4096)
+    g2.attach_quantizer(q2, mode="ivf")
+    g2.load_snapshot(*snap)
+    assert not q2.ready  # load_snapshot invalidates
+    assert q2.rebuild_now()
+    np.testing.assert_array_equal(q1._h_centroids, q2._h_centroids)
+    np.testing.assert_array_equal(q1._h_assign, q2._h_assign)
+    for field in ("cell_rows", "cell_q8", "cell_scale", "spill_rows",
+                  "spill_q8", "spill_scale"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(q1.data, field)),
+            np.asarray(getattr(q2.data, field)), err_msg=field)
+
+
+def test_wal_replay_reproduces_incremental_assignments(tmp_path):
+    """The PR-4 contract extended to derived state: recovery = sidecar
+    (keyed by checkpoint wal_seq) + WAL replay re-driving gallery.add,
+    which re-runs the same incremental assignment path — the recovered
+    quantizer state equals the live one bit-for-bit."""
+    from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
+
+    metrics = Metrics()
+    g1, q1, emb, rng = build_gallery(rows=512, dim=16, nlist=8, seed=4,
+                                     metrics=metrics)
+    state1 = StateLifecycle(str(tmp_path), metrics=metrics)
+    state1.bind(g1, [])
+    assert state1.checkpoint_now(wait=True)  # checkpoint + sidecar
+    assert metrics.counter(mn.IVF_SIDECAR_WRITES) == 1
+    # acknowledged enrollments AFTER the checkpoint -> WAL only
+    live_rows = []
+    for i in range(3):
+        new = _unit(rng.normal(size=(2 + i, 16)))
+        live_rows.append(new)
+        state1.append_enrollment(
+            new, np.arange(g1.size, g1.size + len(new), dtype=np.int32),
+            apply_fn=lambda new=new: g1.add(
+                new, np.arange(g1.size, g1.size + len(new), dtype=np.int32)))
+    assign_live = q1._h_assign.copy()
+    v_live = {f: np.asarray(getattr(q1.data, f))
+              for f in ("cell_rows", "cell_q8", "cell_scale", "spill_rows")}
+
+    # crash + recover into a FRESH gallery/quantizer/lifecycle
+    metrics2 = Metrics()
+    g2 = ShardedGallery(capacity=512, dim=16, mesh=mesh1())
+    q2 = CoarseQuantizer(nlist=8, nprobe=8, seed=4, kmeans_iters=5,
+                         train_sample=512, metrics=metrics2)
+    g2.attach_quantizer(q2, mode="ivf")
+    state2 = StateLifecycle(str(tmp_path), metrics=metrics2)
+    report = state2.recover(g2, [])
+    assert report["quantizer_sidecar"] == "loaded"
+    assert report["replayed_records"] == 3
+    assert metrics2.counter(mn.IVF_SIDECAR_LOADS) == 1
+    assert q2.ready
+    np.testing.assert_array_equal(q1._h_centroids, q2._h_centroids)
+    n = min(len(assign_live), len(q2._h_assign))
+    np.testing.assert_array_equal(assign_live[:n], q2._h_assign[:n])
+    for f, want in v_live.items():
+        np.testing.assert_array_equal(want, np.asarray(getattr(q2.data, f)),
+                                      err_msg=f)
+    # and the recovered two-stage matcher finds the replayed rows at the
+    # exact gallery positions the live process enrolled them at
+    assert g2.size == g1.size
+    probe = live_rows[-1]  # the last enrollment's rows
+    start = g2.size - len(probe)
+    pad = np.tile(probe[-1], (8 - len(probe), 1))
+    _l, _s, idx = (np.asarray(v) for v in g2.match(
+        np.concatenate([probe, pad]), k=1))
+    assert np.array_equal(idx[:len(probe), 0],
+                          np.arange(start, start + len(probe)))
+
+
+def test_stale_sidecar_is_ignored(tmp_path):
+    """A sidecar whose wal_seq does not match the recovered checkpoint is
+    never trusted — recovery proceeds quantizer-less (retrain path)."""
+    from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
+
+    metrics = Metrics()
+    g1, q1, emb, rng = build_gallery(rows=256, dim=16, nlist=8, seed=2,
+                                     metrics=metrics)
+    state1 = StateLifecycle(str(tmp_path), metrics=metrics)
+    state1.bind(g1, [])
+    assert state1.checkpoint_now(wait=True)
+    # a LATER enrollment + checkpoint WITHOUT a quantizer would bump
+    # wal_seq; simulate staleness by rewriting the sidecar with a bogus seq
+    payload = g1.snapshot_quantizer()
+    with open(state1.sidecar_path, "wb") as fh:
+        fh.write(encode_sidecar(payload, wal_seq=999))
+    metrics2 = Metrics()
+    g2 = ShardedGallery(capacity=256, dim=16, mesh=mesh1())
+    q2 = CoarseQuantizer(nlist=8, nprobe=8, seed=2, kmeans_iters=5,
+                         train_sample=256, metrics=metrics2)
+    g2.attach_quantizer(q2, mode="ivf")
+    state2 = StateLifecycle(str(tmp_path), metrics=metrics2)
+    report = state2.recover(g2, [])
+    assert "quantizer_sidecar" not in report
+    assert metrics2.counter(mn.IVF_SIDECAR_STALE) == 1
+    assert not q2.ready
+    # serving still works (exact fallback) while the retrain is pending
+    _l, _s, idx = (np.asarray(v) for v in g2.match(emb[:8], k=1))
+    assert np.array_equal(idx[:, 0], np.arange(8))
+
+
+def test_corrupt_sidecar_fails_closed(tmp_path):
+    from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
+
+    metrics = Metrics()
+    g1, q1, emb, rng = build_gallery(rows=256, dim=16, nlist=8, seed=6,
+                                     metrics=metrics)
+    state1 = StateLifecycle(str(tmp_path), metrics=metrics)
+    state1.bind(g1, [])
+    assert state1.checkpoint_now(wait=True)
+    blob = open(state1.sidecar_path, "rb").read()
+    with open(state1.sidecar_path, "wb") as fh:
+        fh.write(blob[:len(blob) // 2])  # torn write
+    with pytest.raises(SidecarError):
+        decode_sidecar(blob[:len(blob) // 2])
+    metrics2 = Metrics()
+    g2 = ShardedGallery(capacity=256, dim=16, mesh=mesh1())
+    q2 = CoarseQuantizer(nlist=8, nprobe=8, seed=6, kmeans_iters=5,
+                         train_sample=256, metrics=metrics2)
+    g2.attach_quantizer(q2, mode="ivf")
+    state2 = StateLifecycle(str(tmp_path), metrics=metrics2)
+    state2.recover(g2, [])
+    assert not q2.ready
+    assert metrics2.counter(mn.IVF_SIDECAR_ERRORS) == 1
+
+
+# -------------------------------------------- lifecycle: invalidation
+
+def test_swap_from_invalidates_and_falls_back_exact():
+    g, q, emb, rng = build_gallery(rows=512, dim=16, nlist=8)
+    other = ShardedGallery(capacity=512, dim=16, mesh=mesh1())
+    emb2 = _unit(rng.normal(size=(64, 16)))
+    other.add(emb2, np.arange(64, dtype=np.int32))
+    pre_swap_data = g.data
+    g.swap_from(other)
+    assert not q.ready
+    assert not g._ivf_enabled()
+    _l, _s, idx = (np.asarray(v) for v in g.match(emb2[:8], k=1))
+    assert np.array_equal(idx[:, 0], np.arange(8))  # exact path serves
+    # a retrain over the swapped-in rows restores the two-stage path
+    assert q.rebuild_now()
+    assert g._ivf_enabled()
+    _l, _s, idx = (np.asarray(v) for v in g.match(emb2[:8], k=1))
+    assert np.array_equal(idx[:, 0], np.arange(8))
+    # epoch cross-check: the POST-swap quantizer snapshot must never pair
+    # with a PRE-swap gallery snapshot a slow reader may still hold —
+    # scoring the old rows against the new lists would be a silent
+    # misrecognition, so _ivf_data rejects the cross-epoch pair.
+    assert q.data.gallery_epoch == g.data.epoch != pre_swap_data.epoch
+    assert g._ivf_data(pre_swap_data) is None
+    assert g._ivf_data(g.data) is not None
+
+
+def test_reset_invalidates():
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8)
+    g.reset()
+    assert not q.ready
+
+
+def test_spill_overflow_invalidates_never_drops(monkeypatch):
+    """When a cell AND the spill are full, the quantizer refuses to
+    silently miss the row: it invalidates (exact serving) instead."""
+    metrics = Metrics()
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8,
+                                   metrics=metrics)
+    # exhaust the spill artificially, then force a full cell
+    q._spill_count = q.data.spill_cap
+    full_cell = int(np.argmax(q._h_counts))
+    q._h_counts[full_cell] = q.data.max_cell
+    row = np.asarray(q._h_centroids[full_cell], np.float32)
+    row = _unit(row[None, :])[0]
+    start = g.size
+    g.add(row[None, :], np.asarray([start], np.int32))
+    assert not q.ready  # invalidated, not silently dropped
+    assert metrics.counter(mn.IVF_INVALIDATIONS) == 1
+    _l, _s, idx = (np.asarray(v) for v in g.match(
+        np.tile(row, (8, 1)), k=1))
+    assert idx[0, 0] == start  # exact fallback still finds the row
+
+
+# ----------------------------------------------------- retrain chaos
+
+def test_failed_retrain_leaves_serving_intact(monkeypatch):
+    """Kill the k-means mid-retrain: the previous published quantizer (or
+    the exact path) keeps serving, the failure is counted, and the
+    single-flight guard is released for the next attempt."""
+    metrics = Metrics()
+    g, q, emb, rng = build_gallery(rows=512, dim=16, nlist=8,
+                                   metrics=metrics)
+    v_before = q.version
+
+    def boom(*a, **k):
+        raise RuntimeError("injected kmeans crash")
+
+    import opencv_facerecognizer_tpu.parallel.quantizer as quantizer_mod
+
+    monkeypatch.setattr(quantizer_mod, "_kmeans", boom)
+    assert q.rebuild_now() is False
+    assert metrics.counter(mn.IVF_BUILD_FAILURES) == 1
+    assert q.ready and q.version == v_before  # old state intact
+    queries = _unit(emb[:8] + 0.02 * rng.normal(size=(8, 16)))
+    _l, _s, idx = (np.asarray(v) for v in g.match(queries, k=1))
+    assert idx.shape == (8, 1)
+    assert not q._train_lock.locked()  # single-flight guard released
+    monkeypatch.undo()
+    assert q.rebuild_now()  # next attempt succeeds
+    assert q.version == v_before + 1
+
+
+def test_failed_retrain_before_first_build_serves_exact(monkeypatch):
+    metrics = Metrics()
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8,
+                                   metrics=metrics, build=False)
+
+    import opencv_facerecognizer_tpu.parallel.quantizer as quantizer_mod
+
+    monkeypatch.setattr(quantizer_mod, "_kmeans",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            RuntimeError("boom")))
+    assert q.rebuild_now() is False
+    assert not q.ready
+    _l, _s, idx = (np.asarray(v) for v in g.match(emb[:8], k=1))
+    assert np.array_equal(idx[:, 0], np.arange(8))
+
+
+def test_fenced_rebuild_refires_async(monkeypatch):
+    """An epoch bump landing mid-train (swap/load/reset whose own poke
+    was skipped as in-flight) must not leave the quantizer unbuilt
+    forever: the fenced-out attempt re-fires one async build against the
+    new row set."""
+    import time as time_mod
+
+    import opencv_facerecognizer_tpu.parallel.quantizer as quantizer_mod
+
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8, build=False)
+    real_kmeans = quantizer_mod._kmeans
+    fenced = []
+
+    def fence_once(*a, **k):
+        out = real_kmeans(*a, **k)
+        if not fenced:
+            fenced.append(True)
+            g.run_locked(lambda: setattr(g, "_epoch", g._epoch + 1))
+        return out
+
+    monkeypatch.setattr(quantizer_mod, "_kmeans", fence_once)
+    assert q.rebuild_now() is False  # this attempt was fenced out
+    deadline = time_mod.monotonic() + 60
+    while not q.ready and time_mod.monotonic() < deadline:
+        time_mod.sleep(0.05)
+    assert q.ready  # the re-fired attempt published against the new epoch
+    assert q.data.gallery_epoch == g._epoch
+
+
+def test_single_flight_retrain_guard():
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8,
+                                   metrics=Metrics())
+    assert q._train_lock.acquire(blocking=False)
+    try:
+        assert q.rebuild_now(wait=False) is False
+        assert q.maybe_rebuild_async() is False
+        assert q.metrics.counter(mn.IVF_RETRAINS_SKIPPED_INFLIGHT) == 2
+    finally:
+        q._train_lock.release()
+
+
+# -------------------------------------------------------- pipeline wiring
+
+def test_pipeline_threads_ivf_through_fused_step():
+    """The fused serving step must produce identical labels in ivf and
+    exact modes (perturbed-row queries, no near-ties) — proving the
+    IVFDeviceData pytree rides the jitted step as an argument."""
+    from opencv_facerecognizer_tpu.models.detector import CNNFaceDetector
+    from opencv_facerecognizer_tpu.models.embedder import (
+        FaceEmbedNet, init_embedder, normalize_faces,
+    )
+    from opencv_facerecognizer_tpu.parallel.pipeline import RecognitionPipeline
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+    face = (16, 16)
+    scenes, boxes, counts = make_synthetic_scenes(8, (64, 64), max_faces=2,
+                                                  seed=13)
+    det = CNNFaceDetector(features=(4, 8), head_features=8, max_faces=2,
+                          score_threshold=0.0)
+    det.train(scenes, boxes, counts, steps=30, batch_size=8)
+    net = FaceEmbedNet(embed_dim=16, stem_features=4, stage_features=(4, 8),
+                       stage_blocks=(1, 1))
+    params = init_embedder(net, num_classes=4, input_shape=face, seed=0)
+
+    rng = np.random.default_rng(5)
+    emb = _unit(rng.normal(size=(256, 16)))
+    gallery = ShardedGallery(capacity=256, dim=16, mesh=mesh1())
+    gallery.add(emb, np.arange(256, dtype=np.int32))
+    q = CoarseQuantizer(nlist=8, nprobe=8, seed=1, kmeans_iters=4,
+                        train_sample=256)
+    gallery.attach_quantizer(q, mode="ivf")
+    assert q.rebuild_now()
+
+    pipe = RecognitionPipeline(det, net, params["net"], gallery,
+                               face_size=face, top_k=1)
+    batch = scenes[:2]
+    res_ivf = pipe.recognize_batch(batch)
+    assert gallery._ivf_data(gallery.data) is not None
+    gallery.match_mode = "exact"
+    res_exact = pipe.recognize_batch(batch)
+    # two cache entries: the ivf and exact steps are distinct executables
+    assert len(pipe._step_cache) == 2
+    si = np.asarray(res_ivf.similarities).reshape(-1)
+    se = np.asarray(res_exact.similarities).reshape(-1)
+    ii = np.asarray(res_ivf.labels).reshape(-1)
+    ie = np.asarray(res_exact.labels).reshape(-1)
+    assert tie_aware_agreement(si, ii, se, ie) == 1.0
+
+
+# --------------------------------------------------------- tier-1 smoke
+
+def test_bench_ivf_smoke_gate():
+    """The committed recall gate: ``bench.py --ivf-smoke`` must exit 0 —
+    tier-1 runs this on every commit so a recall regression in the
+    two-stage path fails loud."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--ivf-smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    import json
+
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["ok"] and doc["ivf_enabled"]
+    assert doc["tie_aware_recall_at_1"] >= 0.99
+
+
+# -------------------------------------------------------------- comparator
+
+def test_tie_aware_comparator_semantics():
+    vals_a = np.asarray([0.9, 0.8, 0.7])
+    vals_b = np.asarray([0.9, 0.5, 0.7])
+    idx_a = np.asarray([1, 2, 3])
+    idx_b = np.asarray([5, 9, 3])
+    mism = tie_aware_mismatch(vals_a, idx_a, vals_b, idx_b)
+    # row 0: different idx, equal vals -> tie, accepted
+    # row 1: different idx, different vals -> REAL disagreement
+    # row 2: same idx -> agreement
+    assert mism.tolist() == [False, True, False]
+    assert tie_aware_agreement(vals_a, idx_a, vals_b, idx_b) == pytest.approx(2 / 3)
+
+
+def test_sidecar_roundtrip_and_default_nlist():
+    g, q, emb, rng = build_gallery(rows=256, dim=16, nlist=8)
+    payload = g.snapshot_quantizer()
+    blob = encode_sidecar(payload, wal_seq=17)
+    header, cent, assign = decode_sidecar(blob)
+    assert header["wal_seq"] == 17
+    np.testing.assert_array_equal(cent, payload["centroids"])
+    np.testing.assert_array_equal(assign, payload["assign"])
+    with pytest.raises(SidecarError):
+        decode_sidecar(b"garbage" + blob)
+    assert CoarseQuantizer.default_nlist(262_144) == 2048
+    assert CoarseQuantizer.default_nlist(10_000_000) == 16384
+    assert CoarseQuantizer.default_nlist(1) == 64

@@ -34,6 +34,7 @@ ATTR_HINTS: Dict[str, str] = {
     "metrics": "Metrics",
     "batcher": "FrameBatcher",
     "gallery": "ShardedGallery",
+    "quantizer": "CoarseQuantizer",
     "journal": "DeadLetterJournal",
     "drop_log": "DeadLetterJournal",
     "wal": "EnrollmentWAL",
