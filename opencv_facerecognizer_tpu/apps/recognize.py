@@ -278,7 +278,7 @@ def _load_stack(args):
                              store_dtype=(jnp.bfloat16
                                           if args.gallery_dtype == "bf16"
                                           else jnp.float32))
-    gallery.add(emb, labels)
+    gallery.add(emb, labels)  # ocvf-lint: boundary=wal-before-mutate -- startup ingest of the model's frozen subject set, BEFORE recovery/serving; durable enrollments arrive later via StateLifecycle replay
     if args.match_mode == "ivf" and gallery_mesh.size > 1:
         # Fail fast, like the pp guard above: the two-stage path is
         # single-device (GSPMD cannot partition the bucket gather +

@@ -183,6 +183,7 @@ class RecognitionPipeline:
             )
 
         frames_sharding = NamedSharding(mesh, P(DP_AXIS, None, None))
+        # ocvf-lint: boundary=jit-recompile-hazard -- THE cache-keyed builder: every serving call reaches this jit only through _step_cache misses, and warmup/prewarm compile every ladder bucket + future tier up front
         return jax.jit(step, in_shardings=(None, None, None, None, None,
                                            frames_sharding, None))
 
@@ -265,7 +266,7 @@ class RecognitionPipeline:
                 return pack_result(step(det_p, emb_p, g_emb, g_valid,
                                         g_lab, fr, iv))
 
-            packed = self._packed_cache[key] = jax.jit(packed_step)
+            packed = self._packed_cache[key] = jax.jit(packed_step)  # ocvf-lint: boundary=jit-recompile-hazard -- packed-cache fill: warmup compiles every dispatch bucket, so serving only lands here on a genuinely new (shape, capacity, matcher) key
         return packed(
             self.detector.params,
             self.embed_params,
@@ -291,7 +292,7 @@ class RecognitionPipeline:
             zeros = np.zeros((b, *tuple(frame_shape)), dtype)
             out = self.recognize_batch_packed(zeros)
             if hasattr(out, "block_until_ready"):
-                out.block_until_ready()
+                out.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup runs BEFORE serving starts; blocking here is the point (compiles must land before the first real frame)
             built += 1
         return built
 
@@ -357,6 +358,7 @@ class RecognitionPipeline:
             ivf_arg = ivf if ivf is not None else ()
             # Execute each once: jit compiles per concrete shape; block so
             # the caller (grow worker) only installs AFTER compiles landed.
+            # ocvf-lint: boundary=host-sync -- prewarm runs on the gallery's grow-worker thread, never the serving loop; the block IS the contract (install only after compiles landed)
             jax.block_until_ready(step(
                 self.detector.params, self.embed_params,
                 scratch_emb, scratch_val, scratch_lab, frames, ivf_arg,
@@ -367,8 +369,8 @@ class RecognitionPipeline:
                 return pack_result(_step(det_p, emb_p, g_emb, g_valid,
                                          g_lab, fr, iv))
 
-            packed = jax.jit(packed_step)
-            packed(
+            packed = jax.jit(packed_step)  # ocvf-lint: boundary=jit-recompile-hazard -- prewarm builder on the grow-worker thread: compiles the future tier so the serving thread never does
+            packed(  # ocvf-lint: boundary=host-sync -- prewarm executes+blocks off the serving loop; install happens only after the compile landed
                 self.detector.params, self.embed_params,
                 scratch_emb, scratch_val, scratch_lab, frames, ivf_arg,
             ).block_until_ready()

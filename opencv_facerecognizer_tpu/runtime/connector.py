@@ -192,7 +192,7 @@ class JSONLConnector(_TopicDispatchConnector):
         if self._out is None:
             return
         line = json.dumps({"topic": topic, "data": message})
-        with self._lock:  # ocvf-lint: disable-block=blocking-under-lock -- this transport lock EXISTS to serialize whole lines onto the stream; no serving-path lock nests inside it
+        with self._lock:  # ocvf-lint: boundary-block=blocking-under-lock -- this transport lock EXISTS to serialize whole lines onto the stream; no serving-path lock nests inside it
             try:
                 self._out.write(line + "\n")
                 self._out.flush()

@@ -176,7 +176,7 @@ class _ReadbackBlocker:
                     self._cv.wait()
                 arr = self._pending
             try:
-                arr.block_until_ready()
+                arr.block_until_ready()  # ocvf-lint: boundary=host-sync -- THE designed readback wait: this sacrificial blocker thread exists so the worker's wait stays deadline-bounded; the serving loop itself never blocks
                 self._ok = True
             except Exception:  # ocvf-lint: disable=swallowed-exception -- failure IS recorded: _ok=False is read by block(), whose caller classifies the outage and dead-letters the batch
                 self._ok = False
@@ -362,7 +362,7 @@ class RecognizerService:
 
         import jax
 
-        self._embed_chunk = jax.jit(_embed_chunk)
+        self._embed_chunk = jax.jit(_embed_chunk)  # ocvf-lint: boundary=jit-recompile-hazard -- built once at construction for ONE fixed chunk shape; warmup() compiles it before serving starts
         # Placement override for the enrolment graph. None = default
         # backend. rebuild_pipeline_on_cpu pins this to the CPU device it
         # rebuilt on: the bare jit above takes uncommitted numpy inputs
@@ -672,11 +672,11 @@ class RecognizerService:
                                  self.batcher.dtype)
                 out = self.pipeline.recognize_batch_packed(zeros)
                 if hasattr(out, "block_until_ready"):
-                    out.block_until_ready()
+                    out.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup precedes start(): blocking until every ladder bucket is compiled is the contract
         chunk = np.zeros((self._enrol_chunk, *self.pipeline.face_size), np.float32)
         emb = self._run_embed_chunk(self.pipeline.embed_params, chunk)
         if hasattr(emb, "block_until_ready"):
-            emb.block_until_ready()
+            emb.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup precedes start(); the enrolment graph must be compiled before the first enroll command
         self.metrics.observe(mn.WARMUP, time.perf_counter() - t0)
 
     def drain(self, timeout: float = 120.0) -> bool:
@@ -1198,7 +1198,7 @@ class RecognizerService:
           the supervisor restarts the thread.
         """
         try:
-            arr = np.asarray(packed)
+            arr = np.asarray(packed)  # ocvf-lint: boundary=host-sync -- THE one per-batch materialize (PR 2's packed single-readback design); runs on the readback worker / post-is_ready drain, never ahead of readiness
         except Exception:  # noqa: BLE001 — outage error carried by the array
             logging.getLogger(__name__).exception(
                 "readback materialize failed")
@@ -1304,7 +1304,7 @@ class RecognizerService:
 
         face_size = self.pipeline.face_size
         crops = np.stack(
-            [np.asarray(image_ops.resize(c, face_size)) for c in enrolment.crops]
+            [np.asarray(image_ops.resize(c, face_size)) for c in enrolment.crops]  # ocvf-lint: boundary=host-sync -- enrolment readback: _finish_enrolment runs on its own daemon thread, off the serving loop by design
         )
         # Embed in fixed-size padded chunks (pre-compiled in warmup()).
         embeddings = []
@@ -1312,7 +1312,7 @@ class RecognizerService:
             part = crops[start : start + self._enrol_chunk]
             padded = np.zeros((self._enrol_chunk, *face_size), np.float32)
             padded[: len(part)] = part
-            emb = np.array(self._run_embed_chunk(self.pipeline.embed_params,
+            emb = np.array(self._run_embed_chunk(self.pipeline.embed_params,  # ocvf-lint: boundary=host-sync -- enrolment embed readback on the dedicated enrolment thread; frame batches keep flowing while this blocks
                                                  padded))
             embeddings.append(emb[: len(part)])
         emb = np.concatenate(embeddings)
@@ -1337,7 +1337,7 @@ class RecognizerService:
                     label=label,
                     apply_fn=lambda: self.pipeline.gallery.add(emb, labels_arr))
             else:
-                self.pipeline.gallery.add(emb, labels_arr)
+                self.pipeline.gallery.add(emb, labels_arr)  # ocvf-lint: boundary=wal-before-mutate -- explicit no-state-dir mode: nothing durable exists to sequence against, and the operator chose volatility
             grown = self.pipeline.gallery.grow_count - before_grow
             if grown:
                 # Auto-grow saved the enrolment but forced a recompile-sized

@@ -243,7 +243,7 @@ class TheTrainer:
         capacity = capacity or max(2 * len(emb), 64)
         gallery = ShardedGallery(capacity=capacity, dim=emb.shape[1], mesh=mesh,
                                  store_dtype=store_dtype)
-        gallery.add(emb, np.asarray(labels, np.int32))
+        gallery.add(emb, np.asarray(labels, np.int32))  # ocvf-lint: boundary=wal-before-mutate -- offline gallery BUILD from training data: the result is persisted wholesale via a checkpoint, not row-by-row enrollment; no WAL exists yet
         return gallery
 
 

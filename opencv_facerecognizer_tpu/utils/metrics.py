@@ -90,7 +90,7 @@ class Metrics:
         # I/O deliberately held under the sink lock: serializing writers is
         # this lock's entire purpose and nothing on the counter path ever
         # takes it.
-        with self._sink_lock:  # ocvf-lint: disable-block=blocking-under-lock -- sink lock exists solely to serialize sink writes; counter paths never take it
+        with self._sink_lock:  # ocvf-lint: boundary-block=blocking-under-lock -- sink lock exists solely to serialize sink writes; counter paths never take it
             self._sink.write(line + "\n")
             self._sink.flush()
 

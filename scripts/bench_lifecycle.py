@@ -104,7 +104,7 @@ def main():
     # section); this artifact must measure the configuration that ships.
     gallery = ShardedGallery(capacity=16384, dim=dim, mesh=mesh,
                              async_grow=True, store_dtype=jnp.bfloat16)
-    gallery.add(rng.standard_normal((16384, dim), dtype=np.float32),
+    gallery.add(rng.standard_normal((16384, dim), dtype=np.float32),  # ocvf-lint: boundary=wal-before-mutate -- bench fixture: synthetic throwaway gallery, no state dir, nothing durable at stake
                 rng.integers(0, 512, 16384).astype(np.int32))
     pipeline = RecognitionPipeline(det, net, emb_params, gallery,
                                    face_size=SERVING_FACE_SIZE)
@@ -154,7 +154,7 @@ def main():
         rows = rng.standard_normal((need, dim), dtype=np.float32)
         labs = rng.integers(0, 512, need).astype(np.int32)
         t_add0 = time.perf_counter()
-        gallery.add(rows, labs)
+        gallery.add(rows, labs)  # ocvf-lint: boundary=wal-before-mutate -- bench fixture: the measured grow path itself, synthetic rows, no durability contract
         add_return_ms = (time.perf_counter() - t_add0) * 1e3
         # serve continuously until the grow lands; record every call
         during = []

@@ -72,7 +72,7 @@ def build_stack(frame_shape=(64, 64), face=(16, 16), capacity=64, seed=0):
     gallery = ShardedGallery(capacity=capacity, dim=16, mesh=mesh)
     g_rng = np.random.default_rng(seed)
     emb = g_rng.normal(size=(8, 16)).astype(np.float32)
-    gallery.add(emb, np.arange(8, dtype=np.int32) % 4)
+    gallery.add(emb, np.arange(8, dtype=np.int32) % 4)  # ocvf-lint: boundary=wal-before-mutate -- pre-lifecycle seed rows for the soak stack; the recovery scenario's durable enrollments all ride append_enrollment below
     pipe = RecognitionPipeline(det, net, params["net"], gallery, face_size=face)
     return pipe, mesh
 

@@ -78,7 +78,7 @@ def main(argv=None):
     # bf16 rows: the ocvf-recognize serving default (gallery_dtype A/B)
     gallery = ShardedGallery(capacity=16384, dim=dim, mesh=make_mesh(),
                              store_dtype=jnp.bfloat16)
-    gallery.add(rng.normal(size=(16384, dim)).astype(np.float32),
+    gallery.add(rng.normal(size=(16384, dim)).astype(np.float32),  # ocvf-lint: boundary=wal-before-mutate -- probe fixture: synthetic gallery for dispatch timing, no state dir
                 rng.integers(0, 512, 16384).astype(np.int32))
     pipe = RecognitionPipeline(det, net, emb_params, gallery,
                                face_size=SERVING_FACE_SIZE)

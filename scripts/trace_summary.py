@@ -71,7 +71,7 @@ def build_pipeline(batch, h, w, max_faces, dim, tiny=False):
     # bf16 rows: the ocvf-recognize serving default (gallery_dtype A/B)
     gallery = ShardedGallery(capacity=cap, dim=dim, mesh=make_mesh(),
                              store_dtype=jnp.bfloat16)
-    gallery.add(rng.normal(size=(cap, dim)).astype(np.float32),
+    gallery.add(rng.normal(size=(cap, dim)).astype(np.float32),  # ocvf-lint: boundary=wal-before-mutate -- trace fixture: synthetic gallery, traces are the artifact, nothing durable
                 rng.integers(0, 512, cap).astype(np.int32))
     pipe = RecognitionPipeline(det, net, emb_params, gallery,
                                face_size=face)

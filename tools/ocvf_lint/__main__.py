@@ -1,8 +1,10 @@
-"""CLI: ``python -m tools.ocvf_lint [--json] [--rules a,b] PATH...``
+"""CLI: ``python -m tools.ocvf_lint [--json|--sarif] [--rules a,b]
+[--baseline F [--update-baseline]] [--no-cache] PATH...``
 
 Exit codes (stable, scripted against by scripts/run_lint.sh and CI):
-  0 — clean (no findings)
-  1 — findings reported
+  0 — clean (no findings; with --baseline: no count above its frozen limit)
+  1 — findings reported (with --baseline: a rule regressed past its limit,
+      or --update-baseline refused to grow a count)
   2 — internal error (bad invocation, crash in the linter itself)
 """
 
@@ -19,15 +21,31 @@ from tools.ocvf_lint import core
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.ocvf_lint",
-        description="AST-based concurrency & durability lint for the "
-                    "opencv_facerecognizer_tpu serving runtime.")
+        description="AST-based concurrency, durability & JAX-dataflow lint "
+                    "for the opencv_facerecognizer_tpu serving runtime.")
     parser.add_argument("paths", nargs="*", help="files or directories to lint")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable output on stdout")
+    parser.add_argument("--sarif", action="store_true",
+                        help="SARIF 2.1.0 output on stdout (CI annotations)")
     parser.add_argument("--rules", default=None,
                         help="comma-separated subset of rules to run")
     parser.add_argument("--list-rules", action="store_true",
                         help="print registered rules and exit 0")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="ratchet file (LINT_BASELINE.json): exit 0 while "
+                             "every rule's finding count is <= its frozen "
+                             "count; counts may only shrink")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite --baseline with current counts "
+                             "(refuses to grow any count)")
+    parser.add_argument("--baseline-allow-growth", action="store_true",
+                        help="let --update-baseline raise a frozen count "
+                             "(use only when landing a new rule)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the incremental content-hash cache")
+    parser.add_argument("--cache-dir", default=None, metavar="DIR",
+                        help="cache directory (default: ./.ocvf_lint_cache)")
     args = parser.parse_args(argv)
 
     try:
@@ -38,13 +56,42 @@ def main(argv=None) -> int:
             return 0
         if not args.paths:
             parser.error("no paths given (or use --list-rules)")
+        if args.json and args.sarif:
+            parser.error("--json and --sarif are mutually exclusive")
+        if args.update_baseline and not args.baseline:
+            parser.error("--update-baseline requires --baseline FILE")
         rules = [r.strip() for r in args.rules.split(",")] if args.rules else None
         if rules:
             unknown = [r for r in rules if r not in core.REGISTRY]
             if unknown:
                 print(f"unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
                 return 2
-        result = core.run(args.paths, rules=rules)
+        cache = None
+        if not args.no_cache:
+            from tools.ocvf_lint.cache import DEFAULT_CACHE_DIR, LintCache
+            cache = LintCache(args.cache_dir or DEFAULT_CACHE_DIR)
+        result = core.run(args.paths, rules=rules, cache=cache)
+
+        baseline_rc = None
+        baseline_notes = []
+        if args.baseline:
+            from tools.ocvf_lint import baseline as baseline_mod
+            counts = result.rule_counts()
+            if args.update_baseline:
+                err = baseline_mod.update(
+                    args.baseline, counts, list(result.rules),
+                    allow_growth=args.baseline_allow_growth)
+                if err:
+                    print(f"ocvf-lint: {err}", file=sys.stderr)
+                    return 1
+                print(f"ocvf-lint: baseline written to {args.baseline}",
+                      file=sys.stderr)
+                return 0
+            allowed = baseline_mod.load(args.baseline)
+            regressions, improvements = baseline_mod.compare(counts, allowed)
+            baseline_notes = [f"REGRESSION {r}" for r in regressions] + \
+                             [f"note: {i}" for i in improvements]
+            baseline_rc = 1 if regressions else 0
     except SystemExit:
         raise
     except FileNotFoundError as exc:
@@ -55,17 +102,34 @@ def main(argv=None) -> int:
         return 2
 
     if args.json:
-        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+        doc = result.to_dict()
+        if args.baseline:
+            doc["baseline"] = {"path": args.baseline,
+                               "regressed": baseline_rc == 1,
+                               "notes": baseline_notes}
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    elif args.sarif:
+        from tools.ocvf_lint.sarif import to_sarif
+        print(json.dumps(to_sarif(result, core.REGISTRY), indent=2,
+                         sort_keys=True))
     else:
         for finding in result.findings:
             print(finding.format())
             for path, line in finding.also:
                 print(f"    also involves {path}:{line}")
+        for note in baseline_notes:
+            print(f"ocvf-lint: {note}", file=sys.stderr)
+        cache_note = ""
+        if result.cache.get("run_hit"):
+            cache_note = "; cached run"
         print(f"ocvf-lint: {len(result.findings)} finding(s) in "
               f"{result.files_scanned} file(s) scanned "
-              f"({result.suppressions_used} justified suppression(s) honored; "
-              f"rules: {', '.join(result.rules)})",
+              f"({result.suppressions_used} justified suppression(s) and "
+              f"{result.boundaries_used} annotated boundary(ies) honored; "
+              f"rules: {', '.join(result.rules)}{cache_note})",
               file=sys.stderr)
+    if baseline_rc is not None:
+        return baseline_rc
     return 1 if result.findings else 0
 
 

@@ -92,6 +92,17 @@ def _walk_expr(node: ast.AST, stack: Tuple[str, ...]):
             yield from _walk_expr(child, stack)
 
 
+def terminal_attr(expr: ast.expr) -> Optional[str]:
+    """The terminal attribute/name of a receiver expression
+    (``self.pipeline.gallery`` -> ``gallery``, ``gallery`` -> ``gallery``)
+    — the ONE helper every wiring-based receiver test goes through."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
 def dotted_call_name(func: ast.expr) -> Optional[str]:
     """``a.b.c`` for an Attribute chain of Names, else None."""
     parts: List[str] = []

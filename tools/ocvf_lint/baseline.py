@@ -1,0 +1,92 @@
+"""The checked-in ratchet: ``LINT_BASELINE.json`` freezes a per-rule
+finding count that may only SHRINK.
+
+New rules land with whatever real findings survive triage frozen into the
+baseline; the gate then fails on any rule whose live count exceeds its
+frozen count — so the tree can only get cleaner, and a new hazard in
+previously-clean territory fails CI even while an old, accepted one is
+being paid down.  ``--update-baseline`` refuses to grow a count (that is
+the ratchet); shrinking is always allowed and should be committed."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_BASELINE = "LINT_BASELINE.json"
+_VERSION = 1
+
+
+def load(path: str) -> Dict[str, int]:
+    """The per-rule allowed counts.  A missing rule means 0 allowed."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict) or not isinstance(doc.get("rules"), dict):
+        raise ValueError(f"{path}: not a lint baseline (missing 'rules' map)")
+    return {str(k): int(v) for k, v in doc["rules"].items()}
+
+
+def compare(rule_counts: Dict[str, int], allowed: Dict[str, int]
+            ) -> Tuple[List[str], List[str]]:
+    """(regressions, improvements) as human-readable lines."""
+    regressions: List[str] = []
+    improvements: List[str] = []
+    for rule in sorted(set(rule_counts) | set(allowed)):
+        have = rule_counts.get(rule, 0)
+        limit = allowed.get(rule, 0)
+        if have > limit:
+            regressions.append(
+                f"{rule}: {have} finding(s), baseline allows {limit}")
+        elif have < limit:
+            improvements.append(
+                f"{rule}: {have} finding(s), baseline still reserves {limit} "
+                f"— ratchet down with --update-baseline")
+    return regressions, improvements
+
+
+def update(path: str, rule_counts: Dict[str, int], all_rules: List[str],
+           allow_growth: bool = False) -> Optional[str]:
+    """Write the baseline with the current counts.  Returns an error
+    message (and writes nothing) when a count would GROW and
+    ``allow_growth`` is False — fix or suppress instead of re-freezing."""
+    existing: Dict[str, int] = {}
+    if os.path.exists(path):
+        try:
+            existing = load(path)
+        except (OSError, ValueError) as exc:
+            # A corrupt baseline must never silently disable the ratchet:
+            # rewriting from scratch would freeze every current finding in.
+            if not allow_growth:
+                return (f"baseline {path} is unreadable ({exc}) — restore it "
+                        f"from version control, or pass "
+                        f"--baseline-allow-growth to rebuild from scratch")
+            existing = {}
+    if not allow_growth:
+        grew = [r for r in rule_counts
+                if rule_counts[r] > existing.get(r, 0) and existing]
+        if grew:
+            return ("baseline ratchet: refusing to grow "
+                    + ", ".join(f"{r} ({existing.get(r, 0)} -> {rule_counts[r]})"
+                                for r in sorted(grew))
+                    + " — fix the findings or suppress with justification "
+                      "(--baseline-allow-growth overrides)")
+    # Merge over the existing baseline: a --rules subset run must update
+    # only the rules it actually measured, never wipe the others' frozen
+    # counts (a missing rule reads as 0 allowed — losing a reserve would
+    # silently fail the next full gate run).
+    merged = {r: int(v) for r, v in existing.items()}
+    merged.update({r: int(rule_counts.get(r, 0)) for r in all_rules})
+    doc = {
+        "version": _VERSION,
+        "comment": "per-rule finding counts frozen by the ocvf-lint ratchet; "
+                   "counts may only shrink (scripts/run_lint.sh, "
+                   "tests/test_lint.py enforce)",
+        "rules": {r: merged[r] for r in sorted(merged)},
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:  # ocvf-lint: disable=non-atomic-write -- tmp+rename IS the atomic pattern; this file is outside the package tree anyway
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return None

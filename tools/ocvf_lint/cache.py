@@ -1,0 +1,127 @@
+"""Per-file incremental cache keyed on content hashes.
+
+Two layers, both invalidated by a fingerprint of the linter's OWN sources
+(editing a checker must never replay stale findings):
+
+- **run layer** — the common CI case: nothing changed since the last gate
+  run, so the whole ``RunResult`` replays from one hash lookup.
+- **file layer** — content-addressed per-file findings for ``scope ==
+  "file"`` checkers; an edit to one file re-walks only that file (plus the
+  project-scope analyses, which by definition need the whole tree).
+
+Everything is one JSON file under the cache dir, written atomically
+(tmp + ``os.replace``) so a crashed run can never leave a torn cache — a
+torn/unreadable cache is treated as empty, never an error."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: bound the layers so the cache file cannot grow without limit
+_MAX_RUNS = 8
+_MAX_FILES = 2048
+
+DEFAULT_CACHE_DIR = ".ocvf_lint_cache"
+
+
+def tool_fingerprint() -> str:
+    """sha256 over the linter's own source files — any edit to a checker,
+    the core, or this module invalidates every cached result."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    digest = hashlib.sha256()
+    for dirpath, dirs, names in os.walk(root):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(names):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            digest.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as fh:
+                digest.update(fh.read())
+    return digest.hexdigest()
+
+
+class LintCache:
+    def __init__(self, cache_dir: str = DEFAULT_CACHE_DIR):
+        self.path = os.path.join(cache_dir, "cache.json")
+        self.fingerprint = tool_fingerprint()
+        self._dirty = False
+        self.data = {"tool": self.fingerprint, "files": {}, "runs": {}}
+        try:
+            with open(self.path, "r", encoding="utf-8") as fh:
+                loaded = json.load(fh)
+            if (isinstance(loaded, dict)
+                    and loaded.get("tool") == self.fingerprint):
+                self.data = loaded
+        except (OSError, ValueError):
+            pass  # absent/torn/stale cache == empty cache
+
+    # ---- keys ----
+
+    def run_key(self, rules: Sequence[str],
+                file_hashes: Sequence[Tuple[str, str]],
+                extra: str = "") -> str:
+        """``extra`` carries checker-declared out-of-tree inputs (the
+        metrics registry read as a fallback) — a verdict can depend on
+        files that are not in ``file_hashes``."""
+        digest = hashlib.sha256()
+        digest.update(",".join(rules).encode())
+        for path, h in file_hashes:
+            digest.update(f"\n{path}\0{h}".encode())
+        digest.update(b"\x00extra\x00" + extra.encode())
+        return digest.hexdigest()
+
+    # ---- run layer ----
+
+    def get_run(self, key: str) -> Optional[dict]:
+        return self.data["runs"].get(key)
+
+    def store_run(self, key: str, result: dict) -> None:
+        runs = self.data["runs"]
+        runs[key] = result
+        while len(runs) > _MAX_RUNS:
+            runs.pop(next(iter(runs)))
+        self._dirty = True
+
+    # ---- file layer ----
+
+    def get_file(self, file_hash: str, rules: Sequence[str]
+                 ) -> Optional[Dict[str, List[dict]]]:
+        """The per-rule finding dicts for this (path, content) key — the
+        caller hashes BOTH, because path-dependent rules make identical
+        content mean different things at different locations — or None
+        unless EVERY requested rule is present (a partial entry must not
+        hide the missing rule's findings)."""
+        entry = self.data["files"].get(file_hash)
+        if entry is None or any(rule not in entry for rule in rules):
+            return None
+        return {rule: entry[rule] for rule in rules}
+
+    def store_file(self, file_hash: str,
+                   per_rule: Dict[str, List[dict]]) -> None:
+        files = self.data["files"]
+        entry = files.setdefault(file_hash, {})
+        entry.update(per_rule)
+        while len(files) > _MAX_FILES:
+            files.pop(next(iter(files)))
+        self._dirty = True
+
+    # ---- persistence ----
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        directory = os.path.dirname(self.path) or "."
+        try:
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(self.data, fh)
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except OSError:
+            pass  # read-only checkout: run uncached, never fail the lint

@@ -2,8 +2,13 @@
 
 from tools.ocvf_lint.checkers import (  # noqa: F401
     blocking_under_lock,
+    epoch_pairing,
+    host_sync,
+    jit_recompile_hazard,
     lock_order,
     metrics_registry,
     non_atomic_write,
+    prng_discipline,
     swallowed_exception,
+    wal_before_mutate,
 )

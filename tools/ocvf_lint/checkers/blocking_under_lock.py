@@ -33,6 +33,10 @@ class BlockingUnderLockChecker(Checker):
     rule = "blocking-under-lock"
     description = ("time.sleep / file or socket I/O / fsync / JAX dispatch "
                    "inside a held-lock region")
+    #: sites where holding the lock THROUGH the I/O is the invariant (WAL
+    #: fsync-before-ack, transport write serialization) are sanctioned
+    #: boundaries — the same annotation mechanism host-sync uses.
+    boundary_capable = True
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         findings: List[Finding] = []

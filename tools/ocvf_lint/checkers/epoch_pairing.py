@@ -1,0 +1,101 @@
+"""epoch-pairing: gallery/quantizer reads must flow through the
+epoch-checked snapshot API.
+
+PR 6's two-stage matcher is only correct because every serving read takes
+ONE ``gallery.data`` snapshot and pairs it with ONE
+``gallery._ivf_data(data)`` quantizer read — the epoch cross-check inside
+``_ivf_data`` is what stops a ``swap_from`` + fast retrain between two
+non-atomic reads from scoring OLD rows against NEW inverted lists
+(plausible similarities, wrong identities).  Three ways code has
+historically broken protocols like this, three checks:
+
+1. Reaching into another object's ``_epoch``/``_data`` fields outside the
+   owner modules (``parallel/gallery.py``, ``parallel/quantizer.py``) —
+   those names are reserved for the protocol's own implementation.
+2. Reading ``<...>.quantizer.data`` (or ``._data``) directly: an
+   un-paired quantizer snapshot that no epoch check ties to the gallery
+   arrays it will be scored against.
+3. Reading two or more single-field gallery properties
+   (``.embeddings``/``.labels``/``.valid``) in one function: each is an
+   independent snapshot load, so the pair can straddle a concurrent swap
+   — take one ``gallery.data`` and use its fields."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from tools.ocvf_lint import wiring
+from tools.ocvf_lint.astutil import terminal_attr as _receiver_terminal
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+
+@register
+class EpochPairingChecker(Checker):
+    rule = "epoch-pairing"
+    description = ("direct access to epoch-guarded gallery/quantizer state "
+                   "(_epoch/_data, quantizer.data, mixed single-field "
+                   "reads) outside the snapshot API and its owner modules")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if wiring.path_matches(ctx.path, wiring.EPOCH_OWNER_SUFFIXES):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            # 1) reserved protocol fields on ANOTHER object (self._data in
+            # an unrelated class is that class's own business)
+            if node.attr in wiring.EPOCH_GUARDED_ATTRS and not (
+                    isinstance(node.value, ast.Name)
+                    and node.value.id == "self"):
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"direct access to epoch-guarded field "
+                    f"{_receiver_terminal(node.value) or '<expr>'}."
+                    f"{node.attr} outside parallel/gallery.py|quantizer.py "
+                    f"— reads must go through gallery.data / "
+                    f"gallery._ivf_data(data), which carry the epoch "
+                    f"pairing check"))
+            # 2) raw quantizer snapshot, un-paired with a gallery snapshot
+            elif node.attr in ("data", "_data") \
+                    and _receiver_terminal(node.value) == "quantizer":
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    "raw quantizer snapshot read (quantizer.data) — pair "
+                    "it with the gallery snapshot via "
+                    "gallery._ivf_data(data), or a swap+retrain between "
+                    "the two reads scores old rows against new inverted "
+                    "lists"))
+
+        # 3) mixed single-field gallery reads within one function scope
+        # (nested defs own their reads — they run at another time)
+        scopes: List[List[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            fields: Dict[str, ast.Attribute] = {}
+            stack: List[ast.AST] = list(body)
+            while stack:
+                node = stack.pop(0)
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # inner scope, scanned separately
+                stack.extend(ast.iter_child_nodes(node))
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.attr in wiring.GALLERY_FIELD_PROPS
+                        and _receiver_terminal(node.value)
+                        in wiring.GALLERY_RECEIVERS
+                        and node.attr not in fields):
+                    fields[node.attr] = node
+                    if len(fields) == 2:
+                        findings.append(ctx.finding(
+                            self.rule, node,
+                            f"second single-field gallery read "
+                            f"(.{node.attr}) in one function — each "
+                            f"property is an independent snapshot load, so "
+                            f"the fields can straddle a concurrent swap; "
+                            f"take one gallery.data snapshot and read its "
+                            f"fields"))
+        return findings

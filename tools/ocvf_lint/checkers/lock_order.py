@@ -29,21 +29,9 @@ from tools.ocvf_lint import astutil
 from tools.ocvf_lint.core import Checker, FileContext, Finding, register
 
 #: Known wiring of ``self.<attr>`` (or any ``x.<attr>``) to the class whose
-#: methods it dispatches to — the cross-module edges of the serving stack.
-ATTR_HINTS: Dict[str, str] = {
-    "metrics": "Metrics",
-    "batcher": "FrameBatcher",
-    "gallery": "ShardedGallery",
-    "quantizer": "CoarseQuantizer",
-    "journal": "DeadLetterJournal",
-    "drop_log": "DeadLetterJournal",
-    "wal": "EnrollmentWAL",
-    "state": "StateLifecycle",
-    "state_store": "StateLifecycle",
-    "checkpoints": "CheckpointStore",
-    "admission": "AdmissionController",
-    "connector": "JSONLConnector",
-}
+#: methods it dispatches to — ONE map for the whole suite, shared with the
+#: dataflow layer and every v2 checker (tools.ocvf_lint.wiring).
+from tools.ocvf_lint.wiring import ATTR_HINTS  # noqa: F401 — re-exported
 
 _CALL_DEPTH = 4
 
@@ -72,6 +60,7 @@ class LockOrderChecker(Checker):
     rule = "lock-order"
     description = ("inter-module lock-acquisition graph cycles/inversions "
                    "and nested same-lock re-acquisition")
+    scope = "project"  # the graph spans files; never cache per-file
 
     def __init__(self) -> None:
         self.classes: Dict[str, List[ClassInfo]] = {}  # class name -> defs

@@ -102,6 +102,7 @@ class MetricsRegistryChecker(Checker):
     description = ("metric names passed to Metrics.incr/observe/set_gauge "
                    "(and read sites) must come from "
                    "utils/metric_names.py")
+    scope = "project"  # validity depends on the registry file's content
 
     def __init__(self) -> None:
         self._registry_tree: Optional[ast.Module] = None
@@ -124,13 +125,33 @@ class MetricsRegistryChecker(Checker):
                 self._pending.append((ctx, imports, node, node.func.attr))
         return []
 
+    @staticmethod
+    def _fallback_registry_path() -> str:
+        here = os.path.dirname(os.path.abspath(__file__))
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+        return os.path.join(repo_root, "opencv_facerecognizer_tpu",
+                            "utils", "metric_names.py")
+
+    def extra_cache_fingerprint(self, files) -> str:
+        """When the registry is NOT among the linted files, the verdict
+        depends on the fallback registry read from disk — fold its content
+        into the run-cache key so editing utils/metric_names.py can never
+        replay a stale cached verdict for a subset lint."""
+        if any(f.replace("\\", "/").endswith(REGISTRY_SUFFIX) for f in files):
+            return ""  # in-tree: its content hash is already in the key
+        candidate = self._fallback_registry_path()
+        try:
+            with open(candidate, "rb") as fh:
+                import hashlib
+
+                return "metrics-registry:" + hashlib.sha256(fh.read()).hexdigest()
+        except OSError:
+            return "metrics-registry:absent"
+
     def _load_fallback_registry(self) -> None:
         if self._registry_tree is not None:
             return
-        here = os.path.dirname(os.path.abspath(__file__))
-        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
-        candidate = os.path.join(repo_root, "opencv_facerecognizer_tpu",
-                                 "utils", "metric_names.py")
+        candidate = self._fallback_registry_path()
         if os.path.exists(candidate):
             with open(candidate, "r", encoding="utf-8") as fh:
                 self._registry_tree = ast.parse(fh.read())

@@ -1,0 +1,213 @@
+"""prng-discipline: jax.random key hygiene.
+
+Two invariants, both load-bearing for the suite's reproducibility story
+(seeded k-means in the quantizer, seeded augmentation in the embedder,
+seed-logged chaos soaks whose failures must replay):
+
+1. **No key reuse.**  Passing one PRNG key to two sampling calls makes the
+   draws correlated (identical, for the same distribution) — the classic
+   silent jax.random bug.  Every additional draw needs a ``split`` (or a
+   distinct ``fold_in``).  ``split``/sampling each count as consuming the
+   key; ``fold_in(key, n)`` derives and is always fine.  A sampling call
+   inside a loop whose key was made outside (and is not re-split inside)
+   is the same bug wearing a ``for`` statement.
+
+2. **Deterministic seeds outside tests.**  A key seeded from wall-clock /
+   os.urandom / np.random makes quantizer training, augmentation and soak
+   schedules unreplayable; seeds must thread from configuration (the
+   chaos soak logs its seed for exactly this reason)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from tools.ocvf_lint import astutil
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+#: jax.random functions that DERIVE keys (never consume): safe any number
+#: of times on the same parent key.
+_DERIVE_FNS = frozenset({"fold_in", "key_data", "wrap_key_data", "clone"})
+#: producers: their result IS a fresh key (assignment targets become keys)
+_PRODUCER_FNS = frozenset({"PRNGKey", "key", "split", "fold_in"})
+
+_NONDET_RE = re.compile(
+    r"^(time\.(time|time_ns|monotonic|perf_counter)"
+    r"|os\.urandom|os\.getpid"
+    r"|secrets\.\w+|uuid\.uuid\w*"
+    r"|datetime\.)")
+
+
+def _np_aliases(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] == "numpy":
+                    out.add(alias.asname or "numpy")
+    return out
+
+
+def _random_fn(call: ast.Call, np_names: Set[str]) -> Optional[str]:
+    """The jax.random function name for this call, or None.  Matches
+    ``jax.random.X`` / ``random.X`` (``from jax import random``) /
+    ``jrandom.X`` style dotted names while excluding numpy's ``np.random``
+    namespace."""
+    dotted = astutil.dotted_call_name(call.func)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    if parts[0] in np_names:
+        return None
+    if len(parts) >= 2 and parts[-2] in ("random", "jrandom"):
+        return parts[-1]
+    if len(parts) == 2 and parts[0] in ("jrandom", "jrand"):
+        return parts[-1]
+    return None
+
+
+@register
+class PrngDisciplineChecker(Checker):
+    rule = "prng-discipline"
+    description = ("jax.random key reused without split, and "
+                   "nondeterministically-seeded keys outside tests")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        self._np = _np_aliases(ctx.tree)
+        self._in_tests = "tests" in ctx.path.replace("\\", "/").split("/")
+        findings: List[Finding] = []
+        self._scan_body(ctx, ctx.tree.body, findings)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_body(ctx, node.body, findings)
+        return findings
+
+    # ---- one scope (function or module body) ----
+
+    def _scan_body(self, ctx, body, findings: List[Finding]) -> None:
+        #: key var -> {"uses": int, "loop_depth": int}
+        keys: Dict[str, Dict[str, int]] = {}
+        self._walk(ctx, body, keys, findings, loop_depth=0,
+                   loop_assigned=[])
+
+    def _walk(self, ctx, body, keys, findings, loop_depth,
+              loop_assigned) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # scanned as its own scope
+            if isinstance(stmt, (ast.For, ast.While)):
+                assigned = {n.id for sub in ast.walk(stmt)
+                            if isinstance(sub, ast.Name)
+                            and isinstance(sub.ctx, ast.Store)
+                            for n in [sub]}
+                self._visit_exprs(ctx, stmt, keys, findings, loop_depth,
+                                  loop_assigned, header_only=True)
+                self._walk(ctx, stmt.body + stmt.orelse, keys, findings,
+                           loop_depth + 1, loop_assigned + [assigned])
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._visit_exprs(ctx, stmt.value, keys, findings,
+                                  loop_depth, loop_assigned)
+                fn = (self._random_call_fn(stmt.value)
+                      if isinstance(stmt.value, ast.Call) else None)
+                is_key = fn in _PRODUCER_FNS
+                for target in stmt.targets:
+                    self._assign(target, is_key, keys, loop_depth)
+                continue
+            # generic statement: visit expressions once, recurse into bodies
+            for field in ("test", "value", "iter", "exc"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, ast.expr):
+                    self._visit_exprs(ctx, sub, keys, findings, loop_depth,
+                                      loop_assigned)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list):
+                    self._walk(ctx, sub, keys, findings, loop_depth,
+                               loop_assigned)
+            for handler in getattr(stmt, "handlers", []):
+                self._walk(ctx, handler.body, keys, findings, loop_depth,
+                           loop_assigned)
+            for item in getattr(stmt, "items", []):
+                self._visit_exprs(ctx, item.context_expr, keys, findings,
+                                  loop_depth, loop_assigned)
+
+    def _assign(self, target, is_key: bool, keys, loop_depth: int) -> None:
+        if isinstance(target, ast.Name):
+            if is_key:
+                keys[target.id] = {"uses": 0, "loop_depth": loop_depth}
+            else:
+                keys.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, is_key, keys, loop_depth)
+
+    def _random_call_fn(self, call: ast.Call) -> Optional[str]:
+        return _random_fn(call, self._np)
+
+    def _visit_exprs(self, ctx, node, keys, findings, loop_depth,
+                     loop_assigned, header_only=False) -> None:
+        it = ([getattr(node, "iter", None), getattr(node, "test", None)]
+              if header_only and isinstance(node, (ast.For, ast.While))
+              else [node])
+        for root in it:
+            if not isinstance(root, ast.AST):
+                continue
+            for sub in ast.walk(root):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = self._random_call_fn(sub)
+                if fn is None:
+                    continue
+                if fn in ("PRNGKey", "key"):
+                    self._check_seed(ctx, sub, findings)
+                    continue
+                if fn in _DERIVE_FNS:
+                    continue
+                # sampling or split: consumes its first-arg key
+                if not sub.args or not isinstance(sub.args[0], ast.Name):
+                    continue
+                name = sub.args[0].id
+                state = keys.get(name)
+                if state is None:
+                    continue
+                reassigned_in_loop = any(name in assigned
+                                         for assigned in loop_assigned)
+                if state["uses"] >= 1:
+                    findings.append(ctx.finding(
+                        self.rule, sub,
+                        f"PRNG key {name!r} is consumed again by "
+                        f"jax.random.{fn} without an intervening split — "
+                        f"correlated draws; split (or fold_in) a fresh key "
+                        f"per sampling call"))
+                elif (loop_depth > state["loop_depth"]
+                        and not reassigned_in_loop):
+                    findings.append(ctx.finding(
+                        self.rule, sub,
+                        f"PRNG key {name!r} (created outside this loop) is "
+                        f"consumed by jax.random.{fn} every iteration — "
+                        f"identical draws per pass; split inside the loop"))
+                state["uses"] += 1
+
+    def _check_seed(self, ctx, call: ast.Call, findings) -> None:
+        if self._in_tests:
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = astutil.dotted_call_name(sub.func) or ""
+                parts = dotted.split(".")
+                nondet = bool(_NONDET_RE.match(dotted)) or (
+                    len(parts) >= 2 and parts[0] in self._np
+                    and parts[1] == "random")
+                if nondet:
+                    findings.append(ctx.finding(
+                        self.rule, call,
+                        f"PRNG key seeded from {dotted}() — "
+                        f"nondeterministic seeds make quantizer builds / "
+                        f"augmentation / soak schedules unreplayable; "
+                        f"thread a logged seed from configuration instead "
+                        f"(tests are exempt)"))
+                    return

@@ -32,6 +32,7 @@ ACCOUNTING_ATTRS = frozenset({
     "critical", "dead_letter", "_dead_letter", "record", "record_drop",
     "_count",      # the connectors' metrics shim (None-guarded incr)
     "put_nowait",  # pushing the failure onto a result/status queue
+    "print_exc",   # traceback.print_exc: the failure is fully visible
 })
 ACCOUNTING_NAMES = frozenset({"print"})
 

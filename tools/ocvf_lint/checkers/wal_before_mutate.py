@@ -1,0 +1,88 @@
+"""wal-before-mutate: persistent gallery/lifecycle mutations must ride the
+``StateLifecycle._enroll_lock`` -> ``append_enrollment`` path.
+
+PR 4's durability contract is *ack == durable*: an enrollment is
+acknowledged only after its WAL record is fsynced, and the gallery
+mutation happens as the ``apply_fn`` **inside** ``append_enrollment`` —
+under the enroll lock, after the append — so a crash anywhere replays it
+and a checkpoint can never snapshot unsequenced rows.  A bare
+``gallery.add(...)`` (or a direct WAL write) anywhere else silently
+reintroduces acknowledged-but-lost enrollments.
+
+Sanctioned forms, in decreasing order of preference:
+
+- ``state.append_enrollment(..., apply_fn=lambda: gallery.add(...))`` —
+  the lambda is recognized and exempt;
+- mutations inside ``runtime/state_store.py`` itself (replay/recovery);
+- genuinely non-durable galleries (bench fixtures, offline builds, the
+  explicit no-state-dir serving mode) annotated with
+  ``# ocvf-lint: boundary=wal-before-mutate -- <why nothing durable is at
+  stake>``."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from tools.ocvf_lint import wiring
+from tools.ocvf_lint.astutil import terminal_attr as _receiver_terminal
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+
+@register
+class WalBeforeMutateChecker(Checker):
+    rule = "wal-before-mutate"
+    description = ("gallery/WAL mutations outside the StateLifecycle "
+                   "_enroll_lock -> append_enrollment path")
+    boundary_capable = True
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if wiring.path_matches(ctx.path, wiring.WAL_EXEMPT_SUFFIXES):
+            return []
+        # spans of lambdas passed to append_enrollment(...) — the sanctioned
+        # apply_fn route (any argument position; apply_fn= is the idiom)
+        sanctioned: List[Tuple[int, int]] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "append_enrollment"):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Lambda):
+                        sanctioned.append((sub.lineno,
+                                           getattr(sub, "end_lineno",
+                                                   sub.lineno)))
+
+        def in_sanctioned(line: int) -> bool:
+            return any(a <= line <= b for a, b in sanctioned)
+
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = _receiver_terminal(node.func.value)
+            if node.func.attr == "add" \
+                    and recv in wiring.GALLERY_RECEIVERS:
+                if in_sanctioned(node.lineno):
+                    continue
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    "gallery.add() outside the WAL-sequenced enrollment "
+                    "path — a crash after this mutation loses rows no "
+                    "replay can restore; route it through "
+                    "state.append_enrollment(..., apply_fn=lambda: "
+                    "gallery.add(...)), or annotate a genuinely "
+                    "non-durable gallery with '# ocvf-lint: "
+                    "boundary=wal-before-mutate -- <why>'"))
+            elif node.func.attr in wiring.WAL_WRITE_METHODS \
+                    and recv in wiring.WAL_RECEIVERS:
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"direct WAL write ({recv}.{node.func.attr}) outside "
+                    f"runtime/state_store.py — WAL sequencing belongs to "
+                    f"StateLifecycle under its _enroll_lock; a bare write "
+                    f"can interleave with checkpoints and break replay "
+                    f"dedup"))
+        return findings

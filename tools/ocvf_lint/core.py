@@ -13,15 +13,23 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-#: ``# ocvf-lint: disable=rule1,rule2 -- justification``  (line-level; covers
-#: the comment's own line and the line directly below it, so it works both
-#: trailing the offending statement and on its own line above it),
-#: ``# ocvf-lint: disable-block=rule -- justification`` (covers the innermost
-#: statement enclosing the comment — put it on a ``with`` header to cover the
-#: whole block), or
-#: ``# ocvf-lint: disable-file=rule -- justification`` (whole file).
+#: ``# ocvf-lint: disable=<rule>[,<rule>] -- <justification>``  (line-level;
+#: covers the comment's own line and the line directly below it, so it works
+#: both trailing the offending statement and on its own line above it),
+#: ``disable-block=<rule> -- ...`` (covers the innermost statement enclosing
+#: the comment — put it on a ``with`` header to cover the whole block), or
+#: ``disable-file=<rule> -- ...`` (whole file).
+#:
+#: ``boundary=<rule>`` / ``boundary-block=<rule>`` is the shared sanctioned-site
+#: annotation: same coverage and justification hygiene as ``disable``, but it
+#: declares "this site IS the designed protocol boundary" (a WAL fsync under
+#: its lock, the serving loop's one readback, a cache-keyed jit builder)
+#: rather than "a finding we accept".  Only rules that define boundaries
+#: (``Checker.boundary_capable``) honor it; boundaries are counted
+#: separately in the report.
 SUPPRESS_RE = re.compile(
-    r"#\s*ocvf-lint:\s*(?P<kind>disable-file|disable-block|disable)\s*=\s*"
+    r"#\s*ocvf-lint:\s*(?P<kind>disable-file|disable-block|disable"
+    r"|boundary-block|boundary)\s*=\s*"
     r"(?P<rules>[A-Za-z0-9_,-]+)"
     r"(?:\s*--\s*(?P<why>.*\S))?"
 )
@@ -66,12 +74,19 @@ class Finding:
             out["also"] = [{"path": p, "line": l} for p, l in self.also]
         return out
 
+    @staticmethod
+    def from_dict(d: dict) -> "Finding":
+        return Finding(
+            rule=d["rule"], path=d["path"], line=d["line"], col=d["col"],
+            message=d["message"],
+            also=tuple((a["path"], a["line"]) for a in d.get("also", ())))
+
 
 @dataclasses.dataclass
 class Suppression:
     rules: Tuple[str, ...]
     line: int
-    kind: str  # "disable" | "disable-block" | "disable-file"
+    kind: str  # "disable" | "disable-block" | "disable-file" | "boundary[-block]"
     justification: str
     #: inclusive line span this suppression covers (block spans are resolved
     #: against the AST once the file parses; file-level covers everything)
@@ -82,6 +97,10 @@ class Suppression:
     @property
     def file_level(self) -> bool:
         return self.kind == "disable-file"
+
+    @property
+    def boundary(self) -> bool:
+        return self.kind in ("boundary", "boundary-block")
 
     @property
     def justified(self) -> bool:
@@ -109,16 +128,41 @@ class FileContext:
 class Checker:
     """Base checker.  ``check_file`` runs once per file; ``finalize`` runs
     after every file has been seen (for project-wide rules like the lock
-    graph)."""
+    graph).
+
+    ``scope`` declares cacheability: a ``"file"`` checker's findings depend
+    only on that one file's content (the incremental cache can replay them
+    on a content-hash hit); a ``"project"`` checker sees cross-file state
+    (call graphs, the metric registry) and always re-runs.
+
+    ``boundary_capable`` opts the rule into the shared sanctioned-site
+    annotation (``# ocvf-lint: boundary=<rule> -- why``).
+
+    ``extra_cache_fingerprint(files)`` lets a checker declare out-of-tree
+    inputs its verdict depends on (e.g. the metrics registry read as a
+    fallback when it is not among the linted files) — the returned string
+    is folded into the run-cache key so editing that input invalidates
+    cached verdicts.
+
+    ``needs_dataflow`` asks the runner for a ``dataflow.ProjectModel`` over
+    every parsed file, injected as ``self.project`` before any
+    ``check_file`` call (built once, shared by all checkers that want it)."""
 
     rule: str = ""
     description: str = ""
+    scope: str = "file"
+    boundary_capable: bool = False
+    needs_dataflow: bool = False
+    project = None  # dataflow.ProjectModel, injected when needs_dataflow
 
     def check_file(self, ctx: FileContext) -> List[Finding]:
         return []
 
     def finalize(self) -> List[Finding]:
         return []
+
+    def extra_cache_fingerprint(self, files: Sequence[str]) -> str:
+        return ""
 
 
 REGISTRY: Dict[str, type] = {}
@@ -216,13 +260,27 @@ class RunResult:
     files_scanned: int
     rules: List[str]
     suppressions_used: int
+    #: sanctioned-site annotations honored (``boundary=`` kind)
+    boundaries_used: int = 0
+    #: incremental-cache telemetry: {"run_hit": bool, "file_hits": int,
+    #: "file_misses": int} — absent keys mean "no cache in play"
+    cache: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    def rule_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return counts
 
     def to_dict(self) -> dict:
         return {
             "findings": [f.to_dict() for f in self.findings],
             "files_scanned": self.files_scanned,
             "rules": self.rules,
+            "rule_counts": self.rule_counts(),
             "suppressions_used": self.suppressions_used,
+            "boundaries_used": self.boundaries_used,
+            "cache": self.cache,
         }
 
 
@@ -230,9 +288,16 @@ def _load_builtin_checkers() -> None:
     from tools.ocvf_lint import checkers  # noqa: F401 — import registers
 
 
-def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResult:
+def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None,
+        cache=None) -> RunResult:
     """Lint every ``.py`` file under ``paths``.  Returns all unsuppressed
-    findings, sorted by (path, line)."""
+    findings, sorted by (path, line).
+
+    ``cache`` (a ``tools.ocvf_lint.cache.LintCache``) enables the
+    incremental layers: an unchanged project returns the memoized run
+    wholesale; otherwise per-file findings of ``scope == "file"`` checkers
+    replay from their content-hash entries and only project-scope analyses
+    recompute."""
     _load_builtin_checkers()
     selected = sorted(REGISTRY) if rules is None else [r for r in sorted(REGISTRY)
                                                       if r in set(rules)]
@@ -242,13 +307,36 @@ def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResul
         if not os.path.exists(path):
             raise FileNotFoundError(f"lint path does not exist: {path}")
 
+    files = iter_py_files(paths)
+    sources: Dict[str, str] = {}
+    hashes: Dict[str, str] = {}
+    for path in files:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            sources[path] = fh.read()
+        hashes[path] = _sha256(sources[path])
+
+    cache_info: Dict[str, object] = {}
+    run_key = None
+    if cache is not None:
+        extra = "".join(c.extra_cache_fingerprint(files) for c in checkers)
+        run_key = cache.run_key(selected, [(p, hashes[p]) for p in files],
+                                extra=extra)
+        hit = cache.get_run(run_key)
+        if hit is not None:
+            result = RunResult(
+                findings=[Finding.from_dict(d) for d in hit["findings"]],
+                files_scanned=hit["files_scanned"], rules=list(selected),
+                suppressions_used=hit["suppressions_used"],
+                boundaries_used=hit.get("boundaries_used", 0),
+                cache={"run_hit": True})
+            return result
+        cache_info = {"run_hit": False, "file_hits": 0, "file_misses": 0}
+
     findings: List[Finding] = []
     suppressions: Dict[str, List[Suppression]] = {}
     contexts: List[FileContext] = []
-    files = iter_py_files(paths)
     for path in files:
-        with open(path, "r", encoding="utf-8", errors="replace") as fh:
-            source = fh.read()
+        source = sources[path]
         suppressions[path] = parse_suppressions(source)
         try:
             tree = ast.parse(source, filename=path)
@@ -257,24 +345,64 @@ def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResul
                                     exc.offset or 0, f"file does not parse: {exc.msg}"))
             continue
         for s in suppressions[path]:
-            if s.kind == "disable-block":
+            if s.kind in ("disable-block", "boundary-block"):
                 s.start, s.end = _enclosing_stmt_span(tree, s.line)
         contexts.append(FileContext(path, source, tree))
 
-    for checker in checkers:
+    # One shared interprocedural model for every checker that wants it.
+    if any(c.needs_dataflow for c in checkers):
+        from tools.ocvf_lint import dataflow
+        project = dataflow.ProjectModel(contexts)
+        for checker in checkers:
+            if checker.needs_dataflow:
+                checker.project = project
+
+    file_scope = [c for c in checkers if c.scope == "file"]
+    project_scope = [c for c in checkers if c.scope != "file"]
+    file_rules = [c.rule for c in file_scope]
+
+    for ctx in contexts:
+        # The file-layer key covers PATH as well as content: several
+        # file-scope rules decide by location (tests/ exemption, owner- and
+        # durability-module suffixes), so identical bytes at a different
+        # path must never replay the old verdict.
+        fkey = _sha256(ctx.path + "\0" + hashes[ctx.path])
+        cached = (cache.get_file(fkey, file_rules)
+                  if cache is not None and file_scope else None)
+        if cached is not None:
+            cache_info["file_hits"] = cache_info.get("file_hits", 0) + 1
+            for dicts in cached.values():
+                findings.extend(Finding.from_dict(d) for d in dicts)
+            continue
+        per_rule: Dict[str, List[Finding]] = {}
+        for checker in file_scope:
+            per_rule[checker.rule] = checker.check_file(ctx)
+            findings.extend(per_rule[checker.rule])
+        if cache is not None and file_scope:
+            cache_info["file_misses"] = cache_info.get("file_misses", 0) + 1
+            cache.store_file(fkey, {
+                rule: [f.to_dict() for f in fs]
+                for rule, fs in per_rule.items()})
+    for checker in file_scope:
+        findings.extend(checker.finalize())
+
+    for checker in project_scope:
         for ctx in contexts:
             findings.extend(checker.check_file(ctx))
         findings.extend(checker.finalize())
 
     # Suppression hygiene: a disable without justification is a finding in
-    # its own right, and suppresses nothing.  Unknown rule names are typos.
+    # its own right, and suppresses nothing.  Unknown rule names are typos,
+    # and a boundary annotation only exists for rules that define
+    # sanctioned boundaries.
     known = set(REGISTRY) | {PARSE_RULE}
     for path, supps in suppressions.items():
         for s in supps:
             if not s.justified:
+                word = "boundary annotation" if s.boundary else "suppression"
                 findings.append(Finding(
                     SUPPRESSION_RULE, path, s.line, 0,
-                    f"suppression for {','.join(s.rules)} lacks a justification "
+                    f"{word} for {','.join(s.rules)} lacks a justification "
                     f"(append ' -- <why this is safe>'); it is ignored"))
             for r in s.rules:
                 if r not in known:
@@ -282,13 +410,22 @@ def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResul
                         SUPPRESSION_RULE, path, s.line, 0,
                         f"suppression names unknown rule {r!r} "
                         f"(known: {', '.join(sorted(known))})"))
+                elif s.boundary and not getattr(REGISTRY.get(r), "boundary_capable",
+                                                False):
+                    findings.append(Finding(
+                        SUPPRESSION_RULE, path, s.line, 0,
+                        f"rule {r!r} defines no sanctioned boundaries — use "
+                        f"'disable={r}' to accept a finding instead"))
 
     def suppressed(f: Finding) -> bool:
         if f.rule == SUPPRESSION_RULE:
             return False
+        capable = getattr(REGISTRY.get(f.rule), "boundary_capable", False)
         for path, line in ((f.path, f.line),) + f.also:
             for s in suppressions.get(path, ()):
                 if not s.justified or f.rule not in s.rules:
+                    continue
+                if s.boundary and not capable:
                     continue
                 if s.covers(line):
                     s.used = True
@@ -297,6 +434,25 @@ def run(paths: Sequence[str], rules: Optional[Iterable[str]] = None) -> RunResul
 
     kept = [f for f in findings if not suppressed(f)]
     kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    used = sum(1 for supps in suppressions.values() for s in supps if s.used)
-    return RunResult(findings=kept, files_scanned=len(files),
-                     rules=selected, suppressions_used=used)
+    used = sum(1 for supps in suppressions.values()
+               for s in supps if s.used and not s.boundary)
+    bounds = sum(1 for supps in suppressions.values()
+                 for s in supps if s.used and s.boundary)
+    result = RunResult(findings=kept, files_scanned=len(files),
+                       rules=selected, suppressions_used=used,
+                       boundaries_used=bounds, cache=cache_info)
+    if cache is not None and run_key is not None:
+        cache.store_run(run_key, {
+            "findings": [f.to_dict() for f in kept],
+            "files_scanned": len(files),
+            "suppressions_used": used,
+            "boundaries_used": bounds,
+        })
+        cache.save()
+    return result
+
+
+def _sha256(text: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()

@@ -1,0 +1,822 @@
+"""Lightweight interprocedural dataflow on top of the stdlib-AST framework.
+
+Two clients, one model:
+
+- **jit tracing** (jit-recompile-hazard): find every function that jax traces
+  (``jax.jit``/``jax.pmap`` call or decorator, including nested defs and
+  lambdas), then walk each traced body — and the project-local functions it
+  calls, resolved through the same known wiring the lock-order graph uses —
+  tracking which values derive from traced arguments.  Python branching on a
+  traced value, or host-materializing it (``np.*``, ``float()``, ``.item()``)
+  inside the trace, is a finding.
+
+- **host-sync taint** (host-sync): a module-set fixpoint that seeds device
+  taint at dispatch sites (``recognize_batch_packed`` and friends, ``jnp.*``,
+  anything assigned from ``jax.jit(...)``), propagates it through locals,
+  tuple unpacking, attribute stores (``self._inflight.append((packed, ...))``)
+  and resolved calls, and reports every synchronization sink it reaches.
+
+Resolution is deliberately the same *kind* of heuristic PR 5 shipped:
+``self.m()`` through the class and project-local bases, bare ``f()`` through
+the module, ``x.attr.m()`` through ``wiring.ATTR_HINTS``, plus imported-module
+aliases (``detector_mod.decode_detections``).  Bounded depth, memoized —
+wrong answers are conservative (an unresolved call propagates taint; an
+unknown callee is never walked)."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.ocvf_lint import wiring
+
+_CALL_DEPTH = 5
+_FIXPOINT_ROUNDS = 12
+
+
+# --------------------------------------------------------------------------
+# model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str
+    path: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    params: Tuple[str, ...]
+
+    @property
+    def qual(self) -> str:
+        return (f"{self.module}.{self.cls}.{self.name}" if self.cls
+                else f"{self.module}.{self.name}")
+
+    def body(self) -> List[ast.stmt]:
+        body = self.node.body
+        return body if isinstance(body, list) else [ast.Return(value=body)]
+
+
+@dataclasses.dataclass
+class ClassEntry:
+    module: str
+    name: str
+    bases: Tuple[str, ...]
+    methods: Dict[str, FuncInfo]
+
+
+@dataclasses.dataclass
+class JitRoot:
+    fn: FuncInfo
+    #: parameter names excluded from tracing (static_argnums/static_argnames)
+    static: Tuple[str, ...]
+    #: the jit-construction call/decorator site
+    site: ast.AST
+
+
+def _params_of(node: ast.AST) -> Tuple[str, ...]:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs] + [a.arg for a in args.args]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    names += [a.arg for a in args.kwonlyargs]
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return tuple(names)
+
+
+def _normalize_module(dotted: str) -> str:
+    """``opencv_facerecognizer_tpu.models.detector`` -> ``models.detector``
+    (the same last-3-components id ``core.module_name`` produces)."""
+    parts = dotted.split(".")
+    if "opencv_facerecognizer_tpu" in parts:
+        parts = parts[parts.index("opencv_facerecognizer_tpu") + 1:]
+    return ".".join(parts[-3:]) if parts else dotted
+
+
+class ModuleInfo:
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.functions: Dict[str, FuncInfo] = {}       # module-level defs
+        self.all_funcs: List[FuncInfo] = []            # incl. methods/nested
+        #: local alias -> normalized module id (``detector_mod`` ->
+        #: ``models.detector``); only aliases of *modules* land here.
+        self.mod_aliases: Dict[str, str] = {}
+        self.np_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()             # jax, jnp, lax
+        #: names (attr or local) assigned from a jax.jit(...) result —
+        #: calling them dispatches a compiled computation (device producer).
+        self.jit_products: Set[str] = set()
+        self.jit_roots: List[JitRoot] = []
+
+    def collect_imports(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    dotted = alias.name
+                    if dotted in ("numpy", "numpy.ma"):
+                        self.np_aliases.add(local)
+                    elif dotted == "jax" or dotted.startswith("jax."):
+                        self.jax_aliases.add(local)
+                    else:
+                        self.mod_aliases[local] = _normalize_module(dotted)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    if node.module == "jax" and alias.name in ("numpy", "lax",
+                                                               "random"):
+                        self.jax_aliases.add(local)
+                    elif node.module.startswith("jax"):
+                        # from jax import jit / from jax.numpy import ...
+                        if alias.name in ("jit", "pmap"):
+                            self.jax_aliases.add(local)
+                    elif alias.name[:1].islower():
+                        # ``from pkg.sub import module as alias`` — treat as
+                        # a module alias; resolution just misses otherwise.
+                        self.mod_aliases[local] = _normalize_module(
+                            node.module + "." + alias.name)
+
+
+class ProjectModel:
+    """Parsed-project index: functions, classes, imports, jit roots."""
+
+    def __init__(self, contexts: Sequence) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, List[ClassEntry]] = {}
+        for ctx in contexts:
+            mi = ModuleInfo(ctx)
+            mi.collect_imports()
+            self.modules[ctx.module] = mi
+            self._collect_defs(mi)
+        for mi in self.modules.values():
+            self._collect_jit_roots(mi)
+
+    # ---- collection ----
+
+    def _collect_defs(self, mi: ModuleInfo) -> None:
+        ctx = mi.ctx
+
+        def visit(body, cls: Optional[str], scope: List[Dict[str, FuncInfo]],
+                  top: bool) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.ClassDef) and top:
+                    entry = ClassEntry(
+                        module=ctx.module, name=stmt.name,
+                        bases=tuple(b.id for b in stmt.bases
+                                    if isinstance(b, ast.Name)),
+                        methods={})
+                    self.classes.setdefault(stmt.name, []).append(entry)
+                    for sub in stmt.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            fi = FuncInfo(ctx.module, ctx.path, stmt.name,
+                                          sub.name, sub, _params_of(sub))
+                            entry.methods[sub.name] = fi
+                            mi.all_funcs.append(fi)
+                            visit(sub.body, stmt.name, scope + [{}], False)
+                elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FuncInfo(ctx.module, ctx.path, cls, stmt.name,
+                                  stmt, _params_of(stmt))
+                    mi.all_funcs.append(fi)
+                    if top:
+                        mi.functions[stmt.name] = fi
+                    scope[-1][stmt.name] = fi
+                    visit(stmt.body, cls, scope + [{}], False)
+                else:
+                    for child in ast.iter_child_nodes(stmt):
+                        if isinstance(child, ast.stmt):
+                            visit([child], cls, scope, top)
+
+        visit(ctx.tree.body, None, [{}], True)
+
+    # ---- jit roots ----
+
+    def _jit_callee_kind(self, mi: ModuleInfo, func: ast.expr) -> Optional[str]:
+        """'jit'/'pmap' when ``func`` is a jax jit/pmap reference."""
+        if isinstance(func, ast.Attribute) and func.attr in ("jit", "pmap"):
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in mi.jax_aliases:
+                return func.attr
+        if isinstance(func, ast.Name) and func.id in ("jit", "pmap") \
+                and func.id in mi.jax_aliases:
+            return func.id
+        return None
+
+    def _jit_call_info(self, mi: ModuleInfo, call: ast.Call
+                       ) -> Optional[Tuple[ast.Call, List[ast.keyword]]]:
+        """``jax.jit(...)`` -> (call, static kwargs); also unwraps
+        ``functools.partial(jax.jit, static_argnames=...)``."""
+        if self._jit_callee_kind(mi, call.func):
+            return call, list(call.keywords)
+        # functools.partial(jax.jit, ...)
+        func = call.func
+        is_partial = (isinstance(func, ast.Attribute) and func.attr == "partial") \
+            or (isinstance(func, ast.Name) and func.id == "partial")
+        if is_partial and call.args \
+                and self._jit_callee_kind(mi, call.args[0]):
+            return call, list(call.keywords)
+        return None
+
+    @staticmethod
+    def _static_params(fn: FuncInfo, keywords: List[ast.keyword]
+                       ) -> Tuple[str, ...]:
+        static: List[str] = []
+
+        def const_values(node):
+            if isinstance(node, ast.Constant):
+                return [node.value]
+            if isinstance(node, (ast.Tuple, ast.List)):
+                return [e.value for e in node.elts
+                        if isinstance(e, ast.Constant)]
+            return []
+
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                static += [v for v in const_values(kw.value)
+                           if isinstance(v, str)]
+            elif kw.arg == "static_argnums":
+                for v in const_values(kw.value):
+                    if isinstance(v, int) and 0 <= v < len(fn.params):
+                        static.append(fn.params[v])
+        return tuple(static)
+
+    def _collect_jit_roots(self, mi: ModuleInfo) -> None:
+        ctx = mi.ctx
+
+        # decorator form
+        for fi in mi.all_funcs:
+            node = fi.node
+            for dec in getattr(node, "decorator_list", []):
+                if self._jit_callee_kind(mi, dec):
+                    mi.jit_roots.append(JitRoot(fi, (), dec))
+                elif isinstance(dec, ast.Call):
+                    info = self._jit_call_info(mi, dec)
+                    if info is not None:
+                        mi.jit_roots.append(
+                            JitRoot(fi, self._static_params(fi, info[1]), dec))
+
+        # call form: jax.jit(<ref>, ...) — resolve <ref> lexically
+        def visit(body, scope: List[Dict[str, FuncInfo]]) -> None:
+            local: Dict[str, FuncInfo] = {}
+            chain = scope + [local]
+            for stmt in body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = self._find_func(mi, node)
+                        if fi is not None:
+                            local[node.name] = fi
+                    elif isinstance(node, ast.Call):
+                        self._maybe_jit_root(mi, node, chain)
+
+        visit(ctx.tree.body, [dict(mi.functions)])
+        # assignment targets of jit products: x = jax.jit(...) /
+        # self.y = jax.jit(...) — calling them later is a device dispatch.
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and self._jit_call_info(mi, node.value) is not None:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        mi.jit_products.add(target.id)
+                    elif isinstance(target, ast.Attribute):
+                        mi.jit_products.add(target.attr)
+
+    def _find_func(self, mi: ModuleInfo, node: ast.AST) -> Optional[FuncInfo]:
+        for fi in mi.all_funcs:
+            if fi.node is node:
+                return fi
+        return None
+
+    def _maybe_jit_root(self, mi: ModuleInfo, call: ast.Call,
+                        scope: List[Dict[str, FuncInfo]]) -> None:
+        info = self._jit_call_info(mi, call)
+        if info is None:
+            return
+        _, keywords = info
+        target = None
+        if call.args:
+            head = call.args[0]
+            if self._jit_callee_kind(mi, head):
+                # partial(jax.jit, ...): the wrapped fn arrives later (as a
+                # decorator, handled above) — nothing to resolve here.
+                return
+            if isinstance(head, ast.Lambda):
+                fi = FuncInfo(mi.ctx.module, mi.ctx.path, None, "<lambda>",
+                              head, _params_of(head))
+                mi.jit_roots.append(JitRoot(fi, self._static_params(fi, keywords),
+                                            call))
+                return
+            if isinstance(head, ast.Name):
+                for frame in reversed(scope):
+                    if head.id in frame:
+                        target = frame[head.id]
+                        break
+        if target is not None:
+            mi.jit_roots.append(
+                JitRoot(target, self._static_params(target, keywords), call))
+
+    # ---- resolution ----
+
+    def resolve_method(self, cls_name: str, method: str, module: str,
+                       _seen=None) -> Optional[FuncInfo]:
+        if _seen is None:
+            _seen = set()
+        if cls_name in _seen:
+            return None
+        _seen.add(cls_name)
+        defs = sorted(self.classes.get(cls_name, []),
+                      key=lambda c: c.module != module)
+        for cdef in defs:
+            if method in cdef.methods:
+                return cdef.methods[method]
+        for cdef in defs:
+            for base in cdef.bases:
+                found = self.resolve_method(base, method, module, _seen)
+                if found is not None:
+                    return found
+        return None
+
+    def resolve_call(self, call: ast.Call, caller: FuncInfo
+                     ) -> Optional[FuncInfo]:
+        func = call.func
+        mi = self.modules.get(caller.module)
+        if isinstance(func, ast.Name):
+            if mi is not None and func.id in mi.functions:
+                return mi.functions[func.id]
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and caller.cls is not None:
+                return self.resolve_method(caller.cls, func.attr, caller.module)
+            if mi is not None and base.id in mi.mod_aliases:
+                target = self.modules.get(mi.mod_aliases[base.id])
+                if target is not None:
+                    return target.functions.get(func.attr)
+            hint = wiring.ATTR_HINTS.get(base.id)
+            if hint is not None:
+                return self.resolve_method(hint, func.attr, caller.module)
+            return None
+        if isinstance(base, ast.Attribute):
+            hint = wiring.ATTR_HINTS.get(base.attr)
+            if hint is not None:
+                return self.resolve_method(hint, func.attr, caller.module)
+        return None
+
+
+# --------------------------------------------------------------------------
+# shared expression-taint machinery
+# --------------------------------------------------------------------------
+
+
+from tools.ocvf_lint.astutil import terminal_attr  # noqa: E402 — shared helper
+
+
+class _Walker:
+    """One function body, one taint environment, statement order.  Two
+    passes per body so taint assigned late in a loop reaches uses earlier
+    in it.  Subclasses define producer/sink policy."""
+
+    def __init__(self, model: ProjectModel, fn: FuncInfo, env: Set[str]):
+        self.model = model
+        self.fn = fn
+        self.env = set(env)
+        self.mi = model.modules.get(fn.module)
+        self.returns_tainted = False
+        self.report: List[Tuple[ast.AST, str, str]] = []
+        self.reporting = True
+
+    # -- policy hooks --
+
+    def call_taint(self, call: ast.Call, arg_tainted: bool) -> bool:
+        raise NotImplementedError
+
+    def on_branch(self, node: ast.AST) -> None:
+        pass
+
+    def store_attr(self, target_attr: str, is_self: bool, tainted: bool) -> None:
+        pass
+
+    def load_attr_tainted(self, node: ast.Attribute) -> bool:
+        return False
+
+    # -- engine --
+
+    def run(self) -> None:
+        # two passes: first silent (taint assigned late in a loop body must
+        # reach uses textually earlier in it), second reporting
+        self.reporting = False
+        self._pass()
+        self.reporting = True
+        self.report = []
+        self._pass()
+
+    def _pass(self) -> None:
+        for stmt in self.fn.body():
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested defs run later; analyzed as their own entries
+        if isinstance(node, ast.Assign):
+            t = self._expr(node.value)
+            for target in node.targets:
+                self._assign(target, t)
+            return
+        if isinstance(node, ast.AugAssign):
+            t = self._expr(node.value) or self._expr(node.target)
+            self._assign(node.target, t)
+            return
+        if isinstance(node, ast.AnnAssign):
+            t = self._expr(node.value) if node.value is not None else False
+            self._assign(node.target, t)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            if self._expr(node.test) and self.reporting:
+                self.on_branch(node)
+            for child in node.body + node.orelse:
+                self._stmt(child)
+            return
+        if isinstance(node, ast.Assert):
+            if self._expr(node.test) and self.reporting:
+                self.on_branch(node)
+            return
+        if isinstance(node, ast.For):
+            if self._expr(node.iter) and self.reporting:
+                self.on_branch(node)
+            self._assign(node.target, self._expr(node.iter))
+            for child in node.body + node.orelse:
+                self._stmt(child)
+            return
+        if isinstance(node, ast.Return):
+            if node.value is not None and self._expr(node.value):
+                self.returns_tainted = True
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars, False)
+            for child in node.body:
+                self._stmt(child)
+            return
+        if isinstance(node, ast.Try):
+            for child in (node.body + node.orelse + node.finalbody):
+                self._stmt(child)
+            for handler in node.handlers:
+                for child in handler.body:
+                    self._stmt(child)
+            return
+        if isinstance(node, ast.Expr):
+            self._expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child)
+            elif isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _assign(self, target: ast.expr, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.env.add(target.id)
+            else:
+                self.env.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                inner = elt.value if isinstance(elt, ast.Starred) else elt
+                self._assign(inner, tainted)
+        elif isinstance(target, ast.Attribute):
+            base = target.value
+            is_self = isinstance(base, ast.Name) and base.id == "self"
+            self.store_attr(target.attr, is_self, tainted)
+        elif isinstance(target, ast.Subscript):
+            self._expr(target.value)
+
+    def _expr(self, node: Optional[ast.expr]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.env
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in wiring.STATIC_VALUE_ATTRS:
+                self._expr(node.value)
+                return False
+            if self.load_attr_tainted(node):
+                return True
+            return self._expr(node.value)
+        if isinstance(node, ast.Call):
+            arg_tainted = False
+            for arg in node.args:
+                inner = arg.value if isinstance(arg, ast.Starred) else arg
+                arg_tainted |= self._expr(inner)
+            for kw in node.keywords:
+                arg_tainted |= self._expr(kw.value)
+            return self.call_taint(node, arg_tainted)
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, ast.Subscript):
+            # indexing: the CONTAINER's taint is the result's; a (possibly
+            # tainted) index into a host container yields host data
+            t = self._expr(node.value)
+            self._expr(node.slice)
+            return t
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            # the comprehension's value is its ELEMENTS — iterating a
+            # tainted container of already-materialized elements is host
+            for gen in node.generators:
+                self._assign(gen.target, self._expr(gen.iter))
+                for cond in gen.ifs:
+                    self._expr(cond)
+            return self._expr(node.elt)
+        if isinstance(node, ast.DictComp):
+            for gen in node.generators:
+                self._assign(gen.target, self._expr(gen.iter))
+                for cond in gen.ifs:
+                    self._expr(cond)
+            return self._expr(node.key) | self._expr(node.value)
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test)
+            return self._expr(node.body) | self._expr(node.orelse)
+        tainted = False
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                tainted |= self._expr(child)
+        return tainted
+
+    def _is_np_call(self, call: ast.Call) -> bool:
+        func = call.func
+        return (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and self.mi is not None
+                and func.value.id in self.mi.np_aliases)
+
+    def _is_jaxish_call(self, call: ast.Call) -> bool:
+        func = call.func
+        cur = func
+        while isinstance(cur, ast.Attribute):
+            cur = cur.value
+        return (isinstance(cur, ast.Name) and self.mi is not None
+                and cur.id in self.mi.jax_aliases)
+
+
+# --------------------------------------------------------------------------
+# jit tracing (jit-recompile-hazard)
+# --------------------------------------------------------------------------
+
+
+class _TracedWalker(_Walker):
+    """Inside a jax-traced body: params (minus statics) are tracers; any
+    Python decision or host materialization on a tracer-derived value is a
+    hazard."""
+
+    def __init__(self, checker: "JitTraceChecker", fn: FuncInfo,
+                 env: Set[str], depth: int):
+        super().__init__(checker.model, fn, env)
+        self.checker = checker
+        self.depth = depth
+
+    def on_branch(self, node: ast.AST) -> None:
+        kind = ("assert" if isinstance(node, ast.Assert)
+                else "loop" if isinstance(node, (ast.For, ast.While))
+                else "branch")
+        self.report.append((node, "branch",
+                            f"Python {kind} on a traced value"))
+
+    def call_taint(self, call: ast.Call, arg_tainted: bool) -> bool:
+        func = call.func
+        # len()/range() of a tracer are static Python under jit — shape
+        # branching is the ladder's bread and butter, never a finding
+        if isinstance(func, ast.Name) and func.id in wiring.HOST_BUILTIN_FUNCS:
+            return False
+        # host materialization sinks
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist"):
+            if self._expr(func.value):
+                if self.reporting:
+                    self.report.append((call, "materialize",
+                                        f".{func.attr}() on a traced value"))
+                return False
+        if self._is_np_call(call):
+            if arg_tainted and self.reporting:
+                self.report.append((
+                    call, "materialize",
+                    f"numpy call {ast.unparse(func) if hasattr(ast, 'unparse') else func.attr}() "
+                    f"on a traced value"))
+            return False
+        if isinstance(func, ast.Name) \
+                and func.id in wiring.MATERIALIZE_NAME_FUNCS:
+            if arg_tainted and self.reporting:
+                self.report.append((call, "materialize",
+                                    f"{func.id}() on a traced value"))
+            return False
+        if self._is_jaxish_call(call):
+            return True  # any jax/jnp/lax op yields a tracer in-trace
+        resolved = self.model.resolve_call(call, self.fn)
+        if resolved is not None and self.reporting:
+            return self.checker.check_callee(resolved, call, self)
+        if isinstance(func, ast.Attribute) and self._expr(func.value):
+            return True  # method on a tracer (x.astype, x.reshape, x.at[...])
+        return arg_tainted
+
+
+class JitTraceChecker:
+    """Walks every jit root (and, transitively, resolved project callees
+    whose arguments are traced) exactly once per distinct traced-param set."""
+
+    def __init__(self, model: ProjectModel):
+        self.model = model
+        self.findings: List[Tuple[FuncInfo, ast.AST, str, str]] = []
+        self._memo: Dict[Tuple[int, frozenset], bool] = {}
+
+    def run(self) -> "JitTraceChecker":
+        for mi in self.model.modules.values():
+            for root in mi.jit_roots:
+                traced = frozenset(p for p in root.fn.params
+                                   if p not in root.static and p != "self")
+                self._check(root.fn, traced, _CALL_DEPTH)
+        return self
+
+    def check_callee(self, callee: FuncInfo, call: ast.Call,
+                     caller: _TracedWalker) -> bool:
+        """Map per-argument taint onto the callee's params and recurse.
+        Returns the callee's return-taint."""
+        if caller.depth <= 0:
+            return True  # conservatively a tracer
+        params = list(callee.params)
+        if params and params[0] == "self" \
+                and isinstance(call.func, ast.Attribute):
+            params = params[1:]
+        traced: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            inner = arg.value if isinstance(arg, ast.Starred) else arg
+            if i < len(params) and caller._expr(inner):
+                traced.add(params[i])
+        for kw in call.keywords:
+            if kw.arg is not None and kw.arg in callee.params \
+                    and caller._expr(kw.value):
+                traced.add(kw.arg)
+        if not traced:
+            return False  # nothing traced flows in; body runs on statics
+        return self._check(callee, frozenset(traced), caller.depth - 1)
+
+    def _check(self, fn: FuncInfo, traced: frozenset, depth: int) -> bool:
+        key = (id(fn.node), traced)
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = False  # cycle guard
+        walker = _TracedWalker(self, fn, set(traced), depth)
+        walker.run()
+        for node, kind, detail in walker.report:
+            self.findings.append((fn, node, kind, detail))
+        self._memo[key] = walker.returns_tainted
+        return walker.returns_tainted
+
+
+# --------------------------------------------------------------------------
+# host-sync taint (host-sync)
+# --------------------------------------------------------------------------
+
+
+class _HostSyncWalker(_Walker):
+    def __init__(self, analysis: "HostSyncAnalysis", fn: FuncInfo,
+                 env: Set[str]):
+        super().__init__(analysis.model, fn, env)
+        self.analysis = analysis
+
+    def load_attr_tainted(self, node: ast.Attribute) -> bool:
+        return node.attr in self.analysis.attr_taint
+
+    def store_attr(self, attr: str, is_self: bool, tainted: bool) -> None:
+        if tainted:
+            self.analysis.taint_attr(attr)
+
+    def call_taint(self, call: ast.Call, arg_tainted: bool) -> bool:
+        func = call.func
+        terminal = terminal_attr(func)
+        # host-result probes and host builtins never carry device taint
+        if isinstance(func, ast.Attribute) \
+                and func.attr in wiring.HOST_RESULT_ATTRS:
+            self._expr(func.value)
+            return False
+        if isinstance(func, ast.Name) and func.id in wiring.HOST_BUILTIN_FUNCS:
+            return False
+        # unconditional sync sinks: these calls exist only to wait on the
+        # device (``.item()`` included — a scalar readback is a readback)
+        if isinstance(func, ast.Attribute) and func.attr in wiring.SYNC_ATTRS:
+            if self.reporting:
+                self.report.append((call, "sync", f".{func.attr}()"))
+            return False
+        # numpy (or float/int/bool) applied to a device value IS the D2H
+        # readback; its result is host data (taint stops here).
+        if self._is_np_call(call):
+            if arg_tainted and self.reporting:
+                name = (ast.unparse(func) if hasattr(ast, "unparse")
+                        else f"np.{func.attr}")
+                self.report.append((call, "readback", f"{name}()"))
+            return False
+        if isinstance(func, ast.Name) \
+                and func.id in wiring.MATERIALIZE_NAME_FUNCS:
+            if arg_tainted and self.reporting:
+                self.report.append((call, "readback", f"{func.id}()"))
+            return False
+        # device producers
+        if terminal in wiring.DEVICE_PRODUCER_ATTRS:
+            return True
+        if terminal is not None and self.mi is not None \
+                and terminal in self.mi.jit_products:
+            return True
+        if self._is_jaxish_call(call):
+            return True
+        # container stores: x.append(tainted) taints x
+        if isinstance(func, ast.Attribute) \
+                and func.attr in wiring.CONTAINER_STORE_METHODS and arg_tainted:
+            recv = func.value
+            if isinstance(recv, ast.Attribute):
+                self.store_attr(recv.attr,
+                                isinstance(recv.value, ast.Name)
+                                and recv.value.id == "self", True)
+            elif isinstance(recv, ast.Name):
+                self.env.add(recv.id)
+            return False
+        # resolved project calls: propagate into params (fixpoint) and use
+        # the callee's return taint; a callee OUTSIDE the analyzed module
+        # set (e.g. ops.image.resize) degrades to the unresolved rule —
+        # taint flows through, it is just not tracked inside
+        resolved = self.model.resolve_call(call, self.fn)
+        if resolved is not None:
+            if resolved.qual not in self.analysis._quals:
+                return arg_tainted
+            if terminal not in ("recycle",):  # post-readback by contract
+                params = list(resolved.params)
+                if params and params[0] == "self" \
+                        and isinstance(func, ast.Attribute):
+                    params = params[1:]
+                for i, arg in enumerate(call.args):
+                    inner = arg.value if isinstance(arg, ast.Starred) else arg
+                    if i < len(params) and self._expr(inner):
+                        self.analysis.taint_param(resolved, params[i])
+                for kw in call.keywords:
+                    if kw.arg is not None and kw.arg in resolved.params \
+                            and self._expr(kw.value):
+                        self.analysis.taint_param(resolved, kw.arg)
+            return self.analysis.ret_taint.get(resolved.qual, False)
+        if isinstance(func, ast.Attribute) and self._expr(func.value):
+            return True  # method on a device value stays on device
+        return arg_tainted
+
+
+class HostSyncAnalysis:
+    """Module-set fixpoint: device taint from dispatch sites through locals,
+    attributes and resolved calls, then one reporting pass over every sink."""
+
+    def __init__(self, model: ProjectModel, module_names: Sequence[str]):
+        self.model = model
+        self.scope = [model.modules[m] for m in module_names
+                      if m in model.modules]
+        self.funcs: List[FuncInfo] = [fi for mi in self.scope
+                                      for fi in mi.all_funcs]
+        self._quals = {fi.qual for fi in self.funcs}
+        self.param_taint: Dict[str, Set[str]] = {fi.qual: set()
+                                                 for fi in self.funcs}
+        self.ret_taint: Dict[str, bool] = {}
+        self.attr_taint: Set[str] = set()
+        self._changed = False
+
+    def taint_param(self, fn: FuncInfo, param: str) -> None:
+        if fn.qual in self._quals and param not in self.param_taint[fn.qual]:
+            self.param_taint[fn.qual].add(param)
+            self._changed = True
+
+    def taint_attr(self, attr: str) -> None:
+        if attr not in self.attr_taint:
+            self.attr_taint.add(attr)
+            self._changed = True
+
+    def run(self) -> List[Tuple[FuncInfo, ast.AST, str, str]]:
+        for _ in range(_FIXPOINT_ROUNDS):
+            self._changed = False
+            for fi in self.funcs:
+                walker = _HostSyncWalker(self, fi,
+                                         set(self.param_taint[fi.qual]))
+                walker.reporting = False
+                walker._pass()
+                if walker.returns_tainted and not self.ret_taint.get(fi.qual):
+                    self.ret_taint[fi.qual] = True
+                    self._changed = True
+            if not self._changed:
+                break
+        findings: List[Tuple[FuncInfo, ast.AST, str, str]] = []
+        for fi in self.funcs:
+            walker = _HostSyncWalker(self, fi, set(self.param_taint[fi.qual]))
+            walker.reporting = True
+            walker._pass()
+            for node, kind, detail in walker.report:
+                findings.append((fi, node, kind, detail))
+        return findings

@@ -1,0 +1,64 @@
+"""SARIF 2.1.0 serialization of a lint run — the machine-readable format
+CI annotation surfaces (GitHub code scanning et al.) ingest natively.
+Deliberately minimal: one run, one driver, one result per finding, with
+``relatedLocations`` carrying each finding's ``also`` sites."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _location(path: str, line: int, col: int = 0) -> Dict:
+    region = {"startLine": max(1, line)}
+    if col:
+        region["startColumn"] = col + 1  # SARIF columns are 1-based
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path.replace("\\", "/")},
+            "region": region,
+        }
+    }
+
+
+def to_sarif(result, registry: Dict[str, type]) -> Dict:
+    """``result`` is a ``core.RunResult``; ``registry`` maps rule name ->
+    checker class (for descriptions)."""
+    rules = []
+    for rule in sorted(set(result.rules)
+                       | {f.rule for f in result.findings}):
+        cls = registry.get(rule)
+        desc = getattr(cls, "description", "") or rule
+        rules.append({
+            "id": rule,
+            "shortDescription": {"text": desc},
+        })
+    results = []
+    for f in result.findings:
+        entry = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [_location(f.path, f.line, f.col)],
+        }
+        if f.also:
+            entry["relatedLocations"] = [_location(p, l) for p, l in f.also]
+        results.append(entry)
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "ocvf-lint",
+                    "informationUri":
+                        "https://example.invalid/opencv_facerecognizer_tpu",
+                    "rules": rules,
+                }
+            },
+            "results": results,
+        }],
+    }

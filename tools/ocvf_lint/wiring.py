@@ -1,0 +1,125 @@
+"""The ONE known-wiring map of the serving stack, shared by every checker.
+
+PR 5's lock-order rule carried its own ``ATTR_HINTS`` table; the v2 rules
+(host-sync, jit-recompile-hazard, wal-before-mutate, epoch-pairing) all need
+the same "what class does ``self.<attr>`` dispatch to" knowledge plus a few
+scope sets of their own.  Keeping them per-checker would mean four slowly
+diverging copies of the runtime's wiring — this module is the single source
+of truth; checkers import, never redefine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Tuple
+
+#: Known wiring of ``self.<attr>`` (or any ``x.<attr>``) to the class whose
+#: methods it dispatches to — the cross-module edges of the serving stack.
+#: Used by lock-order call resolution AND the dataflow layer's
+#: interprocedural call resolution.
+ATTR_HINTS: Dict[str, str] = {
+    "metrics": "Metrics",
+    "batcher": "FrameBatcher",
+    "gallery": "ShardedGallery",
+    "quantizer": "CoarseQuantizer",
+    "journal": "DeadLetterJournal",
+    "drop_log": "DeadLetterJournal",
+    "wal": "EnrollmentWAL",
+    "state": "StateLifecycle",
+    "state_store": "StateLifecycle",
+    "checkpoints": "CheckpointStore",
+    "admission": "AdmissionController",
+    "connector": "JSONLConnector",
+    "pipeline": "RecognitionPipeline",
+}
+
+#: The serving hot path: the overlapped loop (PR 2) lives in these modules.
+#: host-sync scans exactly these; a stray blocking readback anywhere else is
+#: either offline tooling or already under blocking-under-lock.
+HOT_PATH_SUFFIXES: Tuple[str, ...] = (
+    "runtime/recognizer.py",
+    "runtime/batcher.py",
+    "parallel/pipeline.py",
+)
+
+#: Modules that OWN the epoch-pairing protocol (PR 6): only they may touch
+#: the guarded fields directly; everyone else goes through
+#: ``gallery.data`` + ``gallery._ivf_data(data)``.
+EPOCH_OWNER_SUFFIXES: Tuple[str, ...] = (
+    "parallel/gallery.py",
+    "parallel/quantizer.py",
+)
+
+#: Attributes reserved for the epoch-checked snapshot protocol.  ``_epoch``
+#: is the invalidation fence; ``_data`` is the atomically-published snapshot
+#: slot (both the gallery's GalleryData and the quantizer's IVFDeviceData).
+EPOCH_GUARDED_ATTRS: FrozenSet[str] = frozenset({"_epoch", "_data"})
+
+#: Single-field gallery snapshot properties: each one is an independent
+#: ``self._data`` read, so reading two of them non-atomically can pair
+#: fields across a concurrent swap.  Outside the owner modules, more than
+#: one of these per function is a pairing hazard.
+GALLERY_FIELD_PROPS: FrozenSet[str] = frozenset({"embeddings", "labels", "valid"})
+
+#: Receiver names that denote a ShardedGallery in the runtime's wiring
+#: (``gallery.add(...)``, ``self.pipeline.gallery.add(...)``).
+GALLERY_RECEIVERS: FrozenSet[str] = frozenset({"gallery"})
+
+#: Receiver names that denote the enrollment WAL.  Direct writes to it
+#: outside runtime/state_store.py bypass the lifecycle's sequencing lock.
+WAL_RECEIVERS: FrozenSet[str] = frozenset({"wal"})
+
+#: WAL methods that mutate durable state (reads — replay/verify — are fine).
+WAL_WRITE_METHODS: FrozenSet[str] = frozenset({
+    "append", "append_record", "truncate", "truncate_below", "rotate",
+})
+
+#: The durability layer that owns the _enroll_lock -> append_enrollment
+#: sequencing; gallery/WAL mutations inside it ARE the sanctioned path.
+WAL_EXEMPT_SUFFIXES: Tuple[str, ...] = ("runtime/state_store.py",)
+
+#: Calls whose result is a DEVICE value (taint seeds for host-sync):
+#: terminal attribute names of producer calls in the serving runtime.
+DEVICE_PRODUCER_ATTRS: FrozenSet[str] = frozenset({
+    "recognize_batch", "recognize_batch_packed", "device_put",
+})
+
+#: Host-sync sinks that are flagged UNCONDITIONALLY in hot-path modules —
+#: their only purpose is to synchronize with the device.
+SYNC_ATTRS: FrozenSet[str] = frozenset({
+    "block_until_ready", "device_get", "item",
+})
+
+#: Host-materialization calls that are findings only when their argument is
+#: device-tainted (``np.asarray(host_frame)`` in the batcher is fine; the
+#: same call on a dispatched batch IS the readback).
+MATERIALIZE_NAME_FUNCS: FrozenSet[str] = frozenset({"float", "int", "bool"})
+MATERIALIZE_NP_FUNCS: FrozenSet[str] = frozenset({
+    "asarray", "array", "ascontiguousarray",
+})
+
+#: Attribute loads on a traced/device value that yield STATIC Python data
+#: (shapes are compile-time constants under jit) — never taint through them.
+STATIC_VALUE_ATTRS: FrozenSet[str] = frozenset({
+    "shape", "ndim", "dtype", "size", "weak_type", "sharding",
+})
+
+#: Container mutators that store their argument into the receiver (taint
+#: flows receiver <- argument).
+CONTAINER_STORE_METHODS: FrozenSet[str] = frozenset({
+    "append", "appendleft", "extend", "add", "insert", "put", "put_nowait",
+})
+
+#: Methods on a device value that return HOST data without blocking —
+#: ``is_ready`` is the serving loop's designed non-blocking probe.
+HOST_RESULT_ATTRS: FrozenSet[str] = frozenset({"is_ready"})
+
+#: Builtins whose result is host data regardless of argument taint
+#: (``range(count)``'s index must not taint every subscript it reaches).
+HOST_BUILTIN_FUNCS: FrozenSet[str] = frozenset({
+    "len", "range", "enumerate", "hasattr", "isinstance", "getattr", "id",
+})
+
+
+def path_matches(path: str, suffixes: Tuple[str, ...]) -> bool:
+    norm = path.replace("\\", "/")
+    return any(norm.endswith(s) for s in suffixes)
