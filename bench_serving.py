@@ -178,8 +178,9 @@ def drive_rate(service, connector, frames, rate_hz: float, duration_s: float):
     # connector fan-out).
     summary = service.metrics.summary()
     decomp = {k: round(v, 2) for k, v in summary.items()
-              if k.split("_p")[0] in ("queue_wait", "dispatch", "ready_wait",
-                                      "publish")}
+              if v is not None  # empty windows report explicit nulls
+              and k.split("_p")[0] in ("queue_wait", "dispatch", "ready_wait",
+                                       "publish")}
     if decomp:
         stats["decomposition_ms"] = decomp
     return stats
@@ -334,6 +335,103 @@ def run_smoke(out_path="BENCH_SERVING_smoke.json", frames_n=160,
     return artifact
 
 
+def run_tracing_overhead(frames_n=240, rate_hz=200.0, batch_size=8,
+                         frame_hw=(64, 64), compute_s=0.002, warm_n=48,
+                         trials=3, gate_ratio=1.03, gate_slack_ms=0.5):
+    """Tracing-on vs tracing-off overhead comparison over the fake
+    instant backend: the same offered load driven through the overlapped
+    serving loop with no tracer, then with a ``Tracer`` at **sampling
+    1.0** (every frame records receive/queue_wait/settle spans plus batch
+    spans — the most expensive configuration). Each trial runs a short
+    warm phase first and then ``Metrics.reset_window()`` so the measured
+    percentiles cover steady state only.
+
+    Noise handling: the e2e p50 at a paced offered rate is dominated by
+    sleep/scheduler jitter on a 1-core host (observed ±10% run-to-run —
+    far above tracing's true per-frame cost), so each mode runs
+    ``trials`` times in ALTERNATING order and the gate compares the MIN
+    p50 per mode: additive scheduler noise only inflates a trial, never
+    deflates it, so the min is the noise-robust steady-state estimate.
+    Per-trial p50s are recorded so the artifact shows the spread.
+
+    The gate: min tracing-on p50 must stay within ``gate_ratio`` (3%) of
+    min tracing-off, plus ``gate_slack_ms`` of absolute slack. Recorded
+    as ``within_gate``; a missing measurement FAILS the gate (rc 3 from
+    ``--smoke``) rather than skipping it."""
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    frames = [np.zeros(frame_hw, np.float32)]
+
+    def one_trial(traced: bool):
+        tracer = Tracer(ring_size=1 << 15, sample=1.0) if traced else None
+        pipeline = InstantPipeline(frame_hw, compute_s=compute_s)
+        connector = FakeConnector()
+        service = RecognizerService(
+            pipeline, connector, batch_size=batch_size, frame_shape=frame_hw,
+            flush_timeout=0.05, inflight_depth=4, similarity_threshold=0.0,
+            metrics=Metrics(), readback_worker=True, target_latency_s=0.03,
+            tracer=tracer,
+        )
+        service.start(warmup=False)
+        try:
+            # Warm phase (compile-free here, but fills the EWMA + buffer
+            # pool), then reset the latency windows so the measured stats
+            # cover the steady state only — the reset_window contract.
+            drive_rate(service, connector, frames, rate_hz, warm_n / rate_hz)
+            service.metrics.reset_window()
+            stats = drive_rate(service, connector, frames, rate_hz,
+                               frames_n / rate_hz)
+        finally:
+            service.drain(timeout=60.0)
+            service.stop()
+        if tracer is not None:
+            stats["spans_held"] = tracer.stats()["spans_held"]
+        return stats
+
+    rows = {"tracing_off": {"trial_p50_ms": []},
+            "tracing_on": {"trial_p50_ms": []}}
+    for _trial in range(trials):
+        for mode in ("tracing_off", "tracing_on"):  # alternating order
+            stats = one_trial(traced=mode == "tracing_on")
+            p50 = stats.get("e2e_p50_ms")
+            row = rows[mode]
+            row["trial_p50_ms"].append(p50)
+            if p50 is not None and (row.get("e2e_p50_ms") is None
+                                    or p50 < row["e2e_p50_ms"]):
+                row.update(stats)  # keep the full stats of the best trial
+    p50_off = rows["tracing_off"].get("e2e_p50_ms")
+    p50_on = rows["tracing_on"].get("e2e_p50_ms")
+    result = {
+        "note": ("same offered load, overlapped loop, fake instant "
+                 "backend; tracing_on = Tracer(sample=1.0): every frame "
+                 "records receive/queue_wait/settle spans + batch "
+                 "dispatch/ready_wait/publish spans. Modes alternate for "
+                 f"{trials} trials; the gate compares MIN p50 per mode "
+                 "(scheduler noise is additive — see trial_p50_ms for "
+                 f"the spread): on <= off * {gate_ratio} + "
+                 f"{gate_slack_ms} ms slack."),
+        "config": {"frames": frames_n, "offered_hz": rate_hz,
+                   "batch_size": batch_size, "compute_ms": compute_s * 1e3,
+                   "sample": 1.0, "trials": trials},
+        "modes": rows,
+    }
+    if p50_off is not None and p50_on is not None and p50_off > 0:
+        result["p50_ratio"] = round(p50_on / p50_off, 4)
+        result["within_gate"] = bool(
+            p50_on <= p50_off * gate_ratio + gate_slack_ms)
+    else:
+        # A missing measurement (empty latency window, zero completions)
+        # must FAIL the gate, not skip it — a regression that breaks the
+        # measurement itself would otherwise pass silently.
+        result["within_gate"] = False
+        result["gate_error"] = "e2e p50 unavailable in one or both modes"
+    return result
+
+
 def run_overload_sweep(multipliers=(1.0, 2.0, 4.0), seconds=3.0,
                        batch_size=8, frame_hw=(32, 32), dispatch_s=0.04):
     """Offered-load ladder against a capacity-limited fake backend
@@ -459,6 +557,7 @@ def main(argv=None):
     if args.smoke:
         artifact = run_smoke(write=False)
         artifact["overload_sweep"] = run_overload_sweep()
+        artifact["tracing_overhead"] = run_tracing_overhead()
         with open("BENCH_SERVING_smoke.json", "w") as fh:
             json.dump(artifact, fh, indent=2)
         print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
@@ -466,6 +565,7 @@ def main(argv=None):
         overlap = artifact["modes"].get("overlapped", {})
         sweep_4x = next((r for r in artifact["overload_sweep"]["rows"]
                          if r["offered_multiplier"] == 4.0), {})
+        trace_cmp = artifact["tracing_overhead"]
         print(json.dumps({
             "legacy_e2e_p50_ms": legacy.get("e2e_p50_ms"),
             "overlapped_e2e_p50_ms": overlap.get("e2e_p50_ms"),
@@ -479,8 +579,12 @@ def main(argv=None):
             "overload_4x_bulk_shed": (
                 sweep_4x.get("bulk_offered", 0)
                 - sweep_4x.get("bulk_completed", 0)),
+            "tracing_p50_ratio": trace_cmp.get("p50_ratio"),
+            "tracing_within_gate": trace_cmp.get("within_gate"),
         }))
-        return 0
+        # within_gate is always present (False on a failed measurement):
+        # the gate fails closed.
+        return 0 if trace_cmp.get("within_gate") else 3
 
     import jax
 

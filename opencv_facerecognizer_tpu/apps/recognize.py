@@ -213,6 +213,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "second), always (fsync per record). The "
                         "enrollment WAL always runs at 'always' — its "
                         "acknowledgments promise durability")
+    # ---- frame-lifecycle tracing / flight recorder / exposition
+    # (utils.tracing, runtime.expo; README "Observability") ----
+    p.add_argument("--trace-sample", type=float, default=0.0,
+                   help="frame-trace sampling rate in [0, 1]: each sampled "
+                        "frame records causal spans (receive -> queue_wait "
+                        "-> settle, with batch ancestry) into bounded "
+                        "per-topic ring buffers. Deterministic per trace "
+                        "id. 0 (default) = frame tracing off; lifecycle "
+                        "spans (checkpoint/WAL/retrain/brownout) are "
+                        "always recorded once a tracer exists")
+    p.add_argument("--trace-ring", type=int, default=4096,
+                   help="spans kept per topic ring (the flight recorder's "
+                        "horizon)")
+    p.add_argument("--trace-jsonl", metavar="PATH",
+                   help="additionally stream every span as JSONL into this "
+                        "bounded rotating file (offline analysis beyond "
+                        "the ring horizon; adds a file write per span)")
+    p.add_argument("--flight-dir", metavar="DIR",
+                   help="flight-recorder dump directory: the span rings "
+                        "are dumped atomically here on dead-letter, "
+                        "supervisor restart, wedge detection, and SIGTERM "
+                        "drain (bounded retention; dump path rides the "
+                        "dead-letter journal record)")
+    p.add_argument("--expo-port", type=int, default=None, metavar="PORT",
+                   help="serve the read-only observability endpoint "
+                        "(GET /metrics /ledger /brownout /spans "
+                        "/attribution as JSON) on this TCP port; 0 binds "
+                        "an ephemeral port (printed on stderr). Off-hot-"
+                        "path threads; unset = off")
     return p
 
 
@@ -344,9 +373,32 @@ def main(argv=None) -> int:
     pipeline, names = _load_stack(args)
     metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
     metrics = Metrics(sink=metrics_sink)
+
+    # Frame-lifecycle tracer: built whenever ANY observability surface is
+    # requested (sampled frame spans, flight dumps, span JSONL, or the
+    # expo endpoint — lifecycle spans make the latter two useful even at
+    # sample 0). None otherwise: tracing fully off costs nothing.
+    from opencv_facerecognizer_tpu.utils.tracing import (
+        Tracer, make_span_journal,
+    )
+
+    tracer = None
+    span_journal = None
+    if (args.trace_sample > 0 or args.flight_dir or args.trace_jsonl
+            or args.expo_port is not None):
+        if args.trace_jsonl:
+            span_journal = make_span_journal(args.trace_jsonl,
+                                             metrics=metrics)
+        tracer = Tracer(ring_size=args.trace_ring,
+                        sample=args.trace_sample,
+                        dump_dir=args.flight_dir,
+                        span_sink=span_journal,
+                        metrics=metrics)
+
     quantizer = getattr(pipeline.gallery, "quantizer", None)
     if quantizer is not None:
         quantizer.metrics = metrics
+        quantizer.tracer = tracer
 
     admission = None
     if args.max_inflight_frames > 0 or args.rate_limit_fps > 0:
@@ -367,6 +419,7 @@ def main(argv=None) -> int:
             keep_checkpoints=args.keep_checkpoints,
             checkpoint_wal_rows=args.checkpoint_wal_rows,
             checkpoint_every_s=args.checkpoint_every_s,
+            tracer=tracer,
         )
         # Startup recovery: newest verified checkpoint + WAL replay
         # supersede the fresh --gallery enrollment (the baseline rows are
@@ -431,9 +484,19 @@ def main(argv=None) -> int:
         # job degrades to CPU speed instead of wedging (README "Failure
         # handling"). Only reachable with --probe-on-degraded.
         cpu_fallback=rebuild_pipeline_on_cpu if args.probe_on_degraded else None,
+        tracer=tracer,
     )
     supervisor = (ServiceSupervisor(service, state=state)
                   if args.supervised else None)
+    expo = None
+    if args.expo_port is not None:
+        from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
+
+        expo = ExpoServer(service, tracer=tracer, metrics=metrics,
+                          port=args.expo_port)
+        expo.start()
+        print(f"expo endpoint: http://{expo.host}:{expo.port}/",
+              file=sys.stderr)
     if supervisor is not None:
         supervisor.start()
     else:
@@ -525,9 +588,14 @@ def main(argv=None) -> int:
             graceful_shutdown,
         )
 
+        if expo is not None:
+            expo.stop()
         shutdown = graceful_shutdown(service, state=state,
                                      supervisor=supervisor,
                                      drain_timeout=0.0 if interrupted else 30.0)
+        if shutdown.get("flight_dump"):
+            print(f"flight-recorder dump: {shutdown['flight_dump']}",
+                  file=sys.stderr)
         if state is not None:
             print(f"final checkpoint: "
                   f"{'written' if shutdown['final_checkpoint'] else 'FAILED (previous kept)'}",
@@ -539,6 +607,8 @@ def main(argv=None) -> int:
             print(f"admission ledger: {shutdown['ledger']}", file=sys.stderr)
         if journal is not None:
             journal.close()
+        if span_journal is not None:
+            span_journal.close()
         if metrics_sink:
             metrics_sink.close()
     return 0

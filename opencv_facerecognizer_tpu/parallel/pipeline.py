@@ -254,6 +254,11 @@ class RecognitionPipeline:
         ivf = self.gallery._ivf_data(data)  # one epoch-checked quantizer read
         key = self._step_key(frames, data, ivf)
         packed = self._packed_cache.get(key)  # fetch once (evict race)
+        # Host-side dispatch provenance for the frame-lifecycle tracer's
+        # batch spans (runtime.recognizer reads it right after the call):
+        # plain attr store, best-effort — informational, never synchronized.
+        self.last_dispatch_info = {"cache_hit": packed is not None,
+                                   "mode": "ivf" if ivf is not None else "exact"}
         if packed is None:
             self._evict_stale_ivf(key)
             step = self._step_cache.get(key)

@@ -66,6 +66,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import numpy as np
 
 from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.tracing import LIFECYCLE_TOPIC
 
 #: sidecar file magic — identifies the framed quantizer-sidecar format
 #: (distinct from the OCVFSTATE gallery checkpoints it rides next to).
@@ -259,6 +260,10 @@ class CoarseQuantizer:
         self.kmeans_iters = int(kmeans_iters)
         self.train_sample = int(train_sample)
         self.metrics = metrics
+        #: optional utils.tracing.Tracer — one lifecycle span per retrain
+        #: attempt (outcome ok/skipped/failed), set alongside ``metrics``
+        #: by the serving app. Never touched on the match hot path.
+        self.tracer = None
         self._gallery = None  # set by ShardedGallery.attach_quantizer
         #: single published device snapshot (None == not ready; serving
         #: falls back to the exact matcher).
@@ -437,10 +442,15 @@ class CoarseQuantizer:
             if self.metrics is not None:
                 self.metrics.incr(mn.IVF_RETRAINS_SKIPPED_INFLIGHT)
             return False
+        span_t0 = time.monotonic()
+        outcome = "failed"
         try:
             if skip_if_ready and self._data is not None:
+                outcome = "already_ready"
                 return True
-            return self._rebuild_locked()
+            ok = self._rebuild_locked()
+            outcome = "ok" if ok else "fenced"
+            return ok
         except Exception:  # noqa: BLE001 — a failed retrain must leave the
             # previous quantizer (or the exact path) serving, never crash
             # an enroll/serving thread that triggered it.
@@ -450,6 +460,14 @@ class CoarseQuantizer:
             return False
         finally:
             self._train_lock.release()
+            if self.tracer is not None:
+                # One lifecycle span per retrain attempt, emitted after
+                # the single-flight guard is released.
+                self.tracer.emit(
+                    self.tracer.new_trace(), "ivf_retrain",
+                    topic=LIFECYCLE_TOPIC, t0=span_t0,
+                    dur=time.monotonic() - span_t0, outcome=outcome,
+                    nlist=self.nlist, version=self.version)
             if self._fence_refire:
                 # The epoch fence discarded this build (a swap/load/reset
                 # landed mid-train) AND that invalidation's poke was

@@ -19,6 +19,7 @@ from opencv_facerecognizer_tpu.runtime.connector import (
     JSONLConnector,
     MiddlewareConnector,
 )
+from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
 from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
 from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
 from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
@@ -41,6 +42,7 @@ __all__ = [
     "CheckpointStore",
     "DeadLetterJournal",
     "EnrollmentWAL",
+    "ExpoServer",
     "FakeConnector",
     "FaultInjector",
     "FrameBatcher",

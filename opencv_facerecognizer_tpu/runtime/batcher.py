@@ -64,12 +64,15 @@ from opencv_facerecognizer_tpu.utils import metric_names as mn
 class Batch(NamedTuple):
     """One device-ready batch plus the provenance the latency decomposition
     needs: ``enqueue_ts`` are the ``time.monotonic()`` stamps from ``put``
-    for the ``count`` real frames (queue-wait = pop time - enqueue time)."""
+    for the ``count`` real frames (queue-wait = pop time - enqueue time);
+    ``trace_ids`` are their frame-trace ids (0 = untraced/sampled out) so
+    the consumer can record which batch carried each frame."""
 
     frames: np.ndarray  # [B, H, W] in the batcher's dtype, zero-padded
     metas: List[Any]
     count: int
     enqueue_ts: List[float]
+    trace_ids: List[int]
 
 
 class FrameBatcher:
@@ -106,10 +109,17 @@ class FrameBatcher:
         # always before it can consume a dispatch slot. None disables.
         stale_after_s: Optional[float] = None,
         # Drop observer: called OUTSIDE the lock as ``drop_log(reason,
-        # entries)`` with entries = [{"meta", "enqueue_ts", "priority"}]
-        # for overflow/stale sheds (the service wires its dead-letter
-        # journal here). None = counters only.
+        # entries)`` with entries = [{"meta", "enqueue_ts", "priority",
+        # "trace_id", "stage"}] for overflow/stale sheds (the service
+        # wires its dead-letter journal here). None = counters only.
         drop_log=None,
+        # Frame-lifecycle tracer (utils.tracing.Tracer): every drop the
+        # batcher counts also emits the frame's terminal ``settle`` span
+        # (outcome = the ledger counter it landed in), outside the queue
+        # lock. ``trace_topic`` is the ring topic frame spans ride on
+        # (the service passes its FRAME_TOPIC). None = no spans.
+        tracer=None,
+        trace_topic: Optional[str] = None,
     ):
         self.batch_size = int(batch_size)
         self.frame_shape = tuple(frame_shape)
@@ -130,6 +140,8 @@ class FrameBatcher:
         self.stale_after_s = (None if stale_after_s is None
                               else float(stale_after_s))
         self._drop_log = drop_log
+        self._tracer = tracer
+        self._trace_topic = trace_topic
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._frames: deque = deque()
@@ -143,9 +155,12 @@ class FrameBatcher:
 
     # ---- producer side ----
 
-    def put(self, frame: np.ndarray, meta: Any = None, priority: int = 0) -> bool:
+    def put(self, frame: np.ndarray, meta: Any = None, priority: int = 0,
+            trace_id: int = 0) -> bool:
         """Enqueue one frame (smaller ``priority`` = more important);
-        returns False when dropped (malformed/closed/rejected-at-overflow)."""
+        returns False when dropped (malformed/closed/rejected-at-overflow).
+        ``trace_id`` is the frame's trace (0 = untraced); every drop path
+        emits its terminal span so traced frames never vanish silently."""
         if self.metrics is not None:
             self.metrics.incr(mn.BATCHER_FRAMES_OFFERED)
         if self._faults is not None:
@@ -156,18 +171,24 @@ class FrameBatcher:
                 self._dropped_malformed += 1
             if self.metrics is not None:
                 self.metrics.incr(mn.BATCHER_DROPPED_MALFORMED)
+            self._emit_settle(trace_id, mn.BATCHER_DROPPED_MALFORMED,
+                              "batcher.malformed")
             return False
         dropped = None  # (reason, entry) settled outside the lock
         accepted = True
+        closed = False
         with self._not_empty:
             if self._closed:
+                # Counted under the lock (the one sanctioned
+                # FrameBatcher._lock -> Metrics._lock nesting, cross-checked
+                # by the DebugLock backstop); the span emits outside below.
+                closed = True
                 if self.metrics is not None:
                     self.metrics.incr(mn.BATCHER_DROPPED_CLOSED)
-                return False
-            if len(self._frames) >= self.max_pending:
+            elif len(self._frames) >= self.max_pending:
                 dropped = self._evict_for(int(priority))
                 accepted = dropped is not None
-            if accepted:
+            if not closed and accepted:
                 if np.issubdtype(self.dtype, np.integer) and not np.issubdtype(
                         frame.dtype, np.integer):
                     # A bare astype would WRAP out-of-range floats (-3.0 ->
@@ -177,8 +198,13 @@ class FrameBatcher:
                     info = np.iinfo(self.dtype)
                     frame = np.clip(frame, info.min, info.max)
                 self._frames.append((frame.astype(self.dtype), meta,
-                                     time.monotonic(), int(priority)))
+                                     time.monotonic(), int(priority),
+                                     int(trace_id)))
                 self._not_empty.notify()
+        if closed:
+            self._emit_settle(trace_id, mn.BATCHER_DROPPED_CLOSED,
+                              "batcher.closed")
+            return False
         if not accepted:
             # The incoming frame was the least important thing in sight:
             # IT is the overflow victim, not a queued frame.
@@ -186,12 +212,17 @@ class FrameBatcher:
                 self._dropped_overflow += 1
             if self.metrics is not None:
                 self.metrics.incr(mn.BATCHER_DROPPED_OVERFLOW)
-            self._log_drop("overflow", [(meta, None, int(priority))])
+            self._emit_settle(trace_id, mn.BATCHER_DROPPED_OVERFLOW,
+                              "batcher.overflow")
+            self._log_drop("overflow", [(meta, None, int(priority),
+                                         int(trace_id))])
             return False
         if dropped is not None:
             reason, entry = dropped
             if self.metrics is not None:
                 self.metrics.incr(mn.BATCHER_DROPPED_PREFIX + reason)
+            self._emit_settle(entry[3], mn.BATCHER_DROPPED_PREFIX + reason,
+                              f"batcher.{reason}")
             self._log_drop(reason, [entry])
         return True
 
@@ -200,37 +231,48 @@ class FrameBatcher:
         overflow victim: the oldest already-stale frame if any, else the
         oldest frame of the least-important queued class — but only when
         that class is at least as unimportant as the incoming frame.
-        Returns ``(reason, (meta, enqueue_ts, priority))`` for the evicted
-        frame, or None when the INCOMING frame should be rejected instead
-        (everything queued outranks it)."""
+        Returns ``(reason, (meta, enqueue_ts, priority, trace_id))`` for
+        the evicted frame, or None when the INCOMING frame should be
+        rejected instead (everything queued outranks it)."""
         if self.stale_after_s is not None and self._frames:
             # Only the head can be stale: enqueue stamps are nondecreasing,
             # so staleness is a deque prefix (same fact _shed_stale uses) —
             # no O(max_pending) scan on the per-put overflow path.
-            _f, meta, ts, pri = self._frames[0]
+            _f, meta, ts, pri, tid = self._frames[0]
             if time.monotonic() - ts > self.stale_after_s:
                 self._frames.popleft()
                 self._dropped_stale += 1
-                return "stale", (meta, ts, pri)
+                return "stale", (meta, ts, pri, tid)
         victim_idx, victim_pri = None, -1
-        for idx, (_f, _meta, _ts, pri) in enumerate(self._frames):
+        for idx, (_f, _meta, _ts, pri, _tid) in enumerate(self._frames):
             if pri > victim_pri:  # strictly-greater keeps the OLDEST of a class
                 victim_idx, victim_pri = idx, pri
         if victim_pri < incoming_priority:
             return None  # incoming is the least important: reject it
-        _f, meta, ts, pri = self._frames[victim_idx]
+        _f, meta, ts, pri, tid = self._frames[victim_idx]
         del self._frames[victim_idx]
         self._dropped_overflow += 1
-        return "overflow", (meta, ts, pri)
+        return "overflow", (meta, ts, pri, tid)
+
+    def _emit_settle(self, trace_id: int, outcome: str, where: str) -> None:
+        """Terminal span for a frame the batcher dropped (no-op untraced).
+        Always called OUTSIDE the queue lock — span emission is lock-free
+        but must never nest inside serving-path locks anyway."""
+        if self._tracer is not None and trace_id:
+            self._tracer.emit(trace_id, "settle", topic=self._trace_topic,
+                              outcome=outcome, where=where)
 
     def _log_drop(self, reason: str, items) -> None:
         """Hand dropped frames' metadata to the drop observer (journal).
         Called OUTSIDE the queue lock; a raising observer is its own bug
-        and must not poison the producer thread."""
+        and must not poison the producer thread. Entries carry the frame's
+        ``trace_id`` and the ``stage`` it died at, so a journal replay can
+        reconstruct where each dropped frame died."""
         if self._drop_log is None:
             return
-        entries = [{"meta": meta, "enqueue_ts": ts, "priority": pri}
-                   for meta, ts, pri in items]
+        entries = [{"meta": meta, "enqueue_ts": ts, "priority": pri,
+                    "trace_id": tid or None, "stage": f"batcher.{reason}"}
+                   for meta, ts, pri, tid in items]
         try:
             self._drop_log(reason, entries)
         except Exception:  # noqa: BLE001 — observer bugs stay theirs, but a
@@ -303,6 +345,9 @@ class FrameBatcher:
             if stale:
                 if self.metrics is not None:
                     self.metrics.incr(mn.BATCHER_DROPPED_STALE, len(stale))
+                for _meta, _ts, _pri, tid in stale:
+                    self._emit_settle(tid, mn.BATCHER_DROPPED_STALE,
+                                      "batcher.stale")
                 self._log_drop("stale", stale)
         if popped is None:
             return None
@@ -320,11 +365,13 @@ class FrameBatcher:
             frames[count:] = 0  # re-zero a reused buffer's padding lanes
         metas: List[Any] = [None] * self.batch_size
         enqueue_ts: List[float] = []
-        for i, (frame, meta, ts, _pri) in enumerate(items):
+        trace_ids: List[int] = []
+        for i, (frame, meta, ts, _pri, tid) in enumerate(items):
             frames[i] = frame
             metas[i] = meta
             enqueue_ts.append(ts)
-        return Batch(frames, metas, count, enqueue_ts)
+            trace_ids.append(tid)
+        return Batch(frames, metas, count, enqueue_ts, trace_ids)
 
     def _shed_stale(self, collector: List[tuple]) -> None:
         """Caller holds the lock. Frames are FIFO by enqueue time, so
@@ -333,9 +380,9 @@ class FrameBatcher:
             return
         now = time.monotonic()
         while self._frames and now - self._frames[0][2] > self.stale_after_s:
-            _frame, meta, ts, pri = self._frames.popleft()
+            _frame, meta, ts, pri, tid = self._frames.popleft()
             self._dropped_stale += 1
-            collector.append((meta, ts, pri))
+            collector.append((meta, ts, pri, tid))
 
     def _pop_batch_locked(self, block: bool, stale: List[tuple]):
         """Caller holds the lock: the wait/flush decision + the pop.

@@ -1,0 +1,298 @@
+"""Live exposition surface: a read-only HTTP endpoint over the serving
+runtime's observability state (observability layer, beside
+``utils.tracing``).
+
+Everything the runtime already knows about itself — ``Metrics.summary()``,
+the admission ledger, the brownout level, the tracer's recent spans and
+the derived stage-attribution gauges — was previously reachable only by
+publishing a ``stats`` control command into the frame stream, which (a)
+needs a connector client and (b) is unusable once the loop itself is the
+thing being debugged. ``ExpoServer`` exposes the same state over plain
+HTTP GET, served by its own threads so a wedged serving loop still
+answers (the counters, ledger and spans are all lock-light reads):
+
+======================  =====================================================
+path                    JSON payload
+======================  =====================================================
+``/``                   index: endpoints, brownout level, tracer stats
+``/metrics``            ``Metrics.summary()`` (counters + gauges +
+                        percentiles; empty windows report explicit nulls)
+``/ledger``             ``RecognizerService.ledger()`` — admitted /
+                        completed / drops_by_reason / in_system
+``/brownout``           ``{"level": n}``
+``/spans``              recent spans: ``?topic=<ring>&n=<max>`` (default:
+                        all topics merged, newest 256)
+``/attribution``        stage-attribution gauges, refreshed on read (see
+                        ``fold_attribution``)
+======================  =====================================================
+
+**Read-only contract**: every verb except GET is answered ``405 Method Not
+Allowed`` — this surface can never mutate the service, by construction
+(no handler writes anything). Requests/errors are counted on the shared
+Metrics surface (``expo_requests`` / ``expo_errors``).
+
+**Stage attribution** (``fold_attribution``): two derived gauge families
+registered in ``utils.metric_names``:
+
+- ``device_busy_fraction`` — union of the tracer's recent ``ready_wait``
+  batch-span intervals over a trailing window (the same interval-union
+  technique ``scripts/trace_summary.py`` applies to offline device
+  traces, fed from live spans — a periodic in-process probe instead of an
+  xplane capture);
+- ``stage_share_b<bucket>_<detect|crop|embed|match>`` — per-bucket stage
+  shares of the fused device step. The stages run inside ONE jitted call
+  at serving time (deliberately — the single-readback design), so live
+  per-stage splits are unobservable; the shares come from the committed
+  ablated-prefix measurements in ``BENCH_DETAIL.json``
+  (``stage_attribution.per_batch``, measured by ``bench.py`` on this
+  hardware) for exactly the buckets the dispatch spans show serving.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils import tracing
+
+#: the fused step's in-device stages, in execution order (bench.py's
+#: ablated-prefix stage table uses the same names).
+DEVICE_STAGES = ("detect", "crop", "embed", "match")
+
+#: default bench artifact location: resolved relative to the REPO (two
+#: levels above this module), not the process CWD — ``ocvf-recognize``
+#: launched from any directory must still find the committed stage table.
+DEFAULT_BENCH_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "BENCH_DETAIL.json")
+
+
+def load_stage_quotes(bench_path: str = DEFAULT_BENCH_PATH
+                      ) -> Dict[int, Dict[str, float]]:
+    """Per-batch-size stage cost quotes (ms) from the committed bench
+    artifact's ``stage_attribution.per_batch`` table; ``{}`` when the
+    artifact (or the section) is absent — the gauges are then simply not
+    set, never fabricated."""
+    try:
+        with open(bench_path) as fh:
+            table = json.load(fh)["stage_attribution"]["per_batch"]
+    except (OSError, KeyError, ValueError, TypeError):
+        return {}
+    out: Dict[int, Dict[str, float]] = {}
+    for batch, stages in table.items():
+        try:
+            out[int(batch)] = {
+                s: float(stages[s]["ms_per_batch"])
+                for s in DEVICE_STAGES if s in stages
+            }
+        except (KeyError, TypeError, ValueError):
+            continue
+    return out
+
+
+def fold_attribution(tracer, metrics, bench_path: str = DEFAULT_BENCH_PATH,
+                     window_s: float = 30.0,
+                     _quotes_cache: Dict[str, Any] = {}) -> Dict[str, float]:
+    """Fold the tracer's recent batch spans into the derived
+    stage-attribution gauges (module docstring); returns the values set.
+    Cheap enough for a periodic background refresh: one ring snapshot +
+    host arithmetic. A successfully loaded bench quote table is cached
+    per path in the (deliberately shared) default-arg dict; a MISS is
+    never cached — an artifact written after startup is picked up on the
+    next refresh instead of being pinned absent for the process life."""
+    out: Dict[str, float] = {}
+    if tracer is None or metrics is None:
+        return out
+    spans = tracer.snapshot(topic=tracing.BATCH_TOPIC)
+    busy = tracing.device_busy_fraction(spans, window_s=window_s)
+    metrics.set_gauge(mn.DEVICE_BUSY_FRACTION, busy)
+    out[mn.DEVICE_BUSY_FRACTION] = busy
+    quotes = _quotes_cache.get(bench_path)
+    if quotes is None:
+        quotes = load_stage_quotes(bench_path)
+        if quotes:
+            _quotes_cache[bench_path] = quotes
+    if not quotes:
+        return out
+    lo = time.monotonic() - window_s
+    buckets = {s.get("bucket") for s in spans
+               if s.get("stage") == "dispatch" and s["t0"] >= lo
+               and s.get("bucket")}
+    for bucket in buckets:
+        # Nearest measured batch size stands in for unmeasured buckets
+        # (the ladder defaults 8/32/128 match the bench sweep exactly).
+        nearest = min(quotes, key=lambda b: abs(b - bucket))
+        stage_ms = quotes[nearest]
+        total = sum(stage_ms.values())
+        if total <= 0:
+            continue
+        for stage, ms in stage_ms.items():
+            share = ms / total
+            metrics.set_gauge(mn.STAGE_SHARE_PREFIX + f"b{bucket}_{stage}",
+                              share)
+            out[mn.STAGE_SHARE_PREFIX + f"b{bucket}_{stage}"] = share
+    return out
+
+
+class ExpoServer:
+    """Read-only HTTP exposition of the serving runtime's state (module
+    docstring). ``port=0`` binds an ephemeral port (read ``.port`` after
+    construction). ``start()`` spawns the HTTP threads plus a background
+    gauge-refresh loop; ``stop()`` tears both down. Never wired into the
+    serving hot path — a wedged loop still answers."""
+
+    def __init__(self, service=None, tracer=None, metrics=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 refresh_s: float = 2.0,
+                 bench_path: str = DEFAULT_BENCH_PATH):
+        self.service = service
+        self.tracer = tracer if tracer is not None else getattr(
+            service, "tracer", None)
+        self.metrics = metrics if metrics is not None else getattr(
+            service, "metrics", None)
+        self.refresh_s = float(refresh_s)
+        self.bench_path = bench_path
+        self._started_t = time.monotonic()
+        self._stop = threading.Event()
+        self._refresh_thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None
+        expo = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Read-only contract: GET answers; every mutating verb is 405.
+            def do_GET(self):  # noqa: N802 — http.server API
+                expo._handle_get(self)
+
+            def do_POST(self):  # noqa: N802
+                expo._reject(self)
+
+            do_PUT = do_DELETE = do_PATCH = do_POST  # noqa: N815
+
+            def log_message(self, fmt, *args):  # silence per-request stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="ocvf-expo")
+        self._thread.start()
+        self._refresh_thread = threading.Thread(target=self._refresh_loop,
+                                                daemon=True,
+                                                name="ocvf-expo-refresh")
+        self._refresh_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if self._refresh_thread is not None:
+            self._refresh_thread.join(timeout=2.0)
+            self._refresh_thread = None
+
+    def _refresh_loop(self) -> None:
+        """Periodic fold of the derived gauges — off the hot path, so the
+        exposition surface stays current even when nobody polls it (the
+        gauges also land in the ``--metrics-jsonl`` stream)."""
+        while not self._stop.wait(timeout=self.refresh_s):
+            try:
+                fold_attribution(self.tracer, self.metrics,
+                                 bench_path=self.bench_path)
+            except Exception:  # noqa: BLE001 — refresh must never die
+                logging.getLogger(__name__).exception(
+                    "expo attribution refresh failed")
+                if self.metrics is not None:
+                    self.metrics.incr(mn.EXPO_ERRORS)
+
+    # ---- request handling ----
+
+    def payload(self, path: str, query: Dict[str, Any]) -> Dict[str, Any]:
+        """The JSON body for one GET path; raises ``KeyError`` on unknown
+        paths (mapped to 404). Pure reads — nothing here mutates the
+        service (the read-only contract's enforcement by construction)."""
+        service = self.service
+        if path in ("/", "/index"):
+            return {
+                "endpoints": ["/", "/metrics", "/ledger", "/brownout",
+                              "/spans", "/attribution"],
+                "uptime_s": round(time.monotonic() - self._started_t, 1),
+                "brownout_level": getattr(service, "brownout_level", None),
+                "tracer": (self.tracer.stats()
+                           if self.tracer is not None else None),
+            }
+        if path == "/metrics":
+            return dict(self.metrics.summary()) if self.metrics else {}
+        if path == "/ledger":
+            return service.ledger() if service is not None else {}
+        if path == "/brownout":
+            return {"level": getattr(service, "brownout_level", None)}
+        if path == "/spans":
+            if self.tracer is None:
+                return {"topics": [], "spans": []}
+            topic = (query.get("topic") or [None])[0]
+            try:
+                n = int((query.get("n") or [256])[0])
+            except (TypeError, ValueError):
+                n = 256
+            return {"topics": self.tracer.topics(),
+                    "spans": self.tracer.snapshot(topic=topic, limit=n)}
+        if path == "/attribution":
+            return fold_attribution(self.tracer, self.metrics,
+                                    bench_path=self.bench_path)
+        raise KeyError(path)
+
+    def _handle_get(self, handler) -> None:
+        if self.metrics is not None:
+            self.metrics.incr(mn.EXPO_REQUESTS)
+        parsed = urlparse(handler.path)
+        try:
+            body = self.payload(parsed.path, parse_qs(parsed.query))
+            status = 200
+        except KeyError:
+            body, status = {"error": f"unknown path {parsed.path!r}"}, 404
+        except Exception:  # noqa: BLE001 — a handler bug must answer 500
+            logging.getLogger(__name__).exception("expo request failed")
+            if self.metrics is not None:
+                self.metrics.incr(mn.EXPO_ERRORS)
+            body, status = {"error": "internal error"}, 500
+        blob = json.dumps(body, default=repr).encode("utf-8")
+        try:
+            handler.send_response(status)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(blob)))
+            handler.end_headers()
+            handler.wfile.write(blob)
+        except OSError:
+            pass  # client went away mid-response
+
+    def _reject(self, handler) -> None:
+        """Every non-GET verb: 405 — the read-only contract."""
+        if self.metrics is not None:
+            self.metrics.incr(mn.EXPO_REQUESTS)
+        blob = b'{"error": "read-only endpoint: GET only"}'
+        try:
+            handler.send_response(405)
+            handler.send_header("Allow", "GET")
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(blob)))
+            handler.end_headers()
+            handler.wfile.write(blob)
+        except OSError:
+            pass
+

@@ -130,7 +130,7 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
                          brownout_queue_wait_s: float = 0.05,
                          brownout_dwell_s: float = 0.3,
                          stale_after_s: float = 0.25,
-                         fault_injector=None, journal=None):
+                         fault_injector=None, journal=None, tracer=None):
     """The canonical deterministic overload harness: an
     ``InstantPipeline`` with a hard ``batch_size / dispatch_s`` frames/s
     capacity wall behind a ``RecognizerService`` with the full protection
@@ -161,6 +161,7 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
         dead_letter_journal=journal,
         shed_stale_after_s=stale_after_s,
         bucket_sizes=(max(1, batch_size // 2), batch_size),
+        tracer=tracer,
     )
     return pipeline, service, connector
 

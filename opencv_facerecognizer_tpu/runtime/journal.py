@@ -237,8 +237,17 @@ class DeadLetterJournal(RotatingJournal):
 
     @staticmethod
     def frame_entry(meta: Any = None, enqueue_ts: Optional[float] = None,
-                    priority: Optional[int] = None) -> Dict[str, Any]:
-        return {"meta": meta, "enqueue_ts": enqueue_ts, "priority": priority}
+                    priority: Optional[int] = None,
+                    trace_id: Optional[int] = None,
+                    stage: Optional[str] = None) -> Dict[str, Any]:
+        """One journaled frame: producer ``meta``, batcher enqueue stamp,
+        priority class — plus the frame's ``trace_id`` and the lifecycle
+        ``stage`` it died at (e.g. ``batcher.stale``,
+        ``readback.dead_letter``), so ``replay`` can reconstruct exactly
+        where each dropped frame's lifecycle ended and correlate it with a
+        flight-recorder dump's spans."""
+        return {"meta": meta, "enqueue_ts": enqueue_ts,
+                "priority": priority, "trace_id": trace_id, "stage": stage}
 
     def append(self, reason: str, frames: List[Dict[str, Any]],
                **extra: Any) -> None:
@@ -281,7 +290,10 @@ class DeadLetterJournal(RotatingJournal):
 
 
 def main(argv=None) -> int:
-    """Tiny ops helper: print a journal's records (oldest first)."""
+    """Tiny ops helper: print a journal's records (oldest first). Each
+    frame entry carries its ``trace_id`` and death ``stage`` (plus the
+    record-level ``dump`` path when a flight-recorder dump accompanied a
+    dead-letter), so ``--trace`` answers "where did frame X die"."""
     import argparse
     import sys
 
@@ -289,10 +301,17 @@ def main(argv=None) -> int:
         description="dump a dead-letter journal as JSON lines")
     parser.add_argument("path")
     parser.add_argument("--reason", help="only records with this reason")
+    parser.add_argument("--trace", type=int, default=None,
+                        help="only records holding a frame with this "
+                             "trace id (prints where that frame died)")
     args = parser.parse_args(argv)
     journal = DeadLetterJournal(args.path)
     for record in journal.records():
         if args.reason and record.get("reason") != args.reason:
+            continue
+        if args.trace is not None and not any(
+                f.get("trace_id") == args.trace
+                for f in record.get("frames", ())):
             continue
         sys.stdout.write(json.dumps(record) + "\n")
     return 0

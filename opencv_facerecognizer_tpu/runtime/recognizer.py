@@ -118,6 +118,7 @@ from opencv_facerecognizer_tpu.runtime.resilience import (
     is_transient_error,
 )
 from opencv_facerecognizer_tpu.utils.metrics import Metrics
+from opencv_facerecognizer_tpu.utils import tracing
 
 FRAME_TOPIC = "ocvfacerec/frames"
 RESULT_TOPIC = "ocvfacerec/results"
@@ -265,6 +266,12 @@ class RecognizerService:
         # forces a durable checkpoint. None keeps state memory-only (the
         # pre-durability behavior).
         state_store=None,
+        # Frame-lifecycle tracer (utils.tracing.Tracer): per-frame causal
+        # spans (receive -> queue_wait -> settle), per-batch spans
+        # (dispatch/ready_wait/publish with coalescing ancestry), brownout
+        # lifecycle spans, and the flight-recorder dump on dead-letter.
+        # None = tracing fully off (zero overhead).
+        tracer=None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -300,13 +307,16 @@ class RecognizerService:
         self._reject_pending: Dict[str, int] = {}
         self._reject_last_pub: Dict[str, float] = {}
         self._reject_lock = threading.Lock()
+        self.tracer = tracer
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
                                     dtype=transfer_dtype,
                                     metrics=self.metrics,
                                     fault_injector=fault_injector,
                                     target_latency_s=target_latency_s,
                                     stale_after_s=shed_stale_after_s,
-                                    drop_log=self._journal_drop)
+                                    drop_log=self._journal_drop,
+                                    tracer=tracer,
+                                    trace_topic=FRAME_TOPIC)
         self.inflight_depth = int(inflight_depth)
         self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
                                                         int(batch_size))
@@ -452,9 +462,42 @@ class RecognizerService:
     def _journal_drop(self, reason: str, entries: List[Dict[str, Any]],
                       **extra) -> None:
         """Append shed/lost frames to the dead-letter journal (no-op
-        without one). Also the batcher's ``drop_log`` hook."""
+        without one). Also the batcher's ``drop_log`` hook. Entries carry
+        ``trace_id`` + the ``stage`` the frame died at, so a replay can
+        reconstruct exactly where each dropped frame's lifecycle ended."""
         if self.journal is not None:
             self.journal.append(reason, entries, **extra)
+
+    @staticmethod
+    def _drop_entries(metas, enqueue_ts, trace_ids, stage: str,
+                      priority=None) -> List[Dict[str, Any]]:
+        """Journal entries for a run of dropped frames, aligned by index
+        (missing provenance lists degrade to None fields, same as the
+        pre-tracing rows)."""
+        n = len(metas)
+        return [{
+            "meta": metas[i],
+            "enqueue_ts": (enqueue_ts[i] if enqueue_ts is not None
+                           and i < len(enqueue_ts) else None),
+            "priority": priority,
+            "trace_id": (trace_ids[i] or None) if trace_ids is not None
+                        and i < len(trace_ids) else None,
+            "stage": stage,
+        } for i in range(n)]
+
+    def _trace_settle(self, trace_ids, outcome: str, where: str,
+                      batch: int = 0) -> None:
+        """Terminal ``settle`` span for each traced frame in the run —
+        every admitted frame must emit exactly one, with ``outcome``
+        either ``completed`` or the ledger drop counter it landed in (the
+        span-level mirror of the admission-ledger invariant)."""
+        tracer = self.tracer
+        if tracer is None:
+            return
+        for tid in trace_ids or ():
+            if tid:
+                tracer.emit(tid, tracing.SETTLE_STAGE, topic=FRAME_TOPIC,
+                            outcome=outcome, where=where, batch=batch)
 
     def _note_rejection(self, reason: str) -> None:
         """Count + (rate-limited) announce one admission rejection. The
@@ -524,9 +567,18 @@ class RecognizerService:
             self._set_brownout(level - 1, ewma)
 
     def _set_brownout(self, level: int, ewma: float) -> None:
+        prev = self._brownout_level
         self._brownout_level = level
         self._brownout_changed_at = time.monotonic()
         self.metrics.set_gauge(mn.BROWNOUT_LEVEL, level)
+        if self.tracer is not None:
+            # Instant lifecycle span: level transitions are the overload
+            # story's causal markers (a queue-wait balloon followed by a
+            # brownout span explains the shed settle spans after it).
+            self.tracer.emit(self.tracer.new_trace(), "brownout",
+                             topic=tracing.LIFECYCLE_TOPIC, level=level,
+                             from_level=prev,
+                             queue_wait_ewma_ms=round(ewma * 1e3, 2))
         if level > 0:
             self.metrics.incr(mn.BROWNOUT_TRANSITIONS)
             self._publish_status({"status": "brownout", "level": level,
@@ -578,33 +630,57 @@ class RecognizerService:
         # duplicate, flood, or corrupt the delivery (runtime.faults).
         messages = ([message] if self._faults is None
                     else self._faults.on_receive(message))
+        tracer = self.tracer
         for msg in messages:
             priority = parse_priority(msg.get("priority"))
+            # Trace starts at receive: the span covers wire-decode (when
+            # the connector stamped ``_recv_ts``) through the admission
+            # verdict. tid 0 = sampled out; every emit below no-ops.
+            tid = tracer.start_trace(topic) if tracer is not None else 0
+            if tid:
+                # ``_recv_ts`` is an optional producer/transport stamp
+                # (monotonic) for wire transports that record parse time;
+                # absent it, the receive span starts at handler entry.
+                t_recv = msg.get("_recv_ts") or time.monotonic()
             # Admission FIRST, decode second: a rejected frame must cost
             # ~nothing (the whole point of shedding at the front door).
             if self.admission is not None:
                 reason = self.admission.admit(topic, priority)
                 if reason is not None:
                     self._note_rejection(reason)
+                    if tid:
+                        # Rejected pre-admission: outside the ledger by
+                        # design — the receive span IS the terminal one.
+                        tracer.emit(tid, "receive", topic=topic, t0=t_recv,
+                                    dur=time.monotonic() - t_recv,
+                                    verdict="rejected_" + reason,
+                                    priority=priority)
                     continue
             # Admitted: from here on the frame is the ledger's problem —
             # it must end as completed or as exactly one counted drop.
             self.metrics.incr(mn.FRAMES_ADMITTED)
+            if tid:
+                tracer.emit(tid, "receive", topic=topic, t0=t_recv,
+                            dur=time.monotonic() - t_recv,
+                            verdict="admitted", priority=priority)
             try:
                 frame = decode_frame(msg) if "__frame__" in msg else np.asarray(
                     msg["frame"]
                 )
             except Exception:
                 self.metrics.incr(mn.FRAMES_MALFORMED)
+                self._trace_settle([tid], mn.FRAMES_MALFORMED, "decode")
                 continue
             if self._brownout_sheds_intake(priority):
                 self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT)
-                self._journal_drop("brownout", [
-                    {"meta": msg.get("meta"), "enqueue_ts": None,
-                     "priority": priority}], level=self._brownout_level)
+                self._trace_settle([tid], mn.FRAMES_DROPPED_BROWNOUT,
+                                   "intake.brownout")
+                self._journal_drop("brownout", self._drop_entries(
+                    [msg.get("meta")], None, [tid], "intake.brownout",
+                    priority=priority), level=self._brownout_level)
                 continue
             if not self.batcher.put(frame, meta=msg.get("meta"),
-                                    priority=priority):
+                                    priority=priority, trace_id=tid):
                 self.metrics.incr(mn.FRAMES_DROPPED)
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
@@ -810,14 +886,25 @@ class RecognizerService:
 
     def _serve_one(self, batch) -> None:
         frames, metas, count = batch.frames, batch.metas, batch.count
+        trace_ids = batch.trace_ids
+        tracer = self.tracer
+        # Batch trace: the coalescing ancestor every traced frame in this
+        # batch points at (queue_wait spans carry ``batch=<this id>``);
+        # batch-level spans (dispatch/ready_wait/publish) ride it. Never
+        # sampled independently — it exists iff any member frame is traced.
+        batch_tid = (tracer.new_trace()
+                     if tracer is not None and any(trace_ids) else 0)
         t0 = time.perf_counter()
         # Queue-wait: frame enqueue -> batch pop. The batching-delay
         # term of the end-to-end latency decomposition (continuous-batching
         # deadline + waiting for batch_size peers), measured per frame —
         # and the brownout controller's load signal (batch mean).
         now_mono = time.monotonic()
-        for ts in batch.enqueue_ts:
+        for ts, tid in zip(batch.enqueue_ts, trace_ids):
             self.metrics.observe(mn.QUEUE_WAIT, now_mono - ts)
+            if tid:
+                tracer.emit(tid, "queue_wait", topic=FRAME_TOPIC, t0=ts,
+                            dur=now_mono - ts, batch=batch_tid)
         if batch.enqueue_ts:
             self._note_queue_wait(
                 sum(now_mono - ts for ts in batch.enqueue_ts)
@@ -827,12 +914,13 @@ class RecognizerService:
         # with an explicit reason, not silently truncated.
         cap = self._brownout_bucket_cap()
         if cap is not None and count > cap:
-            shed_metas = metas[cap:count]
-            shed_ts = batch.enqueue_ts[cap:count]
             self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT, count - cap)
-            self._journal_drop("brownout", [
-                {"meta": m, "enqueue_ts": ts, "priority": None}
-                for m, ts in zip(shed_metas, shed_ts)],
+            self._trace_settle(trace_ids[cap:count],
+                               mn.FRAMES_DROPPED_BROWNOUT,
+                               "dispatch.brownout_trim", batch=batch_tid)
+            self._journal_drop("brownout", self._drop_entries(
+                metas[cap:count], batch.enqueue_ts[cap:count],
+                trace_ids[cap:count], "dispatch.brownout_trim"),
                 level=self._brownout_level)
             count = cap
         accounted = False
@@ -849,9 +937,11 @@ class RecognizerService:
                 # for drain() accounting (and an explicit per-frame drop
                 # in the admission ledger + journal).
                 self.metrics.incr(mn.FRAMES_FAILED, count)
-                self._journal_drop("failed", [
-                    {"meta": m, "enqueue_ts": ts, "priority": None}
-                    for m, ts in zip(metas[:count], batch.enqueue_ts[:count])])
+                self._trace_settle(trace_ids[:count], mn.FRAMES_FAILED,
+                                   "dispatch.abandoned", batch=batch_tid)
+                self._journal_drop("failed", self._drop_entries(
+                    metas[:count], batch.enqueue_ts[:count],
+                    trace_ids[:count], "dispatch.abandoned"))
                 self._mark_completed()
                 accounted = True
                 self.batcher.recycle(frames)
@@ -863,7 +953,8 @@ class RecognizerService:
             deadline = time.monotonic() + self.resilience.readback_deadline_s
             with self._inflight_cv:
                 self._inflight.append((packed, frames, metas, count,
-                                       batch.enqueue_ts, t0, t_disp, deadline))
+                                       batch.enqueue_ts, t0, t_disp, deadline,
+                                       trace_ids, batch_tid))
                 accounted = True
                 self._inflight_cv.notify_all()
         except BaseException:
@@ -873,10 +964,23 @@ class RecognizerService:
                 # supervisor restarts the loop — and its frames land in
                 # the ledger's crash bucket, not in limbo.
                 self.metrics.incr(mn.FRAMES_DROPPED_CRASHED, count)
+                self._trace_settle(trace_ids[:count],
+                                   mn.FRAMES_DROPPED_CRASHED,
+                                   "dispatch.crashed", batch=batch_tid)
                 self._mark_completed()
             raise
         self.metrics.incr(mn.BATCHES_DISPATCHED)
         self.metrics.incr(mn.FRAMES_PROCESSED, count)
+        if batch_tid:
+            # Bucketed-dispatch provenance: bucket size, jit-cache verdict
+            # and exact-vs-ivf matcher mode (the pipeline records both on
+            # dispatch), plus the brownout level the batch served under.
+            info = getattr(self.pipeline, "last_dispatch_info", None) or {}
+            tracer.emit(batch_tid, "dispatch", topic=tracing.BATCH_TOPIC,
+                        dur=t_disp - t0, bucket=bucket, frames=count,
+                        cache_hit=info.get("cache_hit"),
+                        mode=info.get("mode"),
+                        brownout=self._brownout_level)
         if bucket < self.batcher.batch_size:
             self.metrics.incr(mn.BATCHES_BUCKETED)
         if self._use_worker:
@@ -1014,24 +1118,45 @@ class RecognizerService:
         return probe_for_recovery(timeout_s=self.resilience.probe_timeout_s)
 
     def _dead_letter(self, count: int, metas: Optional[List[Any]] = None,
-                     enqueue_ts: Optional[List[float]] = None) -> None:
+                     enqueue_ts: Optional[List[float]] = None,
+                     trace_ids: Optional[List[int]] = None,
+                     batch: int = 0) -> None:
         """Abandon a batch whose readback outlived its deadline: counted,
         announced, completed — never blocked on (SURVEY.md §5.3: an
         unhealthy accelerator degrades the job, never wedges it). The
         status message carries the dead frames' ids (their ``meta``) and
         enqueue timestamps so producers can retry, and the same entries
-        land in the dead-letter journal."""
+        land in the dead-letter journal. A dead-letter is also a
+        flight-recorder trigger: the span rings are dumped (rate-limited)
+        and the dump path rides the journal record, so "what was in
+        flight when this batch died" is answerable after the fact."""
         self.metrics.incr(mn.BATCHES_DEAD_LETTERED)
         self.metrics.incr(mn.FRAMES_DEAD_LETTERED, count)
         self._mark_completed()
-        entries = [{
-            "meta": metas[i] if metas is not None else None,
-            "enqueue_ts": (enqueue_ts[i]
-                           if enqueue_ts is not None and i < len(enqueue_ts)
-                           else None),
-            "priority": None,
-        } for i in range(count)]
-        self._journal_drop("dead_letter", entries)
+        # Slice every provenance list to ``count``: metas is the PADDED
+        # [batch_size] list, and after a brownout trim the enqueue_ts/
+        # trace_ids lists still hold the trimmed (already settled) frames
+        # beyond count — journaling or re-settling those would invent
+        # phantom rows / duplicate terminal spans.
+        metas = (list(metas[:count]) if metas is not None
+                 else [None] * count)
+        enqueue_ts = enqueue_ts[:count] if enqueue_ts is not None else None
+        trace_ids = trace_ids[:count] if trace_ids is not None else None
+        self._trace_settle(trace_ids if trace_ids is not None else (),
+                           mn.FRAMES_DEAD_LETTERED, "readback.dead_letter",
+                           batch=batch)
+        dump = None
+        if self.tracer is not None:
+            if batch:
+                self.tracer.emit(batch, "dead_letter",
+                                 topic=tracing.BATCH_TOPIC, frames=count)
+            dump = self.tracer.dump("dead_letter",
+                                    extra={"frames": count,
+                                           "ledger": self.ledger()})
+        entries = self._drop_entries(metas, enqueue_ts, trace_ids,
+                                     "readback.dead_letter")
+        extra = {"dump": dump} if dump else {}
+        self._journal_drop("dead_letter", entries, **extra)
         self._publish_status({
             "status": "dead_letter",
             "frames": count,
@@ -1080,7 +1205,7 @@ class RecognizerService:
                         return
                     continue
                 packed, frames, metas, count, enqueue_ts, t0, t_disp, \
-                    deadline = self._inflight[0]
+                    deadline, trace_ids, batch_tid = self._inflight[0]
             try:
                 ready = self._await_ready(packed, deadline)
             except Exception:  # noqa: BLE001 — outage at the readback side
@@ -1100,10 +1225,11 @@ class RecognizerService:
                 # read of this exact host array may still be pending —
                 # reusing it would race the outage we just survived. The
                 # pool refills from completed batches.
-                self._dead_letter(count, metas, enqueue_ts)
+                self._dead_letter(count, metas, enqueue_ts, trace_ids,
+                                  batch_tid)
                 continue
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp)
+                                t0, t_disp, trace_ids, batch_tid)
 
     def _await_ready(self, packed, deadline: float) -> bool:
         """Wait for one batch's transfer, bounded by its deadline. Returns
@@ -1149,8 +1275,8 @@ class RecognizerService:
         deadline — never an unbounded blocking readback a hang-mode outage
         could wedge."""
         while self._inflight:
-            packed, frames, metas, count, enqueue_ts, t0, t_disp, deadline = \
-                self._inflight[0]
+            packed, frames, metas, count, enqueue_ts, t0, t_disp, deadline, \
+                trace_ids, batch_tid = self._inflight[0]
             ready = self._is_ready(packed)
             if not ready:
                 if time.monotonic() >= deadline:
@@ -1158,7 +1284,8 @@ class RecognizerService:
                     # an async read on this staging buffer (see the worker
                     # path's dead-letter note).
                     self._pop_inflight_head()
-                    self._dead_letter(count, metas, enqueue_ts)
+                    self._dead_letter(count, metas, enqueue_ts, trace_ids,
+                                      batch_tid)
                     continue
                 if not (force or len(self._inflight) > self.inflight_depth):
                     break
@@ -1169,14 +1296,15 @@ class RecognizerService:
                     ready = self._is_ready(packed)
                 if not ready:
                     self._pop_inflight_head()
-                    self._dead_letter(count, metas, enqueue_ts)  # no recycle
+                    self._dead_letter(count, metas, enqueue_ts, trace_ids,
+                                      batch_tid)  # no recycle
                     continue
             self._pop_inflight_head()
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp)
+                                t0, t_disp, trace_ids, batch_tid)
 
     def _complete_head(self, packed, frames, metas, count, enqueue_ts,
-                       t0, t_disp) -> None:
+                       t0, t_disp, trace_ids=(), batch_tid=0) -> None:
         """Materialize + publish one POPPED batch and settle its accounting
         — the shared tail of the readback worker and the fallback drain
         (the two paths must stay behaviorally identical apart from
@@ -1204,17 +1332,28 @@ class RecognizerService:
                 "readback materialize failed")
             self.metrics.incr(mn.READBACK_ERRORS)
             # completed++, no recycle (see above)
-            self._dead_letter(count, metas, enqueue_ts)
+            self._dead_letter(count, metas, enqueue_ts, trace_ids, batch_tid)
             return
-        self.metrics.observe(mn.READY_WAIT, time.perf_counter() - t_disp)
+        ready_dur = time.perf_counter() - t_disp
+        self.metrics.observe(mn.READY_WAIT, ready_dur)
+        if batch_tid:
+            # Dispatch -> readback-complete: the device round-trip term
+            # (perf_counter durations are epoch-free, so the span rides a
+            # fresh monotonic stamp minus the measured duration).
+            self.tracer.emit(batch_tid, "ready_wait",
+                             topic=tracing.BATCH_TOPIC, dur=ready_dur,
+                             frames=count)
         t_pub = time.perf_counter()
         try:
-            self._publish(arr, frames, metas, count)
+            self._publish(arr, frames, metas, count, trace_ids, batch_tid)
         except BaseException:
             self._mark_completed()
             raise
         self._mark_completed()
         now = time.perf_counter()
+        if batch_tid:
+            self.tracer.emit(batch_tid, "publish", topic=tracing.BATCH_TOPIC,
+                             dur=now - t_pub, frames=count)
         self.metrics.observe(mn.PUBLISH, now - t_pub)
         self.metrics.observe(mn.BATCH_LATENCY, now - t0)
         # Feed the continuous batcher's adaptive deadline with the
@@ -1227,7 +1366,8 @@ class RecognizerService:
             self._inflight.popleft()
             self._inflight_cv.notify_all()
 
-    def _publish(self, packed, frames, metas, count) -> None:
+    def _publish(self, packed, frames, metas, count, trace_ids=(),
+                 batch_tid=0) -> None:
         from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
 
         published = 0
@@ -1268,10 +1408,17 @@ class RecognizerService:
             # frames that made it out are completed; on a crash escaping
             # mid-batch the remainder lands in the crash bucket (the
             # publishing thread dies, the supervisor restarts it — the
-            # frames must not stay in limbo between those events).
+            # frames must not stay in limbo between those events). The
+            # terminal spans mirror the same split exactly.
             self.metrics.incr(mn.FRAMES_COMPLETED, published)
+            self._trace_settle(trace_ids[:published],
+                               tracing.OUTCOME_COMPLETED, "publish",
+                               batch=batch_tid)
             if published < count:
                 self.metrics.incr(mn.FRAMES_DROPPED_CRASHED, count - published)
+                self._trace_settle(trace_ids[published:count],
+                                   mn.FRAMES_DROPPED_CRASHED,
+                                   "publish.crashed", batch=batch_tid)
 
     # ---- enrolment (interactive-trainer protocol) ----
 

@@ -375,6 +375,14 @@ class ServiceSupervisor:
                     })
                 continue
             self.restarts += 1
+            # Flight-recorder dump BEFORE the restore/restart mutate
+            # anything: the rings hold exactly what was in flight when
+            # the loop died — the evidence a post-mortem needs.
+            tracer = getattr(service, "tracer", None)
+            if tracer is not None:
+                tracer.dump("supervisor_restart",
+                            extra={"restarts": self.restarts,
+                                   "ledger": service.ledger()})
             try:
                 self._restore_gallery()
             except Exception:
@@ -418,6 +426,17 @@ class ServiceSupervisor:
                 and now - self._last_progress_t > self.stall_warn_s):
             self._stall_warned = True
             service.metrics.incr(mn.SUPERVISOR_STALLS)
+            # Wedge detection is a flight-recorder trigger: the dump is
+            # the answer to "what was in flight when the soak wedged" —
+            # the spans of every undrained frame/batch at stall time.
+            tracer = getattr(service, "tracer", None)
+            if tracer is not None:
+                tracer.dump("wedge_stall", extra={
+                    "pending_frames": service.batcher.pending,
+                    "seconds_without_progress":
+                        round(now - self._last_progress_t, 1),
+                    "ledger": service.ledger(),
+                })
             self._publish(status_topic, {
                 "status": "stalled",
                 "pending_frames": service.batcher.pending,

@@ -67,6 +67,7 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.tracing import LIFECYCLE_TOPIC
 from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
 from opencv_facerecognizer_tpu.runtime.journal import RotatingJournal
 from opencv_facerecognizer_tpu.utils.serialization import (
@@ -600,10 +601,14 @@ class StateLifecycle:
                  checkpoint_every_s: float = 300.0,
                  wal_fsync: str = "always", wal_fsync_interval_s: float = 1.0,
                  wal_max_bytes: int = 64 << 20,
-                 fault_injector=None):
+                 fault_injector=None, tracer=None):
         self.state_dir = str(state_dir)
         os.makedirs(self.state_dir, exist_ok=True)
         self.metrics = metrics
+        #: optional utils.tracing.Tracer: lifecycle spans for WAL appends,
+        #: checkpoints and recovery (emitted AFTER the guarded sections —
+        #: span emission never runs under the enroll/checkpoint locks).
+        self.tracer = tracer
         self.checkpoint_wal_rows = int(checkpoint_wal_rows)
         self.checkpoint_every_s = float(checkpoint_every_s)
         self._faults = fault_injector
@@ -742,6 +747,13 @@ class StateLifecycle:
         poke = getattr(gallery, "_poke_quantizer", None)
         if poke is not None:
             poke()
+        if self.tracer is not None:
+            self.tracer.emit(
+                self.tracer.new_trace(), "recover", topic=LIFECYCLE_TOPIC,
+                replayed_records=report["replayed_records"],
+                replayed_rows=report["replayed_rows"],
+                checkpoint=report["recovered_checkpoint"],
+                gallery_size=int(gallery.size))
         return report
 
     def _restore_quantizer_locked(self, gallery, base_seq: int,
@@ -864,35 +876,50 @@ class StateLifecycle:
         apply them). Returns the record's sequence number; raises when the
         append fails — the caller must NOT acknowledge the enrollment."""
         n = int(np.asarray(labels).shape[0])
-        with self._enroll_lock:
-            # Burn the sequence BEFORE attempting the append: a failed
-            # strict append (fsync raised) may still have landed the full
-            # record bytes — reissuing the seq to the next enrollment
-            # would leave two enroll records sharing it, which replay
-            # cannot tell apart (phantom rows / cross-subject labels).
-            seq = self._wal_seq = self._wal_seq + 1
-            try:
-                self.wal.append_enroll(seq, embeddings, labels,
-                                       subject=subject, label=label)
-            except InjectedCrashError:
-                raise  # simulated kill: no post-mortem writes
-            except BaseException:
-                # Best-effort tombstone for the possibly-landed record;
-                # if this fails too the residual risk is the documented
-                # at-least-once replay of an UNacknowledged record.
-                self.wal.append_abort(seq)
-                raise
-            if apply_fn is not None:
+        t0 = time.monotonic()
+        ok = False
+        try:
+            with self._enroll_lock:
+                # Burn the sequence BEFORE attempting the append: a failed
+                # strict append (fsync raised) may still have landed the
+                # full record bytes — reissuing the seq to the next
+                # enrollment would leave two enroll records sharing it,
+                # which replay cannot tell apart (phantom rows /
+                # cross-subject labels).
+                seq = self._wal_seq = self._wal_seq + 1
                 try:
-                    apply_fn()
+                    self.wal.append_enroll(seq, embeddings, labels,
+                                           subject=subject, label=label)
+                except InjectedCrashError:
+                    raise  # simulated kill: no post-mortem writes
                 except BaseException:
-                    # The apply failed AFTER the record became durable: the
-                    # caller rolls the enrolment back and never
-                    # acknowledges it, so tombstone the record — replay
-                    # must not resurrect rows the live gallery never got.
+                    # Best-effort tombstone for the possibly-landed record;
+                    # if this fails too the residual risk is the documented
+                    # at-least-once replay of an UNacknowledged record.
                     self.wal.append_abort(seq)
                     raise
-            self._rows_since_ckpt += n
+                if apply_fn is not None:
+                    try:
+                        apply_fn()
+                    except BaseException:
+                        # The apply failed AFTER the record became durable:
+                        # the caller rolls the enrolment back and never
+                        # acknowledges it, so tombstone the record — replay
+                        # must not resurrect rows the live gallery never
+                        # got.
+                        self.wal.append_abort(seq)
+                        raise
+                self._rows_since_ckpt += n
+            ok = True
+        finally:
+            if self.tracer is not None:
+                # Emitted OUTSIDE the enroll lock (span emission never
+                # nests inside durability locks); ok=False marks a
+                # failed / rolled-back / crash-injected append — the
+                # lifecycle spans that explain a later recovery.
+                self.tracer.emit(self.tracer.new_trace(), "wal_append",
+                                 topic=LIFECYCLE_TOPIC, t0=t0,
+                                 dur=time.monotonic() - t0, rows=n, ok=ok)
         if self.metrics is not None:
             self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         self.maybe_checkpoint()
@@ -992,6 +1019,8 @@ class StateLifecycle:
         # it; failure paths restore the latch so ticks keep retrying.
         claimed_force = self._force_pending
         self._force_pending = False
+        span_t0 = time.monotonic()
+        span = {"outcome": "crashed", "wal_seq": None, "rows": None}
         try:
             gallery, names = self._targets()
             # Bounded wait for async-grow staged rows: a snapshot taken
@@ -1022,9 +1051,11 @@ class StateLifecycle:
                     # Short retry pause: each attempt already waited up to
                     # 30 s for the grow; don't spin a new worker per tick.
                     self._ckpt_retry_at = time.monotonic() + 5.0
+                    span["outcome"] = "deferred"
                     return False
                 wal_seq = self._wal_seq
                 rows_at = self._rows_since_ckpt
+                span.update(wal_seq=wal_seq, rows=rows_at)
                 emb, lab, val, size = gallery.snapshot()
                 names_copy = [] if names is None else list(names)
                 # IVF sidecar payload captured in the SAME critical
@@ -1064,6 +1095,7 @@ class StateLifecycle:
                                        + self._ckpt_retry_backoff_s)
                 self._ckpt_retry_backoff_s = min(
                     60.0, self._ckpt_retry_backoff_s * 2.0)
+                span["outcome"] = "save_failed"
                 return False
             if qpayload is not None:
                 # Sidecar AFTER the checkpoint is durable (a crash in
@@ -1098,9 +1130,18 @@ class StateLifecycle:
             self._ckpt_retry_at = 0.0
             if self.metrics is not None:
                 self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
+            span["outcome"] = "ok"
             return True
         finally:
             self._ckpt_lock.release()
+            if self.tracer is not None:
+                # Emitted after the single-flight lock is released:
+                # checkpoints are the slowest lifecycle machinery, and
+                # their spans (outcome: ok/deferred/save_failed/crashed)
+                # are what explains a recovery's starting point.
+                self.tracer.emit(self.tracer.new_trace(), "checkpoint",
+                                 topic=LIFECYCLE_TOPIC, t0=span_t0,
+                                 dur=time.monotonic() - span_t0, **span)
 
     def close(self) -> None:
         self._closed = True
@@ -1128,4 +1169,12 @@ def graceful_shutdown(service, state: Optional[StateLifecycle] = None,
     report["ledger"] = ledger
     report["clean"] = bool(drained and abs(ledger["in_system"]) < 1e-6
                            and (state is None or report["final_checkpoint"]))
+    # SIGTERM drain is a flight-recorder trigger: the final dump records
+    # everything that was in flight through the shutdown (forced past the
+    # rate limit — the LAST dump of a process must never be suppressed).
+    tracer = getattr(service, "tracer", None)
+    if tracer is not None:
+        report["flight_dump"] = tracer.dump(
+            "sigterm_drain", extra={"ledger": ledger,
+                                    "drained": drained}, force=True)
     return report

@@ -119,6 +119,16 @@ IVF_SIDECAR_LOADS = "ivf_sidecar_loads"
 IVF_SIDECAR_STALE = "ivf_sidecar_stale"
 IVF_SIDECAR_ERRORS = "ivf_sidecar_errors"
 
+# ---- tracing / flight recorder / exposition (utils.tracing, runtime.expo) --
+TRACE_DUMPS = "trace_dumps"
+TRACE_DUMP_ERRORS = "trace_dump_errors"
+EXPO_REQUESTS = "expo_requests"
+EXPO_ERRORS = "expo_errors"
+#: derived stage-attribution gauge family:
+#: ``stage_share_b<bucket>_<detect|crop|embed|match>``
+STAGE_SHARE_PREFIX = "stage_share_"
+DEVICE_BUSY_FRACTION = "device_busy_fraction"
+
 # ---- supervisor ------------------------------------------------------------
 SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"
 SUPERVISOR_RESTARTS = "supervisor_restarts"

@@ -82,6 +82,21 @@ class Metrics:
         idx = min(int(q / 100.0 * len(values)), len(values) - 1)
         return values[idx]
 
+    def reset_window(self, name: Optional[str] = None) -> None:
+        """Clear one latency window (or all of them) without touching
+        counters/gauges — bench reuse between a warm phase and a measured
+        phase. A cleared window reports explicit ``None`` percentiles in
+        ``summary`` until it sees new observations (never stale or zero
+        values masquerading as measurements)."""
+        with self._lock:
+            if name is not None:
+                window = self._latencies.get(name)
+                if window is not None:
+                    window.clear()
+            else:
+                for window in self._latencies.values():
+                    window.clear()
+
     def log(self, event: str, **fields) -> None:
         if self._sink is None:
             return
@@ -94,13 +109,20 @@ class Metrics:
             self._sink.write(line + "\n")
             self._sink.flush()
 
-    def summary(self) -> Dict[str, float]:
+    def summary(self) -> Dict[str, Optional[float]]:
+        """Counters + gauges + per-window percentiles. A window that is
+        known but currently EMPTY (after ``reset_window``) reports
+        explicit ``None`` values — never a misleading zero, never a raise
+        — so a consumer can tell "no data yet" from "measured 0 ms"."""
         with self._lock:
-            out = dict(self._counters)
+            out: Dict[str, Optional[float]] = dict(self._counters)
             out.update(self._gauges)
             for name, values in self._latencies.items():
                 if values:
                     ordered = sorted(values)
                     out[f"{name}_p50_ms"] = ordered[len(ordered) // 2] * 1e3
                     out[f"{name}_p95_ms"] = ordered[min(int(0.95 * len(ordered)), len(ordered) - 1)] * 1e3
+                else:
+                    out[f"{name}_p50_ms"] = None
+                    out[f"{name}_p95_ms"] = None
         return out

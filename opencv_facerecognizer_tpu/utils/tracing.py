@@ -1,0 +1,377 @@
+"""Frame-lifecycle tracing: causal spans + flight recorder (observability
+layer).
+
+Before this module, a frame that vanished left behind aggregate counters
+(``utils/metrics.py``) and nothing else — nobody could answer "what
+happened to frame 48123" or "what was in flight when the soak wedged".
+This layer records one causal **span** per stage a frame passes through:
+
+    receive (verdict) -> queue_wait (batch ancestry) -> [batch trace:
+    dispatch / ready_wait / publish] -> settle (terminal outcome)
+
+plus **lifecycle spans** for the slow machinery (checkpoints, WAL appends,
+IVF retrains, brownout transitions, recovery). Spans are plain dicts held
+in **per-topic bounded ring buffers** — a flight recorder, not an archive:
+
+- **Emission is lock-free.** ``collections.deque`` appends are documented
+  thread-safe in CPython, so the hot path (connector thread, serving loop,
+  readback worker) never takes a lock to record a span; the tracer's
+  ``_lock`` guards only ring *creation* and dump bookkeeping, and never
+  nests inside (or around) any serving-path lock.
+- **Sampling is deterministic.** The per-trace keep/drop verdict is a pure
+  function of ``(seed, frame arrival index)`` (a Knuth multiplicative hash
+  over frame-trace ids, which have their own counter — span emission and
+  batch/lifecycle traces can never shift them), so a replayed chaos run
+  with the logged seed samples exactly the same frames whenever the frame
+  arrival order itself replays. ``sample=1.0`` traces everything — the
+  mode the chaos accounting check runs in; lifecycle and batch spans are
+  never sampled out.
+- **Terminal accounting.** Every admitted frame must end in exactly one
+  ``settle`` span whose ``outcome`` is either ``"completed"`` or the
+  ledger drop-counter name it was counted under — the span-level mirror of
+  the admission-ledger invariant ``admitted == completed + Σ drops``.
+  ``account_spans`` reduces a span list back to that ledger shape so the
+  chaos soak can cross-check them exactly.
+- **Flight recorder.** ``dump()`` writes the rings atomically
+  (``atomic_write_json`` — a crash mid-dump never leaves a torn file) to
+  ``dump_dir/flight-<seq>-<reason>.json`` with bounded retention, on wedge
+  detection, supervisor restart, SIGTERM drain, and dead-letter. Span
+  timestamps are ``time.monotonic()``; each dump header carries paired
+  monotonic + wall clocks so offline readers can convert.
+- **JSONL export.** An optional ``span_sink`` (a ``RotatingJournal`` from
+  ``make_span_journal``, sharing the dead-letter journal's bounded
+  rotating machinery) streams every emitted span as one JSON line — for
+  offline analysis beyond the ring's horizon. Off by default: it adds a
+  file write per span, which is what the sampling knob is for.
+
+Overhead: one dict + one deque append per span, ~3 spans per frame at
+``sample=1.0``. The bench gate (``bench_serving.py --smoke`` section
+``tracing_overhead``) holds the fully-enabled e2e p50 regression under 3%.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional
+
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.serialization import atomic_write_json
+
+#: ring topic for batch-level spans (dispatch / ready_wait / publish /
+#: dead_letter); frame spans ride the topic the frame arrived on.
+BATCH_TOPIC = "_batch"
+#: ring topic for lifecycle spans (checkpoint / wal_append / ivf_retrain /
+#: brownout / recover ...).
+LIFECYCLE_TOPIC = "_lifecycle"
+#: the terminal span stage every admitted frame must reach exactly once.
+SETTLE_STAGE = "settle"
+#: ``settle`` outcome of a frame that published a result; every other
+#: outcome is the admission-ledger drop-counter name it was counted under.
+OUTCOME_COMPLETED = "completed"
+
+_HASH_MULT = 2654435761  # Knuth multiplicative hash (mod 2^32)
+
+
+class Tracer:
+    """Per-topic span ring buffers with deterministic sampling and an
+    atomic flight-recorder dump (module docstring)."""
+
+    def __init__(self, ring_size: int = 4096, sample: float = 1.0,
+                 seed: int = 0, dump_dir: Optional[str] = None,
+                 keep_dumps: int = 8, min_dump_interval_s: float = 1.0,
+                 span_sink=None, metrics=None):
+        self.ring_size = max(1, int(ring_size))
+        self.sample = min(1.0, max(0.0, float(sample)))
+        self.seed = int(seed)
+        self.dump_dir = None if dump_dir is None else str(dump_dir)
+        self.keep_dumps = max(1, int(keep_dumps))
+        self.min_dump_interval_s = float(min_dump_interval_s)
+        #: optional RotatingJournal-shaped sink (``append_line``) streaming
+        #: every span as JSONL; non-strict — a sink failure never raises
+        #: into the serving path (the journal counts its own errors).
+        self.span_sink = span_sink
+        #: optional shared Metrics surface for DUMP accounting only — span
+        #: emission deliberately never touches the Metrics lock.
+        self.metrics = metrics
+        # THREE id streams (next() on each is atomic in CPython):
+        # - frame-trace ids (ODD): drawn in frame-arrival order ONLY, so
+        #   the sampling verdict for "the Nth arriving frame" is a pure
+        #   function of (seed, N) — batch/lifecycle traces and span
+        #   emission (whose interleaving is thread-timing dependent) must
+        #   not shift it between replayed runs;
+        # - batch/lifecycle trace ids (EVEN): disjoint from frame ids so
+        #   the two families can never collide in one span stream;
+        # - span ids: a global emission-order sequence for sorting only.
+        self._frame_ids = itertools.count(0)
+        self._aux_ids = itertools.count(1)
+        self._span_ids = itertools.count(1)
+        self._rings: Dict[str, deque] = {}
+        # Guards ring creation + dump bookkeeping ONLY; never held across
+        # emission, file I/O, or any call out of this class.
+        self._lock = threading.Lock()
+        self._dump_seq = itertools.count(1)
+        self._last_dump_t: Dict[str, float] = {}
+        if self.dump_dir is not None:
+            os.makedirs(self.dump_dir, exist_ok=True)
+
+    # ---- trace ids + sampling ----
+
+    def start_trace(self, topic: str) -> int:
+        """New frame trace id (odd), or 0 when sampled out (every ``emit``
+        with trace id 0 is a no-op — the whole frame records nothing).
+        Deterministic: the verdict is a pure function of (seed, arrival
+        index) — frame ids come from their own counter, so concurrent
+        span emission or batch/lifecycle traces can never shift which
+        frames a replayed run samples (replay determinism then only needs
+        the frame ARRIVAL order itself to be deterministic)."""
+        tid = 2 * next(self._frame_ids) + 1
+        if self.sample >= 1.0:
+            return tid
+        if self.sample <= 0.0:
+            return 0
+        h = ((tid + self.seed) * _HASH_MULT) & 0xFFFFFFFF
+        return tid if h < self.sample * 4294967296.0 else 0
+
+    def new_trace(self) -> int:
+        """Unconditional trace id (even) for batch/lifecycle traces —
+        never sampled out (they are few and carry the causal ancestry),
+        and disjoint from the frame-trace id space."""
+        return 2 * next(self._aux_ids)
+
+    # ---- emission (the hot path: no locks) ----
+
+    def _ring_for(self, topic: str) -> deque:
+        ring = self._rings.get(topic)
+        if ring is None:
+            with self._lock:  # first span on a topic only
+                ring = self._rings.setdefault(
+                    topic, deque(maxlen=self.ring_size))
+        return ring
+
+    def emit(self, trace_id: int, stage: str, topic: Optional[str] = None,
+             t0: Optional[float] = None, dur: float = 0.0,
+             **attrs: Any) -> None:
+        """Record one finished span. ``t0`` is ``time.monotonic()`` at
+        span start (defaults to now - dur); ``dur`` seconds. No-op for
+        trace id 0 (sampled out). Lock-free: one dict + one thread-safe
+        deque append."""
+        if not trace_id:
+            return
+        span: Dict[str, Any] = {
+            "trace": trace_id,
+            "span": next(self._span_ids),
+            "stage": stage,
+            "t0": (time.monotonic() - dur) if t0 is None else t0,
+            "dur": dur,
+        }
+        if attrs:
+            span.update(attrs)
+        self._ring_for(topic or BATCH_TOPIC).append(span)
+        sink = self.span_sink
+        if sink is not None:
+            sink.append_line(json.dumps({"topic": topic or BATCH_TOPIC,
+                                         **span}, default=repr))
+
+    @contextlib.contextmanager
+    def lifecycle(self, stage: str, **attrs: Any):
+        """Span a lifecycle operation: yields a mutable attr dict the
+        body may enrich; the span is emitted on exit with the measured
+        duration, ``ok`` False plus the error repr when the body raised
+        (re-raised).
+
+        Use this when the spanned body holds NO locks at exit. The
+        runtime's own lifecycle sites (WAL append, checkpoint, IVF
+        retrain) deliberately hand-roll the same t0/outcome/finally
+        pattern instead: their emission must fire strictly AFTER their
+        guard locks release — with a ``span_sink`` wired, ``emit`` does
+        file I/O, and I/O under ``_enroll_lock``/``_ckpt_lock``/
+        ``_train_lock`` is exactly what the blocking-under-lock
+        discipline forbids."""
+        tid = self.new_trace()
+        t0 = time.monotonic()
+        try:
+            yield attrs
+        except BaseException as exc:
+            attrs.setdefault("ok", False)
+            attrs.setdefault("error", repr(exc))
+            raise
+        finally:
+            attrs.setdefault("ok", True)
+            self.emit(tid, stage, topic=LIFECYCLE_TOPIC, t0=t0,
+                      dur=time.monotonic() - t0, **attrs)
+
+    # ---- reading ----
+
+    def topics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._rings)
+
+    def snapshot(self, topic: Optional[str] = None,
+                 limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Spans currently held (oldest first), one topic or all merged in
+        emission order. Emission is lock-free, so a concurrent append can
+        interrupt iteration (CPython raises RuntimeError) — retry a few
+        times rather than serialize the hot path against readers."""
+        if topic is not None:
+            rings = [self._rings.get(topic)]
+        else:
+            with self._lock:
+                rings = list(self._rings.values())
+        out: List[Dict[str, Any]] = []
+        for ring in rings:
+            if ring is None:
+                continue
+            for _ in range(8):
+                try:
+                    # Copy into a TEMP list first: a RuntimeError mid-extend
+                    # would otherwise leave a partial copy in ``out`` and
+                    # the retry would append the whole ring again —
+                    # duplicated spans that break dump accounting.
+                    copied = list(ring)
+                except RuntimeError:
+                    continue  # ring mutated mid-iteration: retry
+                out.extend(copied)
+                break
+        if topic is None:
+            out.sort(key=lambda s: s["span"])
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            per_topic = {t: len(r) for t, r in self._rings.items()}
+        return {"ring_size": self.ring_size, "sample": self.sample,
+                "spans_held": per_topic}
+
+    # ---- the flight recorder ----
+
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the current rings atomically to ``dump_dir`` as
+        ``flight-<seq>-<reason>.json``; returns the path, or None when no
+        dump dir is configured or the per-reason rate limit suppressed it
+        (``force`` bypasses the limit — the end-of-run / SIGTERM dumps
+        must always land). Retention keeps the newest ``keep_dumps``
+        files. Never raises: a recorder failure is counted
+        (``trace_dump_errors``) — observability must not hurt serving."""
+        if self.dump_dir is None:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if (not force and self.min_dump_interval_s > 0
+                    and now - self._last_dump_t.get(reason, float("-inf"))
+                    < self.min_dump_interval_s):
+                return None
+            self._last_dump_t[reason] = now
+            seq = next(self._dump_seq)
+        record = {
+            "schema": 1,
+            "reason": str(reason),
+            "seq": seq,
+            "ts_unix": time.time(),
+            "ts_monotonic": now,
+            "sample": self.sample,
+            "spans": {t: self.snapshot(t) for t in self.topics()},
+        }
+        if extra:
+            record["extra"] = extra
+        path = os.path.join(self.dump_dir, f"flight-{seq:06d}-{reason}.json")
+        try:
+            atomic_write_json(path, record)
+        except (OSError, TypeError, ValueError):
+            if self.metrics is not None:
+                self.metrics.incr(mn.TRACE_DUMP_ERRORS)
+            return None
+        if self.metrics is not None:
+            self.metrics.incr(mn.TRACE_DUMPS)
+        self._prune_dumps()
+        return path
+
+    def _prune_dumps(self) -> None:
+        try:
+            names = sorted(n for n in os.listdir(self.dump_dir)
+                           if n.startswith("flight-") and n.endswith(".json"))
+        except OSError:
+            return
+        for name in names[:-self.keep_dumps or None]:
+            try:
+                os.remove(os.path.join(self.dump_dir, name))
+            except OSError:
+                pass
+
+
+# ---- helpers ----
+
+
+def make_span_journal(path: str, max_bytes: int = 16 << 20,
+                      backups: int = 2, metrics=None):
+    """A bounded rotating JSONL sink for ``Tracer(span_sink=...)`` — the
+    dead-letter journal's ``RotatingJournal`` base reused for span export
+    (non-strict appends: a full disk costs spans, never serving).
+    Imported lazily so utils keeps no module-level dependency on the
+    runtime package."""
+    from opencv_facerecognizer_tpu.runtime.journal import RotatingJournal
+
+    return RotatingJournal(path, max_bytes=max_bytes, backups=backups,
+                           metrics=metrics, fsync="never")
+
+
+def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Reduce frame spans to admission-ledger shape: ``completed`` count +
+    per-outcome ``drops`` from the terminal ``settle`` spans, plus
+    ``traced`` (distinct traces that emitted a ``receive`` span with an
+    admitted verdict). With ``sample=1.0`` these must equal the service's
+    ``ledger()`` exactly — the chaos soak's span-accounting check."""
+    completed = 0
+    drops: Dict[str, int] = {}
+    admitted_traces = set()
+    for span in spans:
+        stage = span.get("stage")
+        if stage == "receive" and span.get("verdict") == "admitted":
+            admitted_traces.add(span.get("trace"))
+        elif stage == SETTLE_STAGE:
+            outcome = span.get("outcome")
+            if outcome == OUTCOME_COMPLETED:
+                completed += 1
+            elif outcome:
+                drops[outcome] = drops.get(outcome, 0) + 1
+    return {"traced": len(admitted_traces), "completed": completed,
+            "drops": drops}
+
+
+def device_busy_fraction(batch_spans: Iterable[Dict[str, Any]],
+                         window_s: float = 30.0,
+                         now: Optional[float] = None) -> float:
+    """Fraction of the trailing ``window_s`` the device spent on batch
+    round-trips, from ``ready_wait`` spans: the union of their
+    ``[t0, t0+dur]`` intervals over the window — the same interval-union
+    technique ``scripts/trace_summary.py`` applies to device trace lines,
+    fed from live spans instead of an offline xplane capture. Overlapping
+    in-flight batches are not double-counted."""
+    now = time.monotonic() if now is None else now
+    lo = now - window_s
+    ivals = sorted(
+        (max(s["t0"], lo), min(s["t0"] + s["dur"], now))
+        for s in batch_spans
+        if s.get("stage") == "ready_wait" and s["t0"] + s["dur"] > lo)
+    busy = 0.0
+    cur_s = cur_e = None
+    for s, e in ivals:
+        if e <= s:
+            continue
+        if cur_e is None or s > cur_e:
+            if cur_e is not None:
+                busy += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    if cur_e is not None:
+        busy += cur_e - cur_s
+    return busy / window_s if window_s > 0 else 0.0

@@ -40,12 +40,93 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _check_flight_dumps(trace_dir: str, failures: list,
+                        require: int = 1) -> list:
+    """Every scenario must leave at least ``require`` flight-recorder
+    dumps that parse as JSON with a span map — the observability
+    acceptance: after an induced crash/wedge/dead-letter there is always
+    evidence of what was in flight. Returns the dump paths."""
+    dumps = sorted(glob.glob(os.path.join(trace_dir, "flight-*.json")))
+    parsed = 0
+    for path in dumps:
+        try:
+            with open(path) as fh:
+                record = json.load(fh)
+            if not isinstance(record.get("spans"), dict):
+                raise ValueError("no span map")
+            parsed += 1
+        except (OSError, ValueError) as exc:
+            failures.append(f"flight dump unparseable: {path} ({exc})")
+    if parsed < require:
+        failures.append(f"flight recorder: {parsed} parseable dumps, "
+                        f"expected >= {require}")
+    return dumps
+
+
+def _check_span_accounting(dump_path: str, ring_size: int, ledger: dict,
+                           failures: list, where: str) -> dict:
+    """Span-level mirror of the admission-ledger invariant, read from the
+    FLIGHT DUMP itself (the acceptance artifact, not live tracer state):
+    with sample=1.0 and no ring eviction, the dump's terminal ``settle``
+    spans must reproduce ``completed`` and every per-reason drop count
+    exactly — each admitted frame has exactly one terminal span."""
+    from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+    from opencv_facerecognizer_tpu.utils import tracing
+
+    try:
+        with open(dump_path) as fh:
+            spans = json.load(fh)["spans"].get(FRAME_TOPIC, [])
+    except (OSError, ValueError, KeyError, TypeError) as exc:
+        failures.append(f"{where}: final dump unreadable: {exc}")
+        return {}
+    acct = tracing.account_spans(spans)
+    if len(spans) >= ring_size:
+        # The ring wrapped: early spans were evicted, exact accounting is
+        # no longer provable — size the ring up instead of asserting lies.
+        acct["ring_wrapped"] = True
+        return acct
+    if acct["completed"] != int(ledger["completed"]):
+        failures.append(
+            f"{where}: {acct['completed']} completed settle spans != "
+            f"ledger completed {ledger['completed']}")
+    want_drops = {k: int(v) for k, v in ledger["drops_by_reason"].items()}
+    if acct["drops"] != want_drops:
+        failures.append(f"{where}: settle-span drops {acct['drops']} != "
+                        f"ledger drops {want_drops}")
+    if acct["traced"] != int(ledger["admitted"]):
+        failures.append(f"{where}: {acct['traced']} admitted receive "
+                        f"spans != ledger admitted {ledger['admitted']}")
+    return acct
+
+
+def _finish_observability(tracer, trace_dir: str, reason: str, ledger: dict,
+                          quiesced: bool, failures: list,
+                          report: dict) -> None:
+    """The shared end-of-scenario observability acceptance: force a final
+    dump (rate limits must never suppress the LAST dump of a run), verify
+    every dump parses, cross-check the final dump's settle spans against
+    the settled ledger (only when the run actually quiesced), and clean
+    the temp trace dir. One body — the soak and overload scenarios must
+    enforce the identical contract."""
+    final_dump = tracer.dump(reason, extra={"ledger": ledger}, force=True)
+    flight_dumps = _check_flight_dumps(trace_dir, failures, require=1)
+    report["flight_dumps"] = len(flight_dumps)
+    if quiesced and final_dump:
+        report["span_accounting"] = _check_span_accounting(
+            final_dump, tracer.ring_size, ledger, failures,
+            "span accounting")
+    shutil.rmtree(trace_dir, ignore_errors=True)
 
 
 def build_stack(frame_shape=(64, 64), face=(16, 16), capacity=64, seed=0):
@@ -113,6 +194,14 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
     injector = FaultInjector(seed=seed, rates=rates)
     pipe, _mesh = build_stack(frame_shape=frame_shape, seed=seed % 997)
     connector = FakeConnector()
+    # Full-fidelity tracing (sample=1.0): the soak's span accounting must
+    # cover EVERY admitted frame, and every induced dead-letter/crash must
+    # leave a parseable flight-recorder dump behind.
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 16, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.1)
     service = RecognizerService(
         pipe, connector, batch_size=2, frame_shape=frame_shape,
         flush_timeout=0.02, inflight_depth=2,
@@ -121,6 +210,7 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
             readback_deadline_s=0.5, degraded_after=3,
         ),
         fault_injector=injector,
+        tracer=tracer,
     )
     supervisor = ServiceSupervisor(service, max_restarts=1000,
                                    poll_interval_s=0.05)
@@ -183,6 +273,8 @@ def run_soak(seconds: float = 10.0, seed: int | None = None,
     report["supervisor_restarts"] = supervisor.restarts
 
     failures = []
+    _finish_observability(tracer, trace_dir, "soak_end", ledger,
+                          ledger_quiesced, failures, report)
     if wedged:
         failures.append(f"wedged: liveness probe got {len(probe_results)}/"
                         f"{probe_n} results")
@@ -235,7 +327,6 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
        shed/dead-letter counters it mirrors.
     """
     import random as random_mod
-    import tempfile
 
     import numpy as np
 
@@ -272,11 +363,20 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
                                             suffix=".jsonl")
         os.close(fd)
     journal = DeadLetterJournal(journal_path, max_bytes=1 << 20)
+    # Full-fidelity tracing through the flood: shed frames must still
+    # settle exactly once each, and the run must leave a parseable
+    # flight-recorder dump.
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 17, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.25)
     # The service-under-test: the canonical overload harness (shared with
     # bench_serving.run_overload_sweep so both exercise one config).
     pipeline, service, connector = build_overload_stack(
         frame_shape=frame_shape, batch_size=batch_size,
-        dispatch_s=dispatch_s, fault_injector=injector, journal=journal)
+        dispatch_s=dispatch_s, fault_injector=injector, journal=journal,
+        tracer=tracer)
     supervisor = ServiceSupervisor(service, max_restarts=100,
                                    poll_interval_s=0.05)
     supervisor.start(warmup=False)
@@ -392,6 +492,8 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
 
     report["brownout_recovered"] = brownout_recovered
     failures = []
+    _finish_observability(tracer, trace_dir, "overload_end", ledger,
+                          quiesced, failures, report)
     if wedged:
         missing = [s for s in probe_seqs if s not in done_t]
         failures.append(f"wedged: liveness probe missing {len(missing)} results")
@@ -450,8 +552,6 @@ def run_recovery(seconds: float = 4.0, seed: int | None = None,
     leave the WAL empty.
     """
     import random as random_mod
-    import shutil
-    import tempfile
 
     import numpy as np
 
@@ -664,12 +764,21 @@ def run_recovery(seconds: float = 4.0, seed: int | None = None,
         pipe = InstantPipeline(frame_shape, dispatch_s=0.002)
         pipe.gallery = gallery
         connector = FakeConnector()
+        # Tracing through the drain: SIGTERM must force a final flight
+        # dump whose lifecycle spans show the WAL append + final
+        # checkpoint this phase performs.
+        from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+        trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+        tracer = Tracer(ring_size=1 << 14, sample=1.0, seed=seed,
+                        dump_dir=trace_dir)
         drain_state = StateLifecycle(state_dir, metrics=drain_metrics,
                                      checkpoint_wal_rows=1 << 30,
-                                     checkpoint_every_s=1e9)
+                                     checkpoint_every_s=1e9,
+                                     tracer=tracer)
         service = RecognizerService(
             pipe, connector, batch_size=4, frame_shape=frame_shape,
-            flush_timeout=0.02, state_store=drain_state)
+            flush_timeout=0.02, state_store=drain_state, tracer=tracer)
         # recover() was already run for this dir; bind fresh seq state so
         # the drain-phase enrollment sequences continue, not collide.
         drain_state.recover(gallery, names)
@@ -695,6 +804,26 @@ def run_recovery(seconds: float = 4.0, seed: int | None = None,
         results = len(connector.messages(RESULT_TOPIC))
         report["drain"] = {"sent": sent, "results": results,
                            "shutdown": {k: v for k, v in shutdown.items()}}
+        # Observability acceptance for the recovery scenario: the SIGTERM
+        # drain forces a flight dump; it must parse, and its lifecycle
+        # spans must show the durable work this phase performed.
+        _check_flight_dumps(trace_dir, failures, require=1)
+        dump_path = shutdown.get("flight_dump")
+        if not dump_path:
+            failures.append("graceful shutdown produced no flight dump")
+        else:
+            try:
+                with open(dump_path) as fh:
+                    dump_rec = json.load(fh)
+                life = [s["stage"] for s in
+                        dump_rec["spans"].get("_lifecycle", ())]
+                if "wal_append" not in life or "checkpoint" not in life:
+                    failures.append(f"drain dump lifecycle spans missing "
+                                    f"wal_append/checkpoint: {life}")
+                report["drain"]["flight_dump_lifecycle"] = sorted(set(life))
+            except (OSError, ValueError, KeyError) as exc:
+                failures.append(f"drain flight dump unreadable: {exc}")
+        shutil.rmtree(trace_dir, ignore_errors=True)
         if not shutdown["drained"]:
             failures.append("graceful drain timed out")
         if results != sent:

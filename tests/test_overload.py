@@ -119,7 +119,9 @@ def test_batcher_overflow_evicts_lowest_priority_first():
     assert b.put(_frame(), meta="inter1", priority=PRIORITY_INTERACTIVE)
     assert m.counter("batcher_dropped_overflow") == 1
     assert drops == [("overflow", [{"meta": "bulk0", "enqueue_ts": drops[0][1][0]["enqueue_ts"],
-                                    "priority": PRIORITY_BULK}])]
+                                    "priority": PRIORITY_BULK,
+                                    "trace_id": None,
+                                    "stage": "batcher.overflow"}])]
     batch = b.get_batch(block=False)
     assert batch.metas[:2] == ["inter0", "bulk1"]  # FIFO among survivors
 
@@ -190,7 +192,8 @@ def test_journal_append_records_and_replay(tmp_path):
     records = list(j.records())
     assert [r["reason"] for r in records] == ["dead_letter", "brownout"]
     assert records[0]["frames"][0] == {"meta": {"seq": 1}, "enqueue_ts": 2.5,
-                                       "priority": 0}
+                                       "priority": 0, "trace_id": None,
+                                       "stage": None}
     assert records[1]["level"] == 2
     assert m.counter("journal_records") == 2
     assert m.counter("journal_frames") == 3

@@ -1,0 +1,427 @@
+"""Frame-lifecycle tracing tests (observability layer): span lifecycle &
+ordering under the threaded serving pipeline, ring-buffer bounds,
+deterministic sampling, the flight-recorder dump on an injected wedge,
+the expo endpoint's read-only contract, and the Metrics empty-window /
+reset_window fixes that ride along.
+
+All over ``runtime.fakes.InstantPipeline`` — fast, deterministic, no
+hardware.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+from opencv_facerecognizer_tpu.runtime.expo import ExpoServer, fold_attribution
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
+from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    FRAME_TOPIC,
+    RecognizerService,
+)
+from opencv_facerecognizer_tpu.runtime.resilience import ResiliencePolicy
+from opencv_facerecognizer_tpu.utils import tracing
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+from opencv_facerecognizer_tpu.utils.tracing import Tracer, account_spans
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FRAME_HW = (16, 16)
+
+
+def _make_service(tracer, **kwargs):
+    pipeline = InstantPipeline(FRAME_HW, compute_s=0.001)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipeline, connector, batch_size=4, frame_shape=FRAME_HW,
+        flush_timeout=0.01, similarity_threshold=0.0, metrics=Metrics(),
+        tracer=tracer, **kwargs)
+    return pipeline, connector, service
+
+
+def _drive(connector, n, start=0):
+    frame = np.zeros(FRAME_HW, np.float32)
+    for i in range(start, start + n):
+        connector.inject(FRAME_TOPIC, {"frame": frame, "meta": {"seq": i}})
+
+
+# ---- span lifecycle & ordering under the threaded pipeline ----
+
+
+def test_span_lifecycle_and_ordering_through_pipeline():
+    tracer = Tracer(ring_size=4096, sample=1.0)
+    _pipe, connector, service = _make_service(tracer)
+    service.start(warmup=False)
+    try:
+        _drive(connector, 12)
+        assert service.drain(timeout=10.0)
+    finally:
+        service.stop()
+
+    frame_spans = tracer.snapshot(topic=FRAME_TOPIC)
+    by_trace = {}
+    for span in frame_spans:
+        by_trace.setdefault(span["trace"], []).append(span)
+    assert len(by_trace) == 12
+    batch_spans = tracer.snapshot(topic=tracing.BATCH_TOPIC)
+    dispatch_by_batch = {s["trace"]: s for s in batch_spans
+                        if s["stage"] == "dispatch"}
+    for spans in by_trace.values():
+        stages = [s["stage"] for s in spans]
+        # Causal order: receive -> queue_wait -> settle, in emission order
+        # (span ids are globally monotonic).
+        assert stages == ["receive", "queue_wait", "settle"]
+        assert spans[0]["span"] < spans[1]["span"] < spans[2]["span"]
+        assert spans[0]["verdict"] == "admitted"
+        assert spans[2]["outcome"] == tracing.OUTCOME_COMPLETED
+        # Coalescing ancestry: the queue_wait span names the batch trace
+        # that carried the frame, and that batch has a dispatch span with
+        # the bucket it served at.
+        batch = spans[1]["batch"]
+        assert batch and batch == spans[2]["batch"]
+        assert dispatch_by_batch[batch]["bucket"] >= 1
+    # Batch spans: every dispatched batch has its round-trip recorded.
+    stages = {s["stage"] for s in batch_spans}
+    assert {"dispatch", "ready_wait", "publish"} <= stages
+    # Span accounting mirrors the (settled) ledger exactly.
+    acct = account_spans(frame_spans)
+    ledger = service.ledger()
+    assert acct["completed"] == int(ledger["completed"]) == 12
+    assert acct["traced"] == int(ledger["admitted"])
+    assert acct["drops"] == {}
+
+
+def test_terminal_spans_cover_drops():
+    """A frame that dies in the batcher still settles exactly once, with
+    the ledger counter name as its outcome."""
+    tracer = Tracer(sample=1.0)
+    _pipe, connector, service = _make_service(tracer)
+    # Malformed decode: admitted, then fails decode_frame.
+    connector.inject(FRAME_TOPIC, {"__frame__": "corrupt!", "shape": [1],
+                                   "dtype": "float32", "meta": {}})
+    acct = account_spans(tracer.snapshot(topic=FRAME_TOPIC))
+    assert acct["drops"] == {"frames_malformed": 1}
+    # Wrong shape: the batcher's malformed drop settles the frame.
+    connector.inject(FRAME_TOPIC, {"frame": np.zeros((3, 3), np.float32)})
+    acct = account_spans(tracer.snapshot(topic=FRAME_TOPIC))
+    assert acct["drops"] == {"frames_malformed": 1,
+                             "batcher_dropped_malformed": 1}
+    ledger = service.ledger()
+    assert acct["traced"] == int(ledger["admitted"]) == 2
+    assert {k: float(v) for k, v in acct["drops"].items()} \
+        == ledger["drops_by_reason"]
+
+
+# ---- ring-buffer bounds ----
+
+
+def test_ring_buffer_bounded():
+    tracer = Tracer(ring_size=16, sample=1.0)
+    for i in range(100):
+        tracer.emit(tracer.new_trace(), "stage", topic="t", seq=i)
+    spans = tracer.snapshot(topic="t")
+    assert len(spans) == 16
+    # The ring keeps the NEWEST spans (flight-recorder semantics).
+    assert [s["seq"] for s in spans] == list(range(84, 100))
+
+
+# ---- deterministic sampling ----
+
+
+def test_sampling_deterministic_under_fixed_seed():
+    def sampled_set(seed, n=400, rate=0.5):
+        tracer = Tracer(sample=rate, seed=seed)
+        return {i for i in range(n) if tracer.start_trace("t")}
+
+    a = sampled_set(seed=42)
+    b = sampled_set(seed=42)
+    assert a == b  # same seed -> exactly the same kept traces
+    c = sampled_set(seed=43)
+    assert a != c  # a different seed samples a different subset
+    assert 0.3 < len(a) / 400 < 0.7  # and the rate is honored roughly
+
+
+def test_sampling_edge_rates():
+    always = Tracer(sample=1.0)
+    assert all(always.start_trace("t") for _ in range(50))
+    never = Tracer(sample=0.0)
+    assert not any(never.start_trace("t") for _ in range(50))
+    # Sampled-out frames record nothing anywhere.
+    never.emit(0, "receive", topic="t")
+    assert never.snapshot() == []
+
+
+# ---- flight recorder ----
+
+
+def test_flight_recorder_dump_on_injected_wedge(tmp_path):
+    """A scripted stuck readback (runtime.faults) dead-letters its batch;
+    the dead-letter must dump the rings atomically and thread the dump
+    path + per-frame trace ids into the dead-letter journal record."""
+    injector = FaultInjector(seed=3)
+    injector.script("readback", "stuck")
+    journal = DeadLetterJournal(str(tmp_path / "dead.jsonl"))
+    tracer = Tracer(sample=1.0, dump_dir=str(tmp_path / "flight"),
+                    min_dump_interval_s=0.0)
+    _pipe, connector, service = _make_service(
+        tracer, fault_injector=injector, dead_letter_journal=journal,
+        resilience=ResiliencePolicy(readback_deadline_s=0.2))
+    service.start(warmup=False)
+    try:
+        _drive(connector, 4)
+        assert service.drain(timeout=10.0)
+    finally:
+        service.stop()
+        journal.close()
+    assert service.metrics.counter("frames_dead_lettered") == 4
+    dumps = sorted(os.listdir(tmp_path / "flight"))
+    assert dumps, "dead-letter did not dump the flight recorder"
+    record = json.loads((tmp_path / "flight" / dumps[0]).read_text())
+    assert record["reason"] == "dead_letter"
+    assert record["extra"]["frames"] == 4
+    # Every dead frame has its terminal span in the dump.
+    acct = account_spans(record["spans"][FRAME_TOPIC])
+    assert acct["drops"] == {"frames_dead_lettered": 4}
+    # The journal row carries the dump path + per-frame trace_id/stage.
+    rows = [r for r in journal.records() if r["reason"] == "dead_letter"]
+    assert rows and rows[0]["dump"] == str(tmp_path / "flight" / dumps[0])
+    for frame in rows[0]["frames"]:
+        assert frame["stage"] == "readback.dead_letter"
+        assert frame["trace_id"]
+
+
+def test_dead_letter_slices_padded_and_trimmed_provenance(tmp_path):
+    """A partial batch dead-letters with count < batch_size (padded metas)
+    and count < len(trace_ids) (a brownout trim already settled the
+    tail): the journal must get exactly ``count`` rows and the trimmed
+    frames must NOT be settled a second time."""
+    tracer = Tracer(sample=1.0)
+    journal = DeadLetterJournal(str(tmp_path / "dead.jsonl"))
+    _pipe, _connector, service = _make_service(
+        tracer, dead_letter_journal=journal)
+    tids = [tracer.start_trace(FRAME_TOPIC) for _ in range(3)]
+    padded_metas = [{"seq": i} for i in range(3)] + [None] * 5  # batch_size pad
+    # count=2: the third frame was brownout-trimmed (settled elsewhere).
+    service._dead_letter(2, padded_metas, [1.0, 2.0, 3.0], tids,
+                         batch=tracer.new_trace())
+    journal.close()
+    rows = [r for r in journal.records() if r["reason"] == "dead_letter"]
+    assert len(rows[0]["frames"]) == 2  # count, not batch_size
+    assert [f["meta"] for f in rows[0]["frames"]] == [{"seq": 0}, {"seq": 1}]
+    acct = account_spans(tracer.snapshot(topic=FRAME_TOPIC))
+    assert acct["drops"] == {"frames_dead_lettered": 2}  # tids[2] untouched
+
+
+def test_dump_rate_limit_and_retention(tmp_path):
+    tracer = Tracer(sample=1.0, dump_dir=str(tmp_path), keep_dumps=3,
+                    min_dump_interval_s=60.0)
+    tracer.emit(tracer.new_trace(), "s", topic="t")
+    assert tracer.dump("dead_letter") is not None
+    assert tracer.dump("dead_letter") is None  # rate-limited
+    assert tracer.dump("dead_letter", force=True) is not None
+    for _ in range(5):
+        assert tracer.dump("end", force=True) is not None
+    names = [n for n in os.listdir(tmp_path) if n.startswith("flight-")]
+    assert len(names) == 3  # retention pruned the oldest
+
+
+def test_dump_without_dir_is_none():
+    tracer = Tracer(sample=1.0)
+    assert tracer.dump("anything", force=True) is None
+
+
+# ---- lifecycle spans ----
+
+
+def test_lifecycle_context_manager_records_errors():
+    tracer = Tracer(sample=1.0)
+    with tracer.lifecycle("checkpoint", wal_seq=7) as attrs:
+        attrs["rows"] = 3
+    with pytest.raises(RuntimeError):
+        with tracer.lifecycle("checkpoint"):
+            raise RuntimeError("boom")
+    spans = tracer.snapshot(topic=tracing.LIFECYCLE_TOPIC)
+    assert len(spans) == 2
+    assert spans[0]["ok"] and spans[0]["rows"] == 3 and spans[0]["wal_seq"] == 7
+    assert spans[1]["ok"] is False and "boom" in spans[1]["error"]
+
+
+def test_brownout_transition_emits_lifecycle_span():
+    from opencv_facerecognizer_tpu.runtime.resilience import BrownoutPolicy
+
+    tracer = Tracer(sample=1.0)
+    _pipe, _connector, service = _make_service(
+        tracer, brownout=BrownoutPolicy(queue_wait_s=0.01, dwell_s=0.0))
+    service._note_queue_wait(1.0)  # EWMA over threshold -> level 1
+    spans = [s for s in tracer.snapshot(topic=tracing.LIFECYCLE_TOPIC)
+             if s["stage"] == "brownout"]
+    assert spans and spans[0]["level"] == 1 and spans[0]["from_level"] == 0
+
+
+# ---- expo endpoint ----
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def test_expo_endpoint_read_only_contract():
+    tracer = Tracer(sample=1.0)
+    _pipe, connector, service = _make_service(tracer)
+    service.start(warmup=False)
+    expo = ExpoServer(service, tracer=tracer, metrics=service.metrics,
+                      port=0, bench_path=os.path.join(REPO_ROOT,
+                                                      "BENCH_DETAIL.json"))
+    expo.start()
+    base = f"http://{expo.host}:{expo.port}"
+    try:
+        _drive(connector, 8)
+        assert service.drain(timeout=10.0)
+
+        status, index = _get(base + "/")
+        assert status == 200 and "/metrics" in index["endpoints"]
+        status, metrics = _get(base + "/metrics")
+        assert status == 200
+        assert metrics["frames_completed"] == 8
+        status, ledger = _get(base + "/ledger")
+        assert ledger["admitted"] == 8 and ledger["in_system"] == 0
+        status, brownout = _get(base + "/brownout")
+        assert brownout["level"] == 0
+        status, spans = _get(base + f"/spans?topic={FRAME_TOPIC}&n=1000")
+        assert {s["stage"] for s in spans["spans"]} \
+            == {"receive", "queue_wait", "settle"}
+        status, attribution = _get(base + "/attribution")
+        assert status == 200 and "device_busy_fraction" in attribution
+
+        # Unknown path -> 404; every mutating verb -> 405 (read-only).
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(base + "/nope")
+        assert err.value.code == 404
+        for method in ("POST", "PUT", "DELETE"):
+            req = urllib.request.Request(base + "/metrics", data=b"{}",
+                                         method=method)
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5.0)
+            assert err.value.code == 405, method
+        assert service.metrics.counter("expo_requests") > 0
+    finally:
+        expo.stop()
+        service.stop()
+
+
+# ---- stage attribution ----
+
+
+def test_device_busy_fraction_interval_union():
+    now = 100.0
+    spans = [
+        {"stage": "ready_wait", "t0": 90.0, "dur": 2.0},
+        {"stage": "ready_wait", "t0": 91.0, "dur": 2.0},  # overlaps above
+        {"stage": "ready_wait", "t0": 95.0, "dur": 1.0},
+        {"stage": "dispatch", "t0": 96.0, "dur": 50.0},  # wrong stage
+        {"stage": "ready_wait", "t0": 10.0, "dur": 1.0},  # out of window
+    ]
+    busy = tracing.device_busy_fraction(spans, window_s=10.0, now=now)
+    assert busy == pytest.approx((3.0 + 1.0) / 10.0)
+
+
+def test_fold_attribution_sets_registered_gauges():
+    tracer = Tracer(sample=1.0)
+    batch_tid = tracer.new_trace()
+    tracer.emit(batch_tid, "dispatch", topic=tracing.BATCH_TOPIC,
+                dur=0.001, bucket=8, frames=8)
+    tracer.emit(batch_tid, "ready_wait", topic=tracing.BATCH_TOPIC, dur=0.01)
+    metrics = Metrics()
+    gauges = fold_attribution(tracer, metrics,
+                              bench_path=os.path.join(REPO_ROOT,
+                                                      "BENCH_DETAIL.json"))
+    assert "device_busy_fraction" in gauges
+    assert metrics.gauge("device_busy_fraction") >= 0.0
+    # Stage shares come from the committed bench stage table for the
+    # observed bucket, sum to ~1, and ride registered gauge names.
+    shares = {k: v for k, v in gauges.items()
+              if k.startswith("stage_share_b8_")}
+    if shares:  # only when BENCH_DETAIL.json carries the stage table
+        assert sum(shares.values()) == pytest.approx(1.0)
+        assert metrics.gauge("stage_share_b8_detect") == shares[
+            "stage_share_b8_detect"]
+
+
+# ---- Metrics empty/short-window fixes (satellite) ----
+
+
+def test_metrics_summary_empty_window_reports_nulls():
+    metrics = Metrics()
+    metrics.observe("queue_wait", 0.005)
+    assert metrics.summary()["queue_wait_p50_ms"] == pytest.approx(5.0)
+    metrics.reset_window("queue_wait")
+    summary = metrics.summary()
+    # Explicit nulls — never a stale value, a zero, or a KeyError.
+    assert summary["queue_wait_p50_ms"] is None
+    assert summary["queue_wait_p95_ms"] is None
+    assert np.isnan(metrics.percentile("queue_wait", 50))
+    # JSON-safe (the expo endpoint serves this dict verbatim).
+    json.dumps(summary)
+
+
+def test_metrics_reset_window_scopes():
+    metrics = Metrics()
+    metrics.observe("a", 0.001)
+    metrics.observe("b", 0.002)
+    metrics.incr("frames_completed", 3)
+    metrics.reset_window("a")
+    summary = metrics.summary()
+    assert summary["a_p50_ms"] is None
+    assert summary["b_p50_ms"] == pytest.approx(2.0)
+    metrics.reset_window()
+    assert metrics.summary()["b_p50_ms"] is None
+    # Counters are untouched by window resets.
+    assert metrics.counter("frames_completed") == 3
+
+
+# ---- journal CLI trace filter (satellite) ----
+
+
+def test_journal_cli_prints_trace_and_stage(tmp_path, capsys):
+    from opencv_facerecognizer_tpu.runtime import journal as journal_mod
+
+    path = str(tmp_path / "dead.jsonl")
+    journal = DeadLetterJournal(path)
+    journal.append("stale", [journal.frame_entry(
+        meta={"seq": 9}, enqueue_ts=1.0, priority=1, trace_id=77,
+        stage="batcher.stale")])
+    journal.append("dead_letter", [journal.frame_entry(
+        meta={"seq": 10}, trace_id=78, stage="readback.dead_letter")],
+        dump="/tmp/flight-x.json")
+    journal.close()
+    assert journal_mod.main([path, "--trace", "78"]) == 0
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 1
+    assert lines[0]["frames"][0]["trace_id"] == 78
+    assert lines[0]["frames"][0]["stage"] == "readback.dead_letter"
+    assert lines[0]["dump"] == "/tmp/flight-x.json"
+
+
+# ---- span JSONL export ----
+
+
+def test_span_sink_streams_jsonl(tmp_path):
+    from opencv_facerecognizer_tpu.utils.tracing import make_span_journal
+
+    sink = make_span_journal(str(tmp_path / "spans.jsonl"))
+    tracer = Tracer(sample=1.0, span_sink=sink)
+    tid = tracer.new_trace()
+    tracer.emit(tid, "receive", topic="frames", verdict="admitted")
+    tracer.emit(tid, "settle", topic="frames", outcome="completed")
+    sink.close()
+    rows = [json.loads(line) for line in
+            (tmp_path / "spans.jsonl").read_text().splitlines()]
+    assert [r["stage"] for r in rows] == ["receive", "settle"]
+    assert all(r["trace"] == tid and r["topic"] == "frames" for r in rows)

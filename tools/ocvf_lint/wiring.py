@@ -18,6 +18,7 @@ from typing import Dict, FrozenSet, Tuple
 #: interprocedural call resolution.
 ATTR_HINTS: Dict[str, str] = {
     "metrics": "Metrics",
+    "tracer": "Tracer",
     "batcher": "FrameBatcher",
     "gallery": "ShardedGallery",
     "quantizer": "CoarseQuantizer",
