@@ -238,10 +238,55 @@ def build_parser() -> argparse.ArgumentParser:
                         "dead-letter journal record)")
     p.add_argument("--expo-port", type=int, default=None, metavar="PORT",
                    help="serve the read-only observability endpoint "
-                        "(GET /metrics /ledger /brownout /spans "
-                        "/attribution as JSON) on this TCP port; 0 binds "
+                        "(GET /metrics /prom /health /ledger /brownout "
+                        "/spans /attribution) on this TCP port; 0 binds "
                         "an ephemeral port (printed on stderr). Off-hot-"
-                        "path threads; unset = off")
+                        "path threads; unset = off. /prom is Prometheus "
+                        "text format; /health is the SLO verdict (503 "
+                        "when critical)")
+    # ---- SLO burn-rate monitor (runtime.slo; README "Observability") ----
+    p.add_argument("--slo", action="store_true",
+                   help="run the SLO burn-rate monitor: interactive e2e "
+                        "p99, queue-wait p99, ledger completion ratio and "
+                        "(with --state-dir) durability lag evaluated on "
+                        "multi-window burn rates into an ok/warn/critical "
+                        "health state machine — served at /health, "
+                        "published on the status topic by the supervisor, "
+                        "consumed by brownout as intake pressure at "
+                        "critical, and dumped to the flight recorder on a "
+                        "critical transition")
+    p.add_argument("--slo-interval-s", type=float, default=5.0,
+                   help="seconds between SLO evaluations (the serving "
+                        "loop's tick cadence; the expo refresh thread "
+                        "backstops it when the loop wedges)")
+    p.add_argument("--slo-e2e-p99-ms", type=float, default=500.0,
+                   help="interactive end-to-end latency objective: 99%% "
+                        "of interactive frames must publish within this "
+                        "(the error budget is the other 1%%)")
+    p.add_argument("--slo-queue-wait-p99-ms", type=float, default=250.0,
+                   help="queue-wait objective: 99%% of frames must leave "
+                        "the batcher queue within this")
+    p.add_argument("--slo-completion-target", type=float, default=0.999,
+                   help="completion-ratio objective: the target fraction "
+                        "of admitted frames that must publish (drops burn "
+                        "the remaining budget)")
+    p.add_argument("--slo-durability-rows", type=int, default=1024,
+                   help="durability-lag objective bound: WAL rows not yet "
+                        "covered by a checkpoint (wal_seq minus the last "
+                        "checkpoint's seq) above this read as burn >= 1; "
+                        "needs --state-dir")
+    p.add_argument("--slo-windows", type=float, nargs=2,
+                   default=(60.0, 600.0), metavar=("SHORT_S", "LONG_S"),
+                   help="the two burn-rate windows (seconds): a severity "
+                        "fires only when BOTH windows burn past its rate "
+                        "(short reacts, long filters blips)")
+    p.add_argument("--slo-loop-stale-s", type=float, default=30.0,
+                   help="loop-liveness objective bound: seconds without a "
+                        "serving-loop iteration before the gauge reads "
+                        "burn >= 1 (warn; critical at 6x). A wedged loop "
+                        "produces no latency/ratio events, so only this "
+                        "gauge — evaluated by the expo backstop thread — "
+                        "can escalate it. 0 = off")
     return p
 
 
@@ -372,7 +417,23 @@ def main(argv=None) -> int:
 
     pipeline, names = _load_stack(args)
     metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
-    metrics = Metrics(sink=metrics_sink)
+    # The latency rolling horizon must cover the longest SLO evaluation
+    # window and the ring resolution must cover the shortest (SLOMonitor
+    # refuses both at construction) — a user asking for a 1 h long window
+    # gets a 1 h ring, and a 5 s short window gets <=5 s slices, not a
+    # silent truncation/dilution of either. Slices are capped: past the
+    # cap the monitor's loud constructor names the incompatible pair.
+    metrics_window_s, metrics_window_slices = 600.0, 20
+    if args.slo:
+        import math as _math
+
+        slo_short_s = min(args.slo_windows)
+        metrics_window_s = max(metrics_window_s, *args.slo_windows)
+        metrics_window_slices = min(960, max(
+            20, int(_math.ceil(metrics_window_s
+                               / max(1e-3, min(30.0, slo_short_s))))))
+    metrics = Metrics(sink=metrics_sink, window_s=metrics_window_s,
+                      window_slices=metrics_window_slices)
 
     # Frame-lifecycle tracer: built whenever ANY observability surface is
     # requested (sampled frame spans, flight dumps, span JSONL, or the
@@ -445,6 +506,28 @@ def main(argv=None) -> int:
         quantizer.rebuild_now(wait=True, skip_if_ready=True)
         print(f"IVF quantizer: {quantizer.stats()}", file=sys.stderr)
 
+    slo_monitor = None
+    if args.slo:
+        from opencv_facerecognizer_tpu.runtime.slo import (
+            SLOMonitor, default_objectives,
+        )
+
+        short_s, long_s = args.slo_windows
+        slo_monitor = SLOMonitor(
+            metrics,
+            default_objectives(
+                drop_counters=RecognizerService.LEDGER_DROP_COUNTERS,
+                state=state,
+                e2e_p99_s=args.slo_e2e_p99_ms / 1e3,
+                queue_wait_p99_s=args.slo_queue_wait_p99_ms / 1e3,
+                completion_target=args.slo_completion_target,
+                durability_rows=args.slo_durability_rows,
+                short_s=short_s, long_s=long_s,
+            ),
+            tracer=tracer,
+            interval_s=args.slo_interval_s,
+        )
+
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)
     elif args.source == "socket":
@@ -485,7 +568,20 @@ def main(argv=None) -> int:
         # handling"). Only reachable with --probe-on-degraded.
         cpu_fallback=rebuild_pipeline_on_cpu if args.probe_on_degraded else None,
         tracer=tracer,
+        slo_monitor=slo_monitor,
     )
+    if slo_monitor is not None and args.slo_loop_stale_s > 0:
+        # Registered after construction: the gauge closes over the
+        # service, which is built WITH the monitor (runtime.slo
+        # loop_liveness_objective docstring).
+        from opencv_facerecognizer_tpu.runtime.slo import (
+            loop_liveness_objective,
+        )
+
+        short_s, long_s = args.slo_windows
+        slo_monitor.add_objective(loop_liveness_objective(
+            service, stale_s=args.slo_loop_stale_s,
+            short_s=short_s, long_s=long_s))
     supervisor = (ServiceSupervisor(service, state=state)
                   if args.supervised else None)
     expo = None

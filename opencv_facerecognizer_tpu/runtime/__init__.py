@@ -28,6 +28,12 @@ from opencv_facerecognizer_tpu.runtime.resilience import (
     ResiliencePolicy,
     ServiceSupervisor,
 )
+from opencv_facerecognizer_tpu.runtime.slo import (
+    SLO,
+    SLOMonitor,
+    default_objectives,
+    loop_liveness_objective,
+)
 from opencv_facerecognizer_tpu.runtime.state_store import (
     CheckpointStore,
     EnrollmentWAL,
@@ -52,7 +58,11 @@ __all__ = [
     "PRIORITY_INTERACTIVE",
     "RecognizerService",
     "ResiliencePolicy",
+    "SLO",
+    "SLOMonitor",
     "ServiceSupervisor",
+    "default_objectives",
+    "loop_liveness_objective",
     "StateLifecycle",
     "TheTrainer",
     "TokenBucket",

@@ -66,13 +66,16 @@ class Batch(NamedTuple):
     needs: ``enqueue_ts`` are the ``time.monotonic()`` stamps from ``put``
     for the ``count`` real frames (queue-wait = pop time - enqueue time);
     ``trace_ids`` are their frame-trace ids (0 = untraced/sampled out) so
-    the consumer can record which batch carried each frame."""
+    the consumer can record which batch carried each frame; ``priorities``
+    are their admission priority classes (the SLO layer's per-class e2e
+    histograms split on them at publish time)."""
 
     frames: np.ndarray  # [B, H, W] in the batcher's dtype, zero-padded
     metas: List[Any]
     count: int
     enqueue_ts: List[float]
     trace_ids: List[int]
+    priorities: List[int]
 
 
 class FrameBatcher:
@@ -366,12 +369,14 @@ class FrameBatcher:
         metas: List[Any] = [None] * self.batch_size
         enqueue_ts: List[float] = []
         trace_ids: List[int] = []
-        for i, (frame, meta, ts, _pri, tid) in enumerate(items):
+        priorities: List[int] = []
+        for i, (frame, meta, ts, pri, tid) in enumerate(items):
             frames[i] = frame
             metas[i] = meta
             enqueue_ts.append(ts)
             trace_ids.append(tid)
-        return Batch(frames, metas, count, enqueue_ts, trace_ids)
+            priorities.append(pri)
+        return Batch(frames, metas, count, enqueue_ts, trace_ids, priorities)
 
     def _shed_stale(self, collector: List[tuple]) -> None:
         """Caller holds the lock. Frames are FIFO by enqueue time, so

@@ -12,16 +12,29 @@ HTTP GET, served by its own threads so a wedged serving loop still
 answers (the counters, ledger and spans are all lock-light reads):
 
 ======================  =====================================================
-path                    JSON payload
+path                    payload
 ======================  =====================================================
 ``/``                   index: endpoints, brownout level, tracer stats
 ``/metrics``            ``Metrics.summary()`` (counters + gauges +
                         percentiles; empty windows report explicit nulls)
+``/prom``               the same state in Prometheus text format
+                        (``runtime.promtext.render``: counters/gauges/
+                        rolling-histogram families, prefix families folded
+                        into labels) — the scrape endpoint
+``/health``             the SLO monitor's verdict (``runtime.slo``):
+                        health state + per-objective short/long burn
+                        rates + active watchdog events. HTTP 200 for
+                        ok/warn, **503 for critical** (load balancers and
+                        liveness probes key on the status alone); 200
+                        with ``{"state": null}`` when no monitor is wired
 ``/ledger``             ``RecognizerService.ledger()`` — admitted /
                         completed / drops_by_reason / in_system
 ``/brownout``           ``{"level": n}``
-``/spans``              recent spans: ``?topic=<ring>&n=<max>`` (default:
-                        all topics merged, newest 256)
+``/spans``              recent spans: ``?topic=<ring>&limit=<max>``
+                        (``n`` is an accepted alias; default: all topics
+                        merged, newest 256; limit is bounds-checked —
+                        non-integer or non-positive values answer 400,
+                        values beyond ``SPAN_LIMIT_MAX`` are clamped)
 ``/attribution``        stage-attribution gauges, refreshed on read (see
                         ``fold_attribution``)
 ======================  =====================================================
@@ -29,7 +42,10 @@ path                    JSON payload
 **Read-only contract**: every verb except GET is answered ``405 Method Not
 Allowed`` — this surface can never mutate the service, by construction
 (no handler writes anything). Requests/errors are counted on the shared
-Metrics surface (``expo_requests`` / ``expo_errors``).
+Metrics surface (``expo_requests`` / ``expo_errors``). The one nuance:
+``/health`` reads the monitor's LAST verdict; the evaluation itself runs
+on the serving loop's tick and (as a liveness backstop for wedged loops)
+on this server's background refresh thread — never on a request thread.
 
 **Stage attribution** (``fold_attribution``): two derived gauge families
 registered in ``utils.metric_names``:
@@ -59,12 +75,24 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
+from opencv_facerecognizer_tpu.runtime.promtext import render as render_prom
+from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL
 from opencv_facerecognizer_tpu.utils import metric_names as mn
 from opencv_facerecognizer_tpu.utils import tracing
 
 #: the fused step's in-device stages, in execution order (bench.py's
 #: ablated-prefix stage table uses the same names).
 DEVICE_STAGES = ("detect", "crop", "embed", "match")
+
+#: hard cap on ``/spans`` ``limit=`` — a scrape cannot ask this surface
+#: to serialize an unbounded span dump.
+SPAN_LIMIT_MAX = 10000
+SPAN_LIMIT_DEFAULT = 256
+
+
+class _BadQuery(ValueError):
+    """A malformed query parameter — mapped to HTTP 400 (the bounds-check
+    contract: bad input is answered, never guessed at)."""
 
 #: default bench artifact location: resolved relative to the REPO (two
 #: levels above this module), not the process CWD — ``ocvf-recognize``
@@ -151,12 +179,18 @@ class ExpoServer:
     def __init__(self, service=None, tracer=None, metrics=None,
                  host: str = "127.0.0.1", port: int = 0,
                  refresh_s: float = 2.0,
-                 bench_path: str = DEFAULT_BENCH_PATH):
+                 bench_path: str = DEFAULT_BENCH_PATH,
+                 slo=None):
         self.service = service
         self.tracer = tracer if tracer is not None else getattr(
             service, "tracer", None)
         self.metrics = metrics if metrics is not None else getattr(
             service, "metrics", None)
+        #: optional runtime.slo.SLOMonitor behind ``/health``; the refresh
+        #: thread ticks it as a backstop so the verdict stays current even
+        #: when the serving loop (its primary ticker) is wedged — which is
+        #: exactly when an orchestrator polls /health hardest.
+        self.slo = slo if slo is not None else getattr(service, "slo", None)
         self.refresh_s = float(refresh_s)
         self.bench_path = bench_path
         self._started_t = time.monotonic()
@@ -211,6 +245,25 @@ class ExpoServer:
         exposition surface stays current even when nobody polls it (the
         gauges also land in the ``--metrics-jsonl`` stream)."""
         while not self._stop.wait(timeout=self.refresh_s):
+            # The backstop tick runs FIRST and in its own try: a
+            # persistently-failing attribution fold must not starve the
+            # /health liveness backstop — that backstop exists for exactly
+            # the moments when other parts of the system are misbehaving.
+            if self.slo is not None:
+                try:
+                    # Backstop tick (interval-throttled inside the
+                    # monitor): /health must reflect reality even when
+                    # the serving loop stopped ticking.
+                    self.slo.tick()
+                except Exception:  # noqa: BLE001 — refresh must never die
+                    logging.getLogger(__name__).exception(
+                        "expo slo backstop tick failed")
+                    if self.metrics is not None:
+                        # slo_tick_errors, not expo_errors: the EVALUATION
+                        # failed — same counter as the supervisor's
+                        # backstop, so triage points at the monitor, not
+                        # the HTTP surface.
+                        self.metrics.incr(mn.SLO_TICK_ERRORS)
             try:
                 fold_attribution(self.tracer, self.metrics,
                                  bench_path=self.bench_path)
@@ -229,41 +282,75 @@ class ExpoServer:
         service = self.service
         if path in ("/", "/index"):
             return {
-                "endpoints": ["/", "/metrics", "/ledger", "/brownout",
-                              "/spans", "/attribution"],
+                "endpoints": ["/", "/metrics", "/prom", "/health", "/ledger",
+                              "/brownout", "/spans", "/attribution"],
                 "uptime_s": round(time.monotonic() - self._started_t, 1),
                 "brownout_level": getattr(service, "brownout_level", None),
+                "health": (self.slo.state if self.slo is not None else None),
                 "tracer": (self.tracer.stats()
                            if self.tracer is not None else None),
             }
         if path == "/metrics":
             return dict(self.metrics.summary()) if self.metrics else {}
+        if path == "/health":
+            if self.slo is None:
+                return {"state": None, "detail": "no SLO monitor wired"}
+            return dict(self.slo.verdict())
         if path == "/ledger":
             return service.ledger() if service is not None else {}
         if path == "/brownout":
             return {"level": getattr(service, "brownout_level", None)}
         if path == "/spans":
+            limit = self._span_limit(query)
             if self.tracer is None:
                 return {"topics": [], "spans": []}
             topic = (query.get("topic") or [None])[0]
-            try:
-                n = int((query.get("n") or [256])[0])
-            except (TypeError, ValueError):
-                n = 256
             return {"topics": self.tracer.topics(),
-                    "spans": self.tracer.snapshot(topic=topic, limit=n)}
+                    "spans": self.tracer.snapshot(topic=topic, limit=limit)}
         if path == "/attribution":
             return fold_attribution(self.tracer, self.metrics,
                                     bench_path=self.bench_path)
         raise KeyError(path)
 
+    @staticmethod
+    def _span_limit(query: Dict[str, Any]) -> int:
+        """Bounds-checked ``limit=`` (alias ``n=``) for ``/spans``: a
+        non-integer or non-positive value answers 400 (``_BadQuery``)
+        instead of being silently defaulted; oversized asks clamp to
+        ``SPAN_LIMIT_MAX``."""
+        raw = (query.get("limit") or query.get("n") or [None])[0]
+        if raw is None:
+            return SPAN_LIMIT_DEFAULT
+        try:
+            limit = int(raw)
+        except (TypeError, ValueError):
+            raise _BadQuery(f"limit must be an integer, got {raw!r}")
+        if limit <= 0:
+            raise _BadQuery(f"limit must be positive, got {limit}")
+        return min(limit, SPAN_LIMIT_MAX)
+
     def _handle_get(self, handler) -> None:
         if self.metrics is not None:
             self.metrics.incr(mn.EXPO_REQUESTS)
         parsed = urlparse(handler.path)
+        content_type = "application/json"
         try:
+            if parsed.path == "/prom":
+                # Prometheus exposition is text, not JSON: rendered from
+                # one atomic Metrics snapshot (runtime.promtext).
+                text = render_prom(self.metrics) if self.metrics else ""
+                self._respond(handler, 200, text.encode("utf-8"),
+                              "text/plain; version=0.0.4; charset=utf-8")
+                return
             body = self.payload(parsed.path, parse_qs(parsed.query))
             status = 200
+            if (parsed.path == "/health"
+                    and body.get("state_code") == STATE_CRITICAL):
+                # Critical answers 503: a load balancer / liveness probe
+                # reads the verdict from the status code alone.
+                status = 503
+        except _BadQuery as exc:
+            body, status = {"error": str(exc)}, 400
         except KeyError:
             body, status = {"error": f"unknown path {parsed.path!r}"}, 404
         except Exception:  # noqa: BLE001 — a handler bug must answer 500
@@ -272,9 +359,14 @@ class ExpoServer:
                 self.metrics.incr(mn.EXPO_ERRORS)
             body, status = {"error": "internal error"}, 500
         blob = json.dumps(body, default=repr).encode("utf-8")
+        self._respond(handler, status, blob, content_type)
+
+    @staticmethod
+    def _respond(handler, status: int, blob: bytes,
+                 content_type: str) -> None:
         try:
             handler.send_response(status)
-            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Type", content_type)
             handler.send_header("Content-Length", str(len(blob)))
             handler.end_headers()
             handler.wfile.write(blob)

@@ -108,6 +108,20 @@ class InstantPipeline:
         #: batch dimension of every dispatch, in order — lets tests assert
         #: the service's bucket ladder sliced partial batches as designed.
         self.batch_sizes_seen: list = []
+        #: batch shapes already "compiled" (first dispatch of a shape is a
+        #: cache miss, like the real packed-step cache) — drives the
+        #: ``last_dispatch_info`` provenance the recompile watchdog reads,
+        #: so the watchdog is testable without hardware. Tests clear this
+        #: to inject a post-warmup compile.
+        self.compiled_batch_sizes: set = set()
+        self.last_dispatch_info: dict = {}
+
+    def prewarm_batch_shapes(self, ladder, frame_shape, dtype) -> None:
+        """Mirror ``RecognitionPipeline.prewarm_batch_shapes``: mark every
+        ladder bucket compiled so post-warmup serving dispatches are cache
+        hits — the recompile watchdog's armed-and-silent baseline."""
+        for bucket in ladder:
+            self.compiled_batch_sizes.add(int(bucket))
 
     def recognize_batch_packed(self, frames) -> FakePacked:
         if self.fault_injector is not None:
@@ -117,6 +131,9 @@ class InstantPipeline:
         self.dispatches += 1
         b = int(np.asarray(frames).shape[0])
         self.batch_sizes_seen.append(b)
+        self.last_dispatch_info = {"cache_hit": b in self.compiled_batch_sizes,
+                                   "mode": "fake"}
+        self.compiled_batch_sizes.add(b)
         # pack_result layout: boxes(4) | det_score | valid | labels(k) |
         # sims(k); valid=0 everywhere -> zero faces per frame.
         packed = np.zeros((b, self.max_faces, 6 + 2 * self.top_k), np.float32)
@@ -130,7 +147,8 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
                          brownout_queue_wait_s: float = 0.05,
                          brownout_dwell_s: float = 0.3,
                          stale_after_s: float = 0.25,
-                         fault_injector=None, journal=None, tracer=None):
+                         fault_injector=None, journal=None, tracer=None,
+                         slo_monitor=None, metrics=None):
     """The canonical deterministic overload harness: an
     ``InstantPipeline`` with a hard ``batch_size / dispatch_s`` frames/s
     capacity wall behind a ``RecognizerService`` with the full protection
@@ -153,6 +171,7 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
     service = RecognizerService(
         pipeline, connector, batch_size=batch_size, frame_shape=frame_shape,
         flush_timeout=0.03, inflight_depth=2, similarity_threshold=0.0,
+        metrics=metrics,
         resilience=ResiliencePolicy(readback_deadline_s=2.0),
         fault_injector=fault_injector,
         admission=AdmissionController(max_inflight_frames=max_inflight_frames),
@@ -162,6 +181,7 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
         shed_stale_after_s=stale_after_s,
         bucket_sizes=(max(1, batch_size // 2), batch_size),
         tracer=tracer,
+        slo_monitor=slo_monitor,
     )
     return pipeline, service, connector
 

@@ -293,7 +293,11 @@ def main(argv=None) -> int:
     """Tiny ops helper: print a journal's records (oldest first). Each
     frame entry carries its ``trace_id`` and death ``stage`` (plus the
     record-level ``dump`` path when a flight-recorder dump accompanied a
-    dead-letter), so ``--trace`` answers "where did frame X die"."""
+    dead-letter), so ``--trace`` answers "where did frame X die" and
+    ``--stage`` answers "what died at stage Y" (exact match, e.g.
+    ``batcher.stale`` / ``readback.dead_letter`` — the same stage strings
+    the settle spans carry, so journal rows and flight dumps correlate).
+    Filters compose (AND)."""
     import argparse
     import sys
 
@@ -304,6 +308,11 @@ def main(argv=None) -> int:
     parser.add_argument("--trace", type=int, default=None,
                         help="only records holding a frame with this "
                              "trace id (prints where that frame died)")
+    parser.add_argument("--stage", default=None,
+                        help="only records holding a frame that died at "
+                             "this lifecycle stage (exact match, e.g. "
+                             "batcher.stale, readback.dead_letter, "
+                             "dispatch.brownout_trim)")
     args = parser.parse_args(argv)
     journal = DeadLetterJournal(args.path)
     for record in journal.records():
@@ -311,6 +320,10 @@ def main(argv=None) -> int:
             continue
         if args.trace is not None and not any(
                 f.get("trace_id") == args.trace
+                for f in record.get("frames", ())):
+            continue
+        if args.stage is not None and not any(
+                f.get("stage") == args.stage
                 for f in record.get("frames", ())):
             continue
         sys.stdout.write(json.dumps(record) + "\n")

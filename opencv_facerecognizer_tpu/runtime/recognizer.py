@@ -117,6 +117,7 @@ from opencv_facerecognizer_tpu.runtime.resilience import (
     ResiliencePolicy,
     is_transient_error,
 )
+from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL
 from opencv_facerecognizer_tpu.utils.metrics import Metrics
 from opencv_facerecognizer_tpu.utils import tracing
 
@@ -272,6 +273,12 @@ class RecognizerService:
         # lifecycle spans, and the flight-recorder dump on dead-letter.
         # None = tracing fully off (zero overhead).
         tracer=None,
+        # SLO burn-rate monitor (runtime.slo.SLOMonitor): ticked by the
+        # serving loop (evaluation every interval_s); its health verdict
+        # feeds /health, the recompile watchdog's warn events, and — at
+        # critical — one extra level of brownout intake pressure. None =
+        # no SLO evaluation (zero overhead).
+        slo_monitor=None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -308,6 +315,19 @@ class RecognizerService:
         self._reject_last_pub: Dict[str, float] = {}
         self._reject_lock = threading.Lock()
         self.tracer = tracer
+        self.slo = slo_monitor
+        # Serving-loop progress stamp, refreshed every loop iteration
+        # (batch AND idle — get_batch's flush timeout guarantees regular
+        # iterations even with zero traffic). Read by the loop_liveness
+        # gauge SLO through ``loop_staleness_s``: empty latency windows
+        # read as "no breach", so without this a wedged loop scores a
+        # clean /health forever — the gauge is what lets the expo
+        # backstop's tick escalate a loop that stopped moving.
+        self._loop_progress_t: Optional[float] = None
+        # Recompile-watchdog arming flag: only set once warmup() compiled
+        # the whole bucket ladder — before that, a jit-cache miss is the
+        # expected cost of starting up, not a mid-serving compile.
+        self._warmed = False
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
                                     dtype=transfer_dtype,
                                     metrics=self.metrics,
@@ -588,13 +608,29 @@ class RecognizerService:
             self._publish_status({"status": "brownout_recovered",
                                   "queue_wait_ewma_ms": round(ewma * 1e3, 2)})
 
-    def _brownout_sheds_intake(self, priority: int) -> bool:
+    def _effective_brownout_level(self) -> int:
+        """The controller's level, plus one when the SLO monitor reads
+        critical — the health verdict as a brownout INPUT: a blown error
+        budget sheds bulk intake even before the queue-wait EWMA catches
+        up, and stops the moment health de-escalates. Only the intake
+        skip consumes the boost; the controller's own level/hysteresis
+        (and its recovery) are untouched, so SLO pressure can never wedge
+        the brownout state machine."""
+        level = self._brownout_level
+        if (self.slo is not None and self.brownout_policy is not None
+                and self.slo.state_code >= STATE_CRITICAL):
+            level = min(self.brownout_policy.max_level, level + 1)
+        return level
+
+    def _brownout_sheds_intake(self, priority: int, level: int) -> bool:
         """Shed this (already admitted) frame at intake? Interactive
         frames never (the intake skip is the priority-aware half of
         brownout; the level-2 ladder trim in ``_serve_one`` is the
         class-blind half — see BrownoutPolicy's docstring); bulk frames
-        skip-k at level 1, always at ``max_level``."""
-        level = self._brownout_level
+        skip-k at level 1, always at ``max_level``. ``level`` is the
+        caller's one ``_effective_brownout_level()`` read (incl. the SLO
+        critical-health boost) — the same read is journaled with the
+        drop, so the recorded level is the one that caused it."""
         if level <= 0 or priority <= PRIORITY_INTERACTIVE:
             return False
         if level >= self.brownout_policy.max_level:
@@ -671,13 +707,18 @@ class RecognizerService:
                 self.metrics.incr(mn.FRAMES_MALFORMED)
                 self._trace_settle([tid], mn.FRAMES_MALFORMED, "decode")
                 continue
-            if self._brownout_sheds_intake(priority):
+            brownout_level = self._effective_brownout_level()
+            if self._brownout_sheds_intake(priority, brownout_level):
                 self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT)
                 self._trace_settle([tid], mn.FRAMES_DROPPED_BROWNOUT,
                                    "intake.brownout")
+                # Journal the EFFECTIVE level (incl. the SLO critical
+                # boost) — it is what caused this drop; the raw controller
+                # level alone could read 0 here, hiding the cause.
                 self._journal_drop("brownout", self._drop_entries(
                     [msg.get("meta")], None, [tid], "intake.brownout",
-                    priority=priority), level=self._brownout_level)
+                    priority=priority),
+                    level=brownout_level)
                 continue
             if not self.batcher.put(frame, meta=msg.get("meta"),
                                     priority=priority, trace_id=tid):
@@ -720,6 +761,7 @@ class RecognizerService:
             self.pipeline.fault_injector = self._faults
         self._running = True
         self._crashed = False
+        self._loop_progress_t = None
         self.connector.start()
         if self._use_worker:
             self._blocker = _ReadbackBlocker()
@@ -754,6 +796,10 @@ class RecognizerService:
         if hasattr(emb, "block_until_ready"):
             emb.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup precedes start(); the enrolment graph must be compiled before the first enroll command
         self.metrics.observe(mn.WARMUP, time.perf_counter() - t0)
+        # Arm the recompile watchdog: from here on, a serving dispatch
+        # that misses the jit cache is a mid-serving XLA compile the
+        # prewarmed ladder was built to prevent.
+        self._warmed = True
 
     def drain(self, timeout: float = 120.0) -> bool:
         """Block until every accepted frame has been batched, computed, AND
@@ -812,6 +858,17 @@ class RecognizerService:
         (``ServiceSupervisor`` watches this flag)."""
         return self._crashed
 
+    @property
+    def loop_staleness_s(self) -> float:
+        """Seconds since the serving loop last completed a queue pop —
+        the loop_liveness gauge SLO's probe (``runtime.slo``). 0.0 while
+        the service is stopped or the loop has not reached its first
+        iteration yet (startup is covered by the bounded backend probe,
+        not this signal)."""
+        if not self._running or self._loop_progress_t is None:
+            return 0.0
+        return max(0.0, time.monotonic() - self._loop_progress_t)
+
     def restart_pending(self) -> bool:
         """True when the crash flag is up AND a serving-side thread has
         actually exited — i.e. ``restart_loop`` would act rather than
@@ -861,12 +918,22 @@ class RecognizerService:
     def _serve_loop(self) -> None:
         while self._running:
             batch = self.batcher.get_batch(block=True)
+            # Liveness stamp: placed AFTER the pop so a loop wedged
+            # anywhere in the iteration body (dispatch, inflight wait,
+            # publish) stops refreshing it and ``loop_staleness_s`` grows.
+            self._loop_progress_t = time.monotonic()
             # Durable-state tick: a cheap WAL row-count/age threshold
             # check; when due it SPAWNS the checkpoint worker (snapshot +
             # write happen off-thread, single-flight) — dispatch never
             # blocks on a checkpoint.
             if self.state is not None:
                 self.state.tick()
+            # SLO tick: one clock read when not due; a full burn-rate
+            # evaluation every interval_s (runtime.slo). Runs on batch
+            # AND idle iterations so the health verdict keeps updating
+            # when traffic stops — recovery is part of the signal.
+            if self.slo is not None:
+                self.slo.tick()
             if batch is None:
                 if not self._running:
                     break
@@ -954,7 +1021,8 @@ class RecognizerService:
             with self._inflight_cv:
                 self._inflight.append((packed, frames, metas, count,
                                        batch.enqueue_ts, t0, t_disp, deadline,
-                                       trace_ids, batch_tid))
+                                       trace_ids, batch_tid,
+                                       batch.priorities))
                 accounted = True
                 self._inflight_cv.notify_all()
         except BaseException:
@@ -971,16 +1039,33 @@ class RecognizerService:
             raise
         self.metrics.incr(mn.BATCHES_DISPATCHED)
         self.metrics.incr(mn.FRAMES_PROCESSED, count)
+        # Dispatch provenance is read for the batch span AND the recompile
+        # watchdog, so it is fetched regardless of tracing.
+        info = getattr(self.pipeline, "last_dispatch_info", None) or {}
         if batch_tid:
             # Bucketed-dispatch provenance: bucket size, jit-cache verdict
             # and exact-vs-ivf matcher mode (the pipeline records both on
             # dispatch), plus the brownout level the batch served under.
-            info = getattr(self.pipeline, "last_dispatch_info", None) or {}
             tracer.emit(batch_tid, "dispatch", topic=tracing.BATCH_TOPIC,
                         dur=t_disp - t0, bucket=bucket, frames=count,
                         cache_hit=info.get("cache_hit"),
                         mode=info.get("mode"),
                         brownout=self._brownout_level)
+        if self._warmed and info.get("cache_hit") is False:
+            # Recompile watchdog: a serving dispatch missed the jit cache
+            # AFTER warmup compiled the whole bucket ladder — a mid-
+            # serving XLA compile (the silent perf killer the prewarm
+            # design exists to prevent; measured ~85 s stalls on the
+            # tunneled backend). Counted, spanned, and reported as a
+            # warn-level SLO event so /health shows it within one
+            # evaluation interval.
+            self.metrics.incr(mn.RECOMPILES_POST_WARMUP)
+            if tracer is not None:
+                tracer.emit(tracer.new_trace(), "recompile",
+                            topic=tracing.LIFECYCLE_TOPIC, bucket=bucket,
+                            frames=count, mode=info.get("mode"))
+            if self.slo is not None:
+                self.slo.note_event("recompile_post_warmup")
         if bucket < self.batcher.batch_size:
             self.metrics.incr(mn.BATCHES_BUCKETED)
         if self._use_worker:
@@ -1205,7 +1290,8 @@ class RecognizerService:
                         return
                     continue
                 packed, frames, metas, count, enqueue_ts, t0, t_disp, \
-                    deadline, trace_ids, batch_tid = self._inflight[0]
+                    deadline, trace_ids, batch_tid, priorities \
+                    = self._inflight[0]
             try:
                 ready = self._await_ready(packed, deadline)
             except Exception:  # noqa: BLE001 — outage at the readback side
@@ -1229,7 +1315,7 @@ class RecognizerService:
                                   batch_tid)
                 continue
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp, trace_ids, batch_tid)
+                                t0, t_disp, trace_ids, batch_tid, priorities)
 
     def _await_ready(self, packed, deadline: float) -> bool:
         """Wait for one batch's transfer, bounded by its deadline. Returns
@@ -1276,7 +1362,7 @@ class RecognizerService:
         could wedge."""
         while self._inflight:
             packed, frames, metas, count, enqueue_ts, t0, t_disp, deadline, \
-                trace_ids, batch_tid = self._inflight[0]
+                trace_ids, batch_tid, priorities = self._inflight[0]
             ready = self._is_ready(packed)
             if not ready:
                 if time.monotonic() >= deadline:
@@ -1301,10 +1387,11 @@ class RecognizerService:
                     continue
             self._pop_inflight_head()
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp, trace_ids, batch_tid)
+                                t0, t_disp, trace_ids, batch_tid, priorities)
 
     def _complete_head(self, packed, frames, metas, count, enqueue_ts,
-                       t0, t_disp, trace_ids=(), batch_tid=0) -> None:
+                       t0, t_disp, trace_ids=(), batch_tid=0,
+                       priorities=()) -> None:
         """Materialize + publish one POPPED batch and settle its accounting
         — the shared tail of the readback worker and the fallback drain
         (the two paths must stay behaviorally identical apart from
@@ -1356,6 +1443,18 @@ class RecognizerService:
                              dur=now - t_pub, frames=count)
         self.metrics.observe(mn.PUBLISH, now - t_pub)
         self.metrics.observe(mn.BATCH_LATENCY, now - t0)
+        # Per-frame end-to-end latency (batcher enqueue -> published):
+        # the SLO layer's headline histogram, split by priority class so
+        # the interactive objective never averages in bulk traffic.
+        # enqueue_ts stamps are monotonic; one clock read covers the run.
+        if enqueue_ts:
+            now_mono = time.monotonic()
+            for i in range(min(count, len(enqueue_ts))):
+                e2e = now_mono - enqueue_ts[i]
+                self.metrics.observe(mn.E2E_LATENCY, e2e)
+                if (i < len(priorities)
+                        and priorities[i] <= PRIORITY_INTERACTIVE):
+                    self.metrics.observe(mn.E2E_LATENCY_INTERACTIVE, e2e)
         # Feed the continuous batcher's adaptive deadline with the
         # realized downstream time (pop -> published).
         self.batcher.report_service_time(now - t0)

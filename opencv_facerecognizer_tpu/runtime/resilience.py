@@ -163,7 +163,8 @@ def rebuild_pipeline_on_cpu(service) -> None:
     host-mirror ``snapshot``/``load_snapshot`` path onto a fresh
     single-CPU-device mesh (no device readback — the dead accelerator may
     not answer one), and swaps ``service.pipeline`` between batches. The
-    first CPU batch pays an XLA compile; after that the job is degraded
+    swap itself pays the ladder's XLA compiles (prewarm, below) so the
+    recompile watchdog stays armed; after that the job is degraded
     (CPU-speed) but serving. Raises when no CPU backend exists — the
     caller treats a failed fallback as best-effort (``cpu_fallback:
     False`` in the degraded status)."""
@@ -198,6 +199,26 @@ def rebuild_pipeline_on_cpu(service) -> None:
     pipeline.fault_injector = getattr(old, "fault_injector", None)
     old.fault_injector = None
     service.pipeline = pipeline
+    # Keep the recompile watchdog armed ACROSS the swap by prewarming the
+    # fresh pipeline's ladder here: its jit cache starts empty, and those
+    # by-design compiles are the documented cost of the fallback — paid up
+    # front, not smeared over the first serving dispatches. Simply
+    # disarming instead would silence the watchdog for the rest of the
+    # process, losing exactly the mid-serving-compile coverage it exists
+    # for. If the prewarm itself fails, disarm and keep serving — a CPU
+    # fallback that serves with a quiet watchdog beats one that crashed
+    # in its own escape hook.
+    if service._warmed:
+        try:
+            with jax.default_device(cpu_device):
+                pipeline.prewarm_batch_shapes(
+                    service._bucket_ladder, service.batcher.frame_shape,
+                    service.batcher.dtype)
+        except Exception:  # noqa: BLE001 — fallback must finish
+            logging.getLogger(__name__).exception(
+                "CPU-fallback ladder prewarm failed; "
+                "recompile watchdog disarmed")
+            service._warmed = False
     # The enrolment embed graph must follow too: the service's jitted
     # chunk embedder would otherwise keep dispatching on the dead
     # accelerator (see RecognizerService._run_embed_chunk).
@@ -273,6 +294,9 @@ class ServiceSupervisor:
         self._last_processed = -1.0
         self._last_progress_t = time.monotonic()
         self._stall_warned = False
+        #: last SLO health state seen by the watchdog (edge detection for
+        #: the health status publishes; -1 = not yet observed).
+        self._last_health = -1
         self._snapshot: Optional[Tuple] = None
         self._snapshot_wal_seq: Optional[int] = None
         self._subject_names: Optional[list] = None
@@ -354,6 +378,7 @@ class ServiceSupervisor:
         while self._running:
             time.sleep(self.poll_interval_s)
             self._check_stall(service, STATUS_TOPIC)
+            self._check_health(service, STATUS_TOPIC)
             if not service.loop_crashed or not service._running:
                 continue
             if not service.restart_pending():
@@ -442,6 +467,49 @@ class ServiceSupervisor:
                 "pending_frames": service.batcher.pending,
                 "seconds_without_progress": round(now - self._last_progress_t, 1),
             })
+
+    def _check_health(self, service, status_topic: str) -> None:
+        """Publish the SLO monitor's health transitions on the status
+        topic — the supervisor is the component a deploy layer already
+        listens to, so the health verdict rides the same channel as
+        ``stalled``/``supervisor_restart``. Edge-triggered: one status per
+        state change, carrying the per-objective burn rates, so an
+        orchestrator can act (drain this replica, route around it)
+        without polling ``/health``. The monitor itself owns evaluation,
+        spans, gauges, and the critical flight dump; the supervisor only
+        ANNOUNCES."""
+        monitor = getattr(service, "slo", None)
+        if monitor is None:
+            return
+        # Backstop tick before reading: the serving loop is the primary
+        # ticker, but a wedged loop stops ticking — and a wedged loop is
+        # exactly what the loop_liveness gauge exists to escalate. The
+        # expo refresh thread also backstops, but expo is optional; the
+        # supervisor's poll loop is the always-on ticker when supervised.
+        # tick() is interval-gated and its evaluation claim is
+        # non-blocking, so this is cheap and never double-evaluates.
+        try:
+            monitor.tick()
+        except Exception:  # noqa: BLE001 — the watchdog thread must live
+            logging.getLogger(__name__).exception(
+                "supervisor slo backstop tick failed")
+            service.metrics.incr(mn.SLO_TICK_ERRORS)
+        state = monitor.state_code
+        if state == self._last_health:
+            return
+        first = self._last_health < 0
+        self._last_health = state
+        if first and state == 0:
+            return  # don't announce the boring initial "ok"
+        verdict = monitor.verdict()
+        self._publish(status_topic, {
+            "status": "health",
+            "state": monitor.state,
+            "objectives": {
+                name: obj.get("burn")
+                for name, obj in verdict.get("objectives", {}).items()},
+            "events": verdict.get("events", {}),
+        })
 
     def _restore_gallery(self) -> None:
         if self._snapshot is None:

@@ -1,0 +1,538 @@
+"""SLO burn-rate monitor: declarative objectives, multi-window burn
+rates, and an ok -> warn -> critical health state machine (signals layer,
+beside ``runtime.expo``).
+
+The tracing layer (PR 8) answers "what happened to frame X"; the metrics
+layer answers "what are the counters right now".  Neither answers the
+operator/orchestrator question: **is this replica healthy, and how fast is
+it eating its error budget?**  This module does, with the standard
+multi-window burn-rate construction:
+
+- An **objective** declares what "good" means (a latency histogram window
+  staying under a threshold for a target fraction of events, a counter
+  ratio staying above a target, a gauge staying under a bound) plus two
+  evaluation horizons — a short window that reacts fast and a long window
+  that filters blips.
+- The **burn rate** is ``observed error rate / error budget`` (budget =
+  ``1 - target``): burn 1.0 means "exactly spending the budget", burn 6
+  means "six times too fast".  An objective's severity requires the burn
+  to exceed the rate on **both** windows — the short window alone flaps
+  on every scheduler hiccup, the long window alone reacts too late; the
+  pairing is what makes the signal actionable (the SRE multi-window
+  multi-burn-rate alert, evaluated in-process).
+- Latency objectives read ``Metrics.fraction_above`` over the rolling
+  histograms (``utils.histogram``), so the short/long horizons are true
+  wall-clock slices of one ring — no second bookkeeping.  Ratio
+  objectives (the admission ledger's completion ratio) diff counter
+  snapshots the monitor itself records per evaluation.  Gauge objectives
+  (durability lag = WAL rows not yet covered by a checkpoint) read an
+  injected callable; their burn is ``value / bound`` on both windows.
+- **Watchdog events** (``note_event``): out-of-band warn signals — the
+  recompile watchdog reports every post-warmup jit compile here — hold
+  the health state at warn while any event is inside the short window,
+  and are counted per reason (``slo_events_<reason>``).
+
+The **health state machine** takes the worst objective severity each
+evaluation.  Escalation is immediate; de-escalation requires
+``recovery_evals`` consecutive cleaner evaluations (hysteresis — a state
+that flaps is worse than no state at all).  Every transition emits a
+lifecycle span; a transition INTO critical additionally fires a
+flight-recorder dump (``slo_critical``) — the rings at the moment the
+budget blew are exactly what the post-mortem needs.  ``health_state``
+(0/1/2) and per-objective ``slo_burn_<name>`` gauges land on the shared
+Metrics surface, so ``/metrics``, ``/prom`` and the JSONL sink all carry
+them; ``/health`` serves the full verdict.
+
+Consumers: the serving loop ticks the monitor (one time-check per batch;
+evaluation every ``interval_s``); the brownout controller treats a
+critical verdict as one extra level of intake pressure; the supervisor
+publishes health transitions on the status topic.  The serving loop is
+the primary evaluator with the expo refresh thread as a liveness
+backstop for wedged loops — concurrent ticks are serialized by a
+NON-BLOCKING claim (the loser skips; nobody ever waits), so the state
+machine can never run twice over one instant and transition side
+effects (spans, the critical flight dump) fire exactly once.  Readers
+(``/health``, supervisor) read the last verdict dict by reference (an
+atomic swap in CPython), and ``note_event`` appends to a thread-safe
+deque.  The evaluation claim's only outgoing lock edge is into Metrics
+(a leaf), so the lock-order graph stays acyclic.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+
+#: health states, in escalation order; index = the ``health_state`` gauge.
+STATE_OK, STATE_WARN, STATE_CRITICAL = 0, 1, 2
+STATE_NAMES = ("ok", "warn", "critical")
+
+
+@dataclass
+class SLO:
+    """One objective. ``kind`` selects which fields apply:
+
+    - ``"latency"``: ``window`` (a Metrics histogram window name) must
+      stay under ``threshold_s`` for ``target`` of events;
+    - ``"ratio"``: the ``bad_counters`` share of ``total_counters``
+      growth must stay under ``1 - target`` (e.g. ledger drops vs
+      admitted);
+    - ``"gauge"``: ``value_fn()`` must stay under ``bound`` (burn =
+      value / bound, both windows).
+    """
+
+    name: str
+    kind: str  # "latency" | "ratio" | "gauge"
+    # latency
+    window: Optional[str] = None
+    threshold_s: float = 0.0
+    # latency + ratio: target fraction of good events (budget = 1-target)
+    target: float = 0.99
+    # ratio
+    bad_counters: Tuple[str, ...] = ()
+    total_counters: Tuple[str, ...] = ()
+    # gauge
+    value_fn: Optional[Callable[[], float]] = None
+    bound: float = 0.0
+    # evaluation windows (seconds) and burn-rate severity thresholds
+    short_s: float = 60.0
+    long_s: float = 600.0
+    warn_burn: float = 1.0
+    critical_burn: float = 6.0
+    #: volume floor: latency/ratio severity is claimed only when BOTH
+    #: windows hold at least this many events. One dropped frame on an
+    #: idle replica is a 500x burn against a 0.001 budget — without a
+    #: floor it would 503 /health, fire the critical dump, and add a
+    #: brownout level all by itself. Gauge objectives are point-in-time
+    #: reads and exempt. The burn is still computed and reported
+    #: (``low_volume`` marks the verdict) so /health shows the signal
+    #: without acting on it.
+    min_events: int = 10
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("latency", "ratio", "gauge"):
+            raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if not 0.0 < self.target < 1.0 and self.kind != "gauge":
+            raise ValueError("target must be in (0, 1)")
+        if self.kind == "latency" and not self.window:
+            raise ValueError("latency SLO needs a metrics window name")
+        if self.kind == "gauge" and self.value_fn is None:
+            raise ValueError("gauge SLO needs a value_fn")
+        if self.kind == "gauge" and not self.bound > 0:
+            # bound<=0 would make burn read 0.0 forever — a permanently
+            # green objective is worse than a loud constructor.
+            raise ValueError("gauge SLO needs a positive bound")
+        if self.short_s > self.long_s:
+            # A swapped pair is symmetric for burn severity so it would
+            # never surface as an error — but the reported
+            # burn_short/burn_long horizons invert and the watchdog-event
+            # hold window (derived from min short_s) inflates 10x.
+            raise ValueError(
+                f"SLO {self.name!r}: short_s {self.short_s:g} > long_s "
+                f"{self.long_s:g} — pass windows short-first")
+
+
+def default_objectives(drop_counters: Sequence[str] = (),
+                       state=None,
+                       e2e_p99_s: float = 0.5,
+                       queue_wait_p99_s: float = 0.25,
+                       completion_target: float = 0.999,
+                       durability_rows: int = 1024,
+                       short_s: float = 60.0,
+                       long_s: float = 600.0) -> List[SLO]:
+    """The four stock objectives from the signals-layer design: interactive
+    e2e latency, completion ratio over the admission ledger's drop
+    counters, durability lag against the state lifecycle (only when one is
+    wired), and queue-wait. Callers pass the service's
+    ``LEDGER_DROP_COUNTERS`` — this module deliberately does not import
+    the recognizer (the service imports us)."""
+    objectives = [
+        SLO(name="interactive_p99", kind="latency",
+            window=mn.E2E_LATENCY_INTERACTIVE, threshold_s=e2e_p99_s,
+            target=0.99, short_s=short_s, long_s=long_s),
+        SLO(name="queue_wait_p99", kind="latency",
+            window=mn.QUEUE_WAIT, threshold_s=queue_wait_p99_s,
+            target=0.99, short_s=short_s, long_s=long_s),
+    ]
+    if drop_counters:
+        objectives.append(SLO(
+            name="completion", kind="ratio", target=completion_target,
+            bad_counters=tuple(drop_counters),
+            total_counters=(mn.FRAMES_ADMITTED,),
+            short_s=short_s, long_s=long_s))
+    if state is not None:
+        objectives.append(SLO(
+            name="durability_lag", kind="gauge",
+            value_fn=lambda: float(state.rows_since_checkpoint),
+            bound=float(durability_rows),
+            short_s=short_s, long_s=long_s))
+    return objectives
+
+
+def loop_liveness_objective(service, stale_s: float = 30.0,
+                            short_s: float = 60.0,
+                            long_s: float = 600.0) -> SLO:
+    """Gauge objective over ``RecognizerService.loop_staleness_s``: warn
+    once the serving loop has not completed an iteration for ``stale_s``,
+    critical at 6x that. This closes the wedged-loop blind spot the
+    latency/ratio objectives share — a loop that stops moving stops
+    producing events, empty windows read as burn 0, and /health would
+    report ok indefinitely. The gauge is evaluated by whichever ticker
+    still runs (the expo refresh backstop when the loop itself is the
+    casualty). Built via ``SLOMonitor.add_objective`` because the service
+    is constructed WITH the monitor — this objective can only close over
+    it afterwards."""
+    return SLO(name="loop_liveness", kind="gauge",
+               value_fn=lambda: float(service.loop_staleness_s),
+               bound=float(stale_s), short_s=short_s, long_s=long_s)
+
+
+class SLOMonitor:
+    """Evaluate a set of ``SLO`` objectives on a fixed interval and run
+    the health state machine over them (module docstring)."""
+
+    def __init__(self, metrics, objectives: Sequence[SLO],
+                 tracer=None, interval_s: float = 5.0,
+                 recovery_evals: int = 2,
+                 event_window_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.metrics = metrics
+        self.objectives = list(objectives)
+        self.tracer = tracer
+        self.interval_s = float(interval_s)
+        for obj in self.objectives:
+            self._validate_objective(obj)
+        self.recovery_evals = max(1, int(recovery_evals))
+        #: how long a watchdog event keeps the state at >= warn; defaults
+        #: to the shortest objective short-window (or the interval).
+        self._event_window_explicit = bool(event_window_s)
+        self.event_window_s = float(event_window_s) if event_window_s else 0.0
+        self._clock = clock
+        self._state = STATE_OK
+        self._calm_evals = 0
+        self._last_eval_t: Optional[float] = None
+        #: (monotonic t, reason) of recent warn-level watchdog events;
+        #: appends are thread-safe, expiry happens at evaluation.
+        self._events: deque = deque(maxlen=1024)
+        #: ring of (t, counter snapshot) for ratio-objective deltas; sized
+        #: by ``_resize_for_objectives`` to cover the longest long window
+        #: at the evaluation cadence (plus slack for early/late ticks) —
+        #: each entry is a full counter-dict copy, so an oversized ring is
+        #: real memory and a longer ``_snapshot_at`` scan on every ratio
+        #: evaluation.
+        self._counter_ring: deque = deque(maxlen=8)
+        self._resize_for_objectives()
+        #: one evaluation at a time: the serving loop is the primary
+        #: ticker but the expo refresh thread backstops it, so the
+        #: interval gate alone is check-then-act. The claim is
+        #: non-blocking — a contending ticker skips (the winner's verdict
+        #: stands) — so neither hot path ever waits here.
+        self._eval_lock = threading.Lock()
+        self._verdict: Dict[str, Any] = {
+            "state": STATE_NAMES[STATE_OK], "state_code": STATE_OK,
+            "objectives": {}, "events": {}, "evaluations": 0, "ts": None,
+        }
+
+    def _validate_objective(self, obj: SLO) -> None:
+        """Refuse an objective whose windows the metrics ring cannot
+        honestly answer. A latency horizon longer than the rolling window
+        would SILENTLY read only window_s of data; one below a ring slice
+        would aggregate a full slice anyway — either way the configured
+        reaction/filtering guarantee is quietly weaker than asked. Same
+        philosophy as the gauge bound check: loud constructor over a
+        quietly-wrong objective."""
+        if obj.kind != "latency":
+            return
+        window_s = getattr(self.metrics, "window_s", None)
+        slice_s = getattr(self.metrics, "window_slice_s", None)
+        if window_s is not None and max(obj.short_s, obj.long_s) > window_s:
+            raise ValueError(
+                f"SLO {obj.name!r} window "
+                f"{max(obj.short_s, obj.long_s):g}s exceeds the "
+                f"metrics rolling horizon {window_s:g}s — construct "
+                f"Metrics(window_s=...) to cover the longest "
+                f"objective window")
+        if slice_s is not None and min(obj.short_s, obj.long_s) < slice_s:
+            raise ValueError(
+                f"SLO {obj.name!r} window "
+                f"{min(obj.short_s, obj.long_s):g}s is below the "
+                f"metrics ring resolution {slice_s:g}s/slice — raise "
+                f"the window or construct Metrics with more "
+                f"window_slices")
+
+    def _default_event_window(self) -> float:
+        return max(self.interval_s,
+                   min((o.short_s for o in self.objectives),
+                       default=self.interval_s))
+
+    def _resize_for_objectives(self) -> None:
+        """Re-derive the objective-dependent sizes — the default event
+        window (shortest short_s) and the counter-ring depth (longest
+        long_s at the eval cadence, +2 slack, clamped to [8, 4096]) —
+        from the CURRENT objective list. The single sizing rule for both
+        the constructor and ``add_objective``; existing ring entries are
+        preserved on a resize."""
+        if not self._event_window_explicit:
+            self.event_window_s = self._default_event_window()
+        longest_s = max((o.long_s for o in self.objectives),
+                        default=self.interval_s)
+        depth = int(math.ceil(longest_s / self.interval_s)) + 2
+        maxlen = max(8, min(4096, depth))
+        if maxlen != self._counter_ring.maxlen:
+            self._counter_ring = deque(self._counter_ring, maxlen=maxlen)
+
+    def add_objective(self, obj: SLO) -> None:
+        """Register one more objective after construction — for consumers
+        that only exist once the monitor does (the serving loop's
+        staleness gauge closes over the service, which is constructed WITH
+        the monitor). Runs the same window validation and re-derives the
+        event window and counter-ring depth exactly as the constructor
+        would have."""
+        self._validate_objective(obj)
+        self.objectives.append(obj)
+        self._resize_for_objectives()
+
+    # ---- readers (any thread) ----
+
+    @property
+    def state_code(self) -> int:
+        return self._state
+
+    @property
+    def state(self) -> str:
+        return STATE_NAMES[self._state]
+
+    def verdict(self) -> Dict[str, Any]:
+        """The last evaluation's full verdict (per-objective burn rates,
+        window counts, active events). Reference read — cheap and safe
+        from any thread; the dict is never mutated after the swap."""
+        return self._verdict
+
+    # ---- watchdog events (any thread) ----
+
+    def note_event(self, reason: str) -> None:
+        """Record one warn-level out-of-band event (e.g. the recompile
+        watchdog's post-warmup compile). Counted immediately
+        (``slo_events_<reason>``); holds health at >= warn while inside
+        ``event_window_s``."""
+        self._events.append((self._clock(), str(reason)))
+        if self.metrics is not None:
+            self.metrics.incr(mn.SLO_EVENTS_PREFIX + reason)
+
+    # ---- evaluation (serving-loop thread) ----
+
+    def tick(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Evaluate iff ``interval_s`` has elapsed — the serving loop
+        calls this once per batch/idle iteration; the non-due path is one
+        clock read and one comparison."""
+        now = self._clock() if now is None else now
+        if (self._last_eval_t is not None
+                and now - self._last_eval_t < self.interval_s):
+            return None
+        return self.evaluate(now)
+
+    def evaluate(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One full evaluation. Returns None (without evaluating) when
+        another thread is mid-evaluation — the serving loop and the expo
+        backstop can tick concurrently, and the winner's verdict stands;
+        the state machine must never run twice over one instant."""
+        if not self._eval_lock.acquire(blocking=False):
+            return None
+        try:
+            now = self._clock() if now is None else now
+            self._last_eval_t = now
+            counters = (self.metrics.counters()
+                        if self.metrics is not None else {})
+            self._counter_ring.append((now, counters))
+            per_objective: Dict[str, Dict[str, Any]] = {}
+            worst = STATE_OK
+            for obj in self.objectives:
+                result = self._evaluate_one(obj, now, counters)
+                per_objective[obj.name] = result
+                worst = max(worst, result["state_code"])
+                if self.metrics is not None:
+                    self.metrics.set_gauge(mn.SLO_BURN_PREFIX + obj.name,
+                                           result["burn"])
+            active_events = self._active_events(now)
+            if active_events and worst < STATE_WARN:
+                worst = STATE_WARN
+            prev = self._state
+            state = self._advance_state(worst)
+            verdict = {
+                "state": STATE_NAMES[state],
+                "state_code": state,
+                "raw_state": STATE_NAMES[worst],
+                "objectives": per_objective,
+                "events": active_events,
+                "evaluations": self._verdict["evaluations"] + 1,
+                "ts": time.time(),
+            }
+            self._verdict = verdict
+            # The health_state gauge and evaluation counter are written
+            # INSIDE the claim: written after release, an evaluator
+            # descheduled at the release point could overwrite a newer
+            # evaluation's gauge with its stale state — /prom would then
+            # disagree with /health until the next tick. Metrics is a leaf
+            # lock, so the edge stays clean in the lock-order graph.
+            if self.metrics is not None:
+                self.metrics.incr(mn.SLO_EVALUATIONS)
+                self.metrics.set_gauge(mn.HEALTH_STATE, state)
+        finally:
+            self._eval_lock.release()
+        # Transition side effects (span, flight dump — file I/O) run
+        # OUTSIDE the lock: the non-blocking claim above already
+        # guarantees at most one thread reaches a given transition.
+        if state != prev:
+            self._note_transition(prev, state, verdict)
+        return verdict
+
+    # ---- internals ----
+
+    def _active_events(self, now: float) -> Dict[str, int]:
+        lo = now - self.event_window_s
+        active: Dict[str, int] = {}
+        # Snapshot before iterating: note_event appends from other threads
+        # (the serving loop's recompile watchdog, any future watchdog) and
+        # a deque append during iteration raises RuntimeError; tuple() of
+        # a deque completes in C without a bytecode boundary, so the copy
+        # itself cannot interleave with an append.
+        for t, reason in tuple(self._events):
+            if t >= lo:
+                active[reason] = active.get(reason, 0) + 1
+        return active
+
+    def _evaluate_one(self, obj: SLO, now: float,
+                      counters: Dict[str, float]) -> Dict[str, Any]:
+        if obj.kind == "latency":
+            burns = self._latency_burns(obj)
+        elif obj.kind == "ratio":
+            burns = self._ratio_burns(obj, now, counters)
+        else:
+            burns = self._gauge_burns(obj)
+        (burn_short, n_short), (burn_long, n_long) = burns
+        state = STATE_OK
+        # Severity needs BOTH windows burning past its rate AND enough
+        # volume to make the rate meaningful (the min_events floor —
+        # docstrings here and on the field).
+        enough = (obj.kind == "gauge"
+                  or min(n_short, n_long) >= obj.min_events)
+        if enough:
+            if (burn_short >= obj.critical_burn
+                    and burn_long >= obj.critical_burn):
+                state = STATE_CRITICAL
+            elif burn_short >= obj.warn_burn and burn_long >= obj.warn_burn:
+                state = STATE_WARN
+        result = {
+            "kind": obj.kind,
+            "burn_short": round(burn_short, 4),
+            "burn_long": round(burn_long, 4),
+            "burn": round(max(burn_short, burn_long), 4),
+            "events_short": n_short,
+            "events_long": n_long,
+            "state": STATE_NAMES[state],
+            "state_code": state,
+        }
+        if not enough:
+            result["low_volume"] = True
+        return result
+
+    def _latency_burns(self, obj: SLO):
+        budget = 1.0 - obj.target
+        if getattr(self.metrics, "window_count", None) is None:
+            # No histogram surface wired (metrics=None constructs by
+            # documented contract): zero events in both windows. The
+            # min_events floor then keeps the objective at ok/low_volume
+            # instead of the serving loop crash-looping on an
+            # AttributeError every tick.
+            return [(0.0, 0), (0.0, 0)]
+        out = []
+        for horizon in (obj.short_s, obj.long_s):
+            count = self.metrics.window_count(obj.window, horizon_s=horizon)
+            frac = (self.metrics.fraction_above(obj.window, obj.threshold_s,
+                                                horizon_s=horizon)
+                    if count else 0.0)
+            out.append((frac / budget, count))
+        return out
+
+    def _ratio_burns(self, obj: SLO, now: float, counters: Dict[str, float]):
+        budget = 1.0 - obj.target
+        out = []
+        for horizon in (obj.short_s, obj.long_s):
+            base = self._snapshot_at(now - horizon)
+            bad = sum(counters.get(k, 0.0) - base.get(k, 0.0)
+                      for k in obj.bad_counters)
+            total = sum(counters.get(k, 0.0) - base.get(k, 0.0)
+                        for k in obj.total_counters)
+            frac = (bad / total) if total > 0 else 0.0
+            out.append((max(0.0, frac) / budget, int(max(0.0, total))))
+        return out
+
+    def _snapshot_at(self, t: float) -> Dict[str, float]:
+        """The newest recorded counter snapshot taken at or before ``t``
+        (so the delta covers AT LEAST the horizon); the empty dict —
+        i.e. since-process-start deltas — when the ring does not reach
+        back that far yet."""
+        best: Dict[str, float] = {}
+        for ts, snap in self._counter_ring:
+            if ts <= t:
+                best = snap
+            else:
+                break
+        return best
+
+    def _gauge_burns(self, obj: SLO):
+        try:
+            value = float(obj.value_fn())
+        except Exception:  # noqa: BLE001 — a probe failure is not a breach
+            # Counted, not raised: a dead gauge probe must read as burn 0
+            # (no data is not a breach), but it must not be silent either.
+            if self.metrics is not None:
+                self.metrics.incr(mn.SLO_PROBE_FAILURES)
+            value = 0.0
+        burn = (value / obj.bound) if obj.bound > 0 else 0.0
+        return [(burn, 1), (burn, 1)]
+
+    def _advance_state(self, worst: int) -> int:
+        """Hysteresis: escalate immediately, de-escalate one level per
+        ``recovery_evals`` consecutive evaluations whose raw severity sat
+        below the current state."""
+        prev = self._state
+        if worst >= prev:
+            self._calm_evals = 0
+            self._state = worst
+        else:
+            self._calm_evals += 1
+            if self._calm_evals >= self.recovery_evals:
+                self._calm_evals = 0
+                self._state = prev - 1
+        return self._state
+
+    def _note_transition(self, prev: int, new: int,
+                         verdict: Dict[str, Any]) -> None:
+        if self.metrics is not None:
+            self.metrics.incr(mn.SLO_TRANSITIONS)
+        tracer = self.tracer
+        if tracer is not None:
+            from opencv_facerecognizer_tpu.utils import tracing
+
+            # Instant lifecycle span: health transitions are the signals
+            # layer's causal markers, same shape as the brownout spans.
+            tracer.emit(tracer.new_trace(), "health",
+                        topic=tracing.LIFECYCLE_TOPIC,
+                        from_state=STATE_NAMES[prev],
+                        to_state=STATE_NAMES[new])
+            if new == STATE_CRITICAL:
+                # The budget just blew: capture what was in flight. Rate
+                # limited like every recorder trigger; the per-objective
+                # burns ride the dump so the post-mortem starts with the
+                # verdict, not just the spans.
+                tracer.dump("slo_critical",
+                            extra={"verdict": {
+                                k: verdict[k] for k in
+                                ("state", "objectives", "events")}})

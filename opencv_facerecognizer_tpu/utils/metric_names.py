@@ -50,6 +50,11 @@ DISPATCH = "dispatch"
 PUBLISH = "publish"
 BATCH_LATENCY = "batch_latency"
 READY_WAIT = "ready_wait"
+#: per-frame end-to-end latency (batcher enqueue -> result publish), the
+#: SLO layer's headline histogram family; the ``_interactive`` window is
+#: the same observation restricted to interactive-priority frames.
+E2E_LATENCY = "e2e_latency"
+E2E_LATENCY_INTERACTIVE = "e2e_latency_interactive"
 
 # ---- admission / brownout (overload layer) --------------------------------
 #: per-reason rejection family: ``frames_rejected_<reason>``
@@ -128,6 +133,29 @@ EXPO_ERRORS = "expo_errors"
 #: ``stage_share_b<bucket>_<detect|crop|embed|match>``
 STAGE_SHARE_PREFIX = "stage_share_"
 DEVICE_BUSY_FRACTION = "device_busy_fraction"
+
+# ---- signals layer: SLO / health / watchdogs (runtime.slo) -----------------
+#: health state machine gauge: 0 = ok, 1 = warn, 2 = critical.
+HEALTH_STATE = "health_state"
+SLO_EVALUATIONS = "slo_evaluations"
+SLO_TRANSITIONS = "slo_transitions"
+#: a gauge objective's ``value_fn`` raised — the probe is dead, its burn
+#: reads 0 (no data is not a breach), but the failure is never silent.
+SLO_PROBE_FAILURES = "slo_probe_failures"
+#: a backstop ticker's ``SLOMonitor.tick()`` raised — the EVALUATION
+#: failed, distinct from a dead gauge probe (``slo_probe_failures``):
+#: alerting on this chases the monitor, not an objective's value_fn.
+SLO_TICK_ERRORS = "slo_tick_errors"
+#: per-objective burn-rate gauge family: ``slo_burn_<objective>`` (the
+#: max of the short- and long-window burn rates at last evaluation).
+SLO_BURN_PREFIX = "slo_burn_"
+#: warn-level watchdog event counter family: ``slo_events_<reason>``
+#: (e.g. ``slo_events_recompile_post_warmup``).
+SLO_EVENTS_PREFIX = "slo_events_"
+#: jit-cache misses observed on serving dispatches AFTER warmup compiled
+#: the whole bucket ladder — each one is a mid-serving XLA compile the
+#: prewarm design exists to prevent (the recompile watchdog's counter).
+RECOMPILES_POST_WARMUP = "recompiles_post_warmup"
 
 # ---- supervisor ------------------------------------------------------------
 SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"

@@ -1,21 +1,45 @@
 """Structured per-batch metrics (SURVEY.md §5.5): counters + latency
 percentiles + a JSONL sink. The north-star metric (faces/sec/chip) falls out
-of the per-batch records."""
+of the per-batch records.
+
+Latency windows are **rolling log-bucket histograms**
+(``utils.histogram.RollingHistogram``) as of the signals layer: an
+``observe`` is one O(1) bucket increment, a percentile read is a
+~100-bucket walk (exact to one bucket width — see the histogram module's
+contract), the horizon is true wall-clock time (``window_s`` seconds,
+sliced), and memory per window is flat forever — the old sample deques
+were bounded only between ``reset_window()`` calls and reported "the last
+N samples" over whatever time span that happened to be. The observe /
+``percentile`` / ``summary`` surface is unchanged, including the explicit
+``None`` percentiles for known-but-empty windows; ``summary`` additionally
+reports ``_p99_ms`` now that p99 is cheap (the SLO layer's headline
+quantile). The SLO monitor reads the same windows through
+``fraction_above``/``window_count``, and ``/prom`` renders them through
+``export_state``."""
 
 from __future__ import annotations
 
 import json
 import threading
 import time
-from collections import defaultdict, deque
-from typing import Dict, IO, Optional
+from collections import defaultdict
+from typing import Any, Dict, IO, Optional, Tuple
+
+from opencv_facerecognizer_tpu.utils.histogram import RollingHistogram
 
 
 class Metrics:
-    """Thread-safe counters + gauges + bounded latency windows + optional
-    JSONL sink."""
+    """Thread-safe counters + gauges + rolling-histogram latency windows +
+    optional JSONL sink.
 
-    def __init__(self, sink: Optional[IO[str]] = None, window: int = 512):
+    ``window_s``/``window_slices`` size every latency window's rolling
+    ring: the default 600 s over 20 slices covers the SLO layer's stock
+    long window at 30 s horizon granularity (a requested horizon is
+    rounded UP to whole slices — see ``RollingHistogram.merged``). Tests
+    and soaks that need fast expiry pass finer slicing."""
+
+    def __init__(self, sink: Optional[IO[str]] = None,
+                 window_s: float = 600.0, window_slices: int = 20):
         self._lock = threading.Lock()
         # The sink gets its OWN lock: a slow JSONL sink (disk stall, full
         # pipe) must serialize log lines against each other, but it must
@@ -24,8 +48,26 @@ class Metrics:
         self._sink_lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
-        self._latencies: Dict[str, deque] = defaultdict(lambda: deque(maxlen=window))
+        self._window_s = float(window_s)
+        self._window_slices = int(window_slices)
+        self._latencies: Dict[str, RollingHistogram] = defaultdict(
+            lambda: RollingHistogram(self._window_s, self._window_slices))
         self._sink = sink
+
+    @property
+    def window_s(self) -> float:
+        """Rolling-horizon of every latency window (seconds). Reads over a
+        longer horizon silently see at most this much data — consumers
+        with configurable horizons (the SLO monitor) validate against it
+        at construction."""
+        return self._window_s
+
+    @property
+    def window_slice_s(self) -> float:
+        """Ring resolution (seconds per slice): a horizon below this reads
+        a full slice's worth of data anyway. The SLO monitor refuses
+        sub-slice windows against it at construction."""
+        return self._window_s / self._window_slices
 
     def incr(self, name: str, value: float = 1.0) -> None:
         with self._lock:
@@ -33,7 +75,7 @@ class Metrics:
 
     def observe(self, name: str, seconds: float) -> None:
         with self._lock:
-            self._latencies[name].append(seconds)
+            self._latencies[name].observe(seconds)  # ocvf-lint: disable=metrics-registry -- RollingHistogram.observe takes the sample VALUE; the metric name was validated at this method's own call site
 
     def set_gauge(self, name: str, value: float) -> None:
         """Last-write-wins instantaneous value (e.g. the batcher's current
@@ -74,13 +116,35 @@ class Metrics:
             return (sum(c.get(n, 0.0) for n in positive)
                     - sum(c.get(n, 0.0) for n in negative))
 
-    def percentile(self, name: str, q: float) -> float:
+    def percentile(self, name: str, q: float,
+                   horizon_s: Optional[float] = None) -> float:
+        """The window's ``q``-percentile in seconds over the trailing
+        ``horizon_s`` (default: the full rolling window); NaN when the
+        window is unknown or empty. Exact to one histogram bucket."""
         with self._lock:
-            values = sorted(self._latencies.get(name, ()))
-        if not values:
-            return float("nan")
-        idx = min(int(q / 100.0 * len(values)), len(values) - 1)
-        return values[idx]
+            window = self._latencies.get(name)
+            if window is None:
+                return float("nan")
+            return window.quantile(q, horizon_s=horizon_s)
+
+    def fraction_above(self, name: str, threshold_s: float,
+                       horizon_s: Optional[float] = None) -> float:
+        """Fraction of the window's observations above ``threshold_s``
+        over the trailing horizon — the SLO burn-rate monitor's error-rate
+        read for latency objectives. 0.0 for unknown/empty windows (no
+        data never reads as a breach; ``window_count`` tells them apart)."""
+        with self._lock:
+            window = self._latencies.get(name)
+            if window is None:
+                return 0.0
+            return window.fraction_above(threshold_s, horizon_s=horizon_s)
+
+    def window_count(self, name: str,
+                     horizon_s: Optional[float] = None) -> int:
+        """Observations currently inside the trailing horizon."""
+        with self._lock:
+            window = self._latencies.get(name)
+            return 0 if window is None else window.count(horizon_s=horizon_s)
 
     def reset_window(self, name: Optional[str] = None) -> None:
         """Clear one latency window (or all of them) without touching
@@ -110,19 +174,37 @@ class Metrics:
             self._sink.flush()
 
     def summary(self) -> Dict[str, Optional[float]]:
-        """Counters + gauges + per-window percentiles. A window that is
-        known but currently EMPTY (after ``reset_window``) reports
-        explicit ``None`` values — never a misleading zero, never a raise
-        — so a consumer can tell "no data yet" from "measured 0 ms"."""
+        """Counters + gauges + per-window percentiles (p50/p95/p99, ms,
+        bucket precision). A window that is known but currently EMPTY
+        (after ``reset_window`` or full rolling expiry) reports explicit
+        ``None`` values — never a misleading zero, never a raise — so a
+        consumer can tell "no data yet" from "measured 0 ms"."""
         with self._lock:
             out: Dict[str, Optional[float]] = dict(self._counters)
             out.update(self._gauges)
-            for name, values in self._latencies.items():
-                if values:
-                    ordered = sorted(values)
-                    out[f"{name}_p50_ms"] = ordered[len(ordered) // 2] * 1e3
-                    out[f"{name}_p95_ms"] = ordered[min(int(0.95 * len(ordered)), len(ordered) - 1)] * 1e3
+            for name, window in self._latencies.items():
+                merged = window.merged()
+                if merged.count:
+                    out[f"{name}_p50_ms"] = merged.quantile(50) * 1e3
+                    out[f"{name}_p95_ms"] = merged.quantile(95) * 1e3
+                    out[f"{name}_p99_ms"] = merged.quantile(99) * 1e3
                 else:
                     out[f"{name}_p50_ms"] = None
                     out[f"{name}_p95_ms"] = None
+                    out[f"{name}_p99_ms"] = None
         return out
+
+    def export_state(self) -> Tuple[Dict[str, float], Dict[str, float],
+                                    Dict[str, Dict[str, Any]]]:
+        """One atomic ``(counters, gauges, histograms)`` snapshot for the
+        Prometheus exposition (``runtime.promtext``): histograms are the
+        full-window merge in ``LogBucketHistogram.snapshot`` shape
+        (bounds / per-bucket counts / count / sum). Empty-but-known
+        windows export with ``count == 0`` — a scraper sees the family
+        exists even before traffic."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = {name: window.merged().snapshot()
+                     for name, window in self._latencies.items()}
+        return counters, gauges, hists

@@ -371,12 +371,34 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
     trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
     tracer = Tracer(ring_size=1 << 17, sample=1.0, seed=seed,
                     dump_dir=trace_dir, min_dump_interval_s=0.25)
+    # SLO burn-rate monitor under test (signals-layer acceptance): tight
+    # windows + fine-sliced metrics rings so a few seconds of flood
+    # provably burns the budget to critical AND a few seconds of calm
+    # provably recovers it — the production defaults just stretch the
+    # same clocks. The monitor is ticked by the serving loop (the wiring
+    # under test), fires the critical flight dump via this tracer, and at
+    # critical adds one level of brownout intake pressure.
+    from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+    from opencv_facerecognizer_tpu.runtime.slo import SLO, SLOMonitor
+    from opencv_facerecognizer_tpu.utils import metric_names as mn
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    metrics = Metrics(window_s=6.0, window_slices=12)
+    slo = SLOMonitor(metrics, [
+        SLO(name="queue_wait_p99", kind="latency", window=mn.QUEUE_WAIT,
+            threshold_s=0.05, target=0.9, short_s=1.0, long_s=3.0,
+            warn_burn=1.0, critical_burn=2.5),
+        SLO(name="completion", kind="ratio", target=0.95,
+            bad_counters=RecognizerService.LEDGER_DROP_COUNTERS,
+            total_counters=(mn.FRAMES_ADMITTED,),
+            short_s=1.0, long_s=3.0, warn_burn=1.0, critical_burn=2.5),
+    ], tracer=tracer, interval_s=0.25, recovery_evals=2)
     # The service-under-test: the canonical overload harness (shared with
     # bench_serving.run_overload_sweep so both exercise one config).
     pipeline, service, connector = build_overload_stack(
         frame_shape=frame_shape, batch_size=batch_size,
         dispatch_s=dispatch_s, fault_injector=injector, journal=journal,
-        tracer=tracer)
+        tracer=tracer, slo_monitor=slo, metrics=metrics)
     supervisor = ServiceSupervisor(service, max_restarts=100,
                                    poll_interval_s=0.05)
     supervisor.start(warmup=False)
@@ -408,12 +430,18 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
         service.drain(timeout=15.0)
         base_p99_ms = recorder.percentile_ms(base_seqs, 99)
 
+        # SLO baseline sanity: after the clean phase the monitor must be
+        # sitting at ok (a monitor that starts alarmed proves nothing
+        # about the flood).
+        slo_baseline_state = slo.state
+
         # ---- phase B: the flood (seed-logged fault amplification) ----
         injector.arm()
         flood_interactive, flood_bulk = [], []
         interval = 1.0 / base_hz
         flood_end = time.monotonic() + seconds
         i = 0
+        slo_max_state = slo.state_code
         while time.monotonic() < flood_end:
             if i % 10 == 0:
                 offer(seq, "interactive")
@@ -423,6 +451,7 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
                 flood_bulk.append(seq)
             seq += 1
             i += 1
+            slo_max_state = max(slo_max_state, slo.state_code)
             time.sleep(interval)
         injector.disarm()
 
@@ -435,8 +464,17 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
         recover_deadline = time.monotonic() + 15.0
         while (service.brownout_level > 0
                and time.monotonic() < recover_deadline):
+            slo_max_state = max(slo_max_state, slo.state_code)
             time.sleep(0.05)
         brownout_recovered = service.brownout_level == 0
+        # The SLO state machine must also walk back to ok once the
+        # rolling windows clear the flood (hysteresis: recovery_evals
+        # consecutive calmer evaluations per level) — bounded wait, the
+        # serving loop keeps ticking the monitor on idle iterations.
+        slo_deadline = time.monotonic() + 20.0
+        while slo.state_code > 0 and time.monotonic() < slo_deadline:
+            time.sleep(0.05)
+        slo_recovered_state = slo.state
         probe_seqs = []
         for _ in range(6):
             offer(seq, "interactive")
@@ -491,14 +529,55 @@ def run_overload(seconds: float = 6.0, seed: int | None = None,
     })
 
     report["brownout_recovered"] = brownout_recovered
+    from opencv_facerecognizer_tpu.runtime.slo import (
+        STATE_CRITICAL, STATE_NAMES, STATE_OK,
+    )
+
+    report["slo"] = {
+        "baseline_state": slo_baseline_state,
+        "max_state": STATE_NAMES[slo_max_state],
+        "recovered_state": slo_recovered_state,
+        "evaluations": slo.verdict().get("evaluations"),
+        "transitions": int(counters.get("slo_transitions", 0)),
+    }
+    # The critical-transition flight dump: globbed BEFORE
+    # _finish_observability tears the trace dir down.
+    slo_dumps = sorted(glob.glob(
+        os.path.join(trace_dir, "flight-*slo_critical*.json")))
+    slo_dump_ok = False
+    for path in slo_dumps:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+            verdict = rec.get("extra", {}).get("verdict", {})
+            if (isinstance(rec.get("spans"), dict)
+                    and verdict.get("objectives")):
+                slo_dump_ok = True
+        except (OSError, ValueError):
+            continue
+    report["slo"]["critical_dumps"] = len(slo_dumps)
     failures = []
     _finish_observability(tracer, trace_dir, "overload_end", ledger,
                           quiesced, failures, report)
     if wedged:
-        missing = [s for s in probe_seqs if s not in done_t]
-        failures.append(f"wedged: liveness probe missing {len(missing)} results")
+        failures.append(f"wedged: liveness probe got "
+                        f"{recorder.completed(probe_seqs)}/"
+                        f"{len(probe_seqs)} results")
     if not brownout_recovered:
         failures.append("brownout never recovered after the flood stopped")
+    # ---- SLO acceptance (signals layer) ----
+    if slo_baseline_state != STATE_NAMES[STATE_OK]:
+        failures.append(f"SLO monitor not ok after the clean baseline "
+                        f"phase (was {slo_baseline_state})")
+    if slo_max_state < STATE_CRITICAL:
+        failures.append(f"SLO monitor never reached critical under a ~4x "
+                        f"flood (max {STATE_NAMES[slo_max_state]})")
+    if slo_recovered_state != STATE_NAMES[STATE_OK]:
+        failures.append(f"SLO monitor never recovered to ok after the "
+                        f"flood (stuck at {slo_recovered_state})")
+    if slo_max_state >= STATE_CRITICAL and not slo_dump_ok:
+        failures.append("critical transition left no parseable "
+                        "slo_critical flight dump with a verdict")
     if counters.get("loop_crashes", 0):
         failures.append(f"crashed: loop_crashes={counters['loop_crashes']}")
     # NaN percentiles mean zero completions in that phase — each is its

@@ -588,6 +588,12 @@ def test_cpu_fallback_rebuilds_pipeline_and_keeps_serving(chaos_stack):
         assert service.pipeline.fault_injector is injector
         # The enrolment embed graph follows to the fallback device too.
         assert service._embed_device is not None
+        # The recompile watchdog stayed armed across the swap: the new
+        # pipeline's ladder was prewarmed inside the hook, so the
+        # fallback's own compiles never fire it and later mid-serving
+        # compiles still would.
+        assert service._warmed
+        assert service.metrics.counter("recompiles_post_warmup") == 0
         chunk = np.zeros((service._enrol_chunk, *service.pipeline.face_size),
                          np.float32)
         emb = np.asarray(service._run_embed_chunk(

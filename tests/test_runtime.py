@@ -36,7 +36,7 @@ def test_batcher_full_batch():
     b = FrameBatcher(batch_size=4, frame_shape=(8, 8), flush_timeout=10.0)
     for i in range(4):
         assert b.put(np.full((8, 8), i, np.float32), meta=i)
-    frames, metas, count, _ts, _tids = b.get_batch()
+    frames, metas, count, _ts, _tids, _pris = b.get_batch()
     assert count == 4 and frames.shape == (4, 8, 8)
     assert metas == [0, 1, 2, 3]
     np.testing.assert_allclose(frames[2], 2.0)
@@ -46,7 +46,7 @@ def test_batcher_timeout_flush_pads():
     b = FrameBatcher(batch_size=4, frame_shape=(8, 8), flush_timeout=0.05)
     b.put(np.ones((8, 8), np.float32), meta="only")
     t0 = time.monotonic()
-    frames, metas, count, _ts, _tids = b.get_batch()
+    frames, metas, count, _ts, _tids, _pris = b.get_batch()
     assert time.monotonic() - t0 < 1.0
     assert count == 1
     assert metas[0] == "only" and metas[1] is None
@@ -64,7 +64,7 @@ def test_batcher_overflow_drops_oldest():
     b = FrameBatcher(batch_size=2, frame_shape=(4, 4), max_pending=3)
     for i in range(5):
         b.put(np.full((4, 4), i, np.float32), meta=i)
-    frames, metas, count, _ts, _tids = b.get_batch()
+    frames, metas, count, _ts, _tids, _pris = b.get_batch()
     assert b.stats["dropped_overflow"] == 2
     assert metas[:2] == [2, 3]  # oldest (0, 1) dropped
 
@@ -89,7 +89,7 @@ def test_batcher_concurrent_producers_consumer():
             out = b.get_batch(block=True)
             if out is None:
                 break
-            _, metas, count, _ts, _tids = out
+            _, metas, count, _ts, _tids, _pris = out
             seen.extend(metas[:count])
 
     c = threading.Thread(target=consumer)

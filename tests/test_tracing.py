@@ -359,7 +359,7 @@ def test_fold_attribution_sets_registered_gauges():
 def test_metrics_summary_empty_window_reports_nulls():
     metrics = Metrics()
     metrics.observe("queue_wait", 0.005)
-    assert metrics.summary()["queue_wait_p50_ms"] == pytest.approx(5.0)
+    assert metrics.summary()["queue_wait_p50_ms"] == pytest.approx(5.0, rel=0.1)  # histogram bucket precision
     metrics.reset_window("queue_wait")
     summary = metrics.summary()
     # Explicit nulls — never a stale value, a zero, or a KeyError.
@@ -378,7 +378,7 @@ def test_metrics_reset_window_scopes():
     metrics.reset_window("a")
     summary = metrics.summary()
     assert summary["a_p50_ms"] is None
-    assert summary["b_p50_ms"] == pytest.approx(2.0)
+    assert summary["b_p50_ms"] == pytest.approx(2.0, rel=0.1)  # histogram bucket precision
     metrics.reset_window()
     assert metrics.summary()["b_p50_ms"] is None
     # Counters are untouched by window resets.

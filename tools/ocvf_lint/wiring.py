@@ -29,6 +29,7 @@ ATTR_HINTS: Dict[str, str] = {
     "state_store": "StateLifecycle",
     "checkpoints": "CheckpointStore",
     "admission": "AdmissionController",
+    "slo": "SLOMonitor",
     "connector": "JSONLConnector",
     "pipeline": "RecognitionPipeline",
 }
