@@ -529,6 +529,116 @@ def run_overload_sweep(multipliers=(1.0, 2.0, 4.0), seconds=3.0,
     }
 
 
+def run_replica_scaleout(replica_counts=(1, 2, 4), seconds=3.0,
+                         batch_size=8, frame_hw=(32, 32), dispatch_s=0.04,
+                         topics=48, offered_factor=4.0):
+    """In-process replica scale-out ladder (the horizontal-scale-out
+    analogue of the overload sweep): N serving replicas — each the
+    canonical capacity-walled overload stack (``batch_size / dispatch_s``
+    frames/s) — behind the rendezvous ``TopicRouter``
+    (``runtime.fakes.build_replica_fleet``), driven at one FIXED offered
+    load of ``offered_factor`` x a single replica's capacity spread over
+    ``topics`` camera topics. One replica saturates; more replicas split
+    the topics and the completed-frame count scales until the offered
+    load itself is the ceiling. Deterministic: the rendezvous split is a
+    pure hash of (topic, replica name), and the capacity wall is a
+    scripted sleep, not real compute.
+
+    ``scaling.x2`` (completed at 2 replicas / completed at 1) is the
+    acceptance number: >= 1.6x proves the router + fleet actually spread
+    load (ideal is ~2.0 — the hash split over 48 topics is 23/25).
+    ``scaling_2x_ok`` gates the smoke's exit code;
+    ``scripts/bench_compare.py`` tracks the ratio across artifacts."""
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        TrafficRecorder, build_replica_fleet,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    capacity_fps = batch_size / dispatch_s
+    offered_hz = offered_factor * capacity_fps
+    frame = np.zeros(frame_hw, np.float32)
+    rows = []
+    completed_by_n = {}
+    for n in replica_counts:
+        router, stacks = build_replica_fleet(
+            n, frame_shape=frame_hw, batch_size=batch_size,
+            dispatch_s=dispatch_s, router_metrics=Metrics())
+        # The shared seq-stamped recorder (runtime.fakes.TrafficRecorder,
+        # subscribed on the ROUTER so results from every replica fan in)
+        # — the replication chaos scenario measures through the same
+        # code, so the bench rows and the soak's criteria agree.
+        recorder = TrafficRecorder(router)
+        for _pipe, service, _conn, _metrics in stacks:
+            service.start(warmup=False)
+        router.start()
+        try:
+            n_frames = int(seconds * offered_hz)
+            interval = 1.0 / offered_hz
+            start = time.monotonic()
+            for seq in range(n_frames):
+                target = start + seq * interval
+                now = time.monotonic()
+                if target > now:
+                    time.sleep(target - now)
+                recorder.send_t[seq] = time.monotonic()
+                router.publish(f"camera/{seq % topics}",
+                               {"frame": frame, "meta": {"seq": seq}})
+            for _pipe, service, _conn, _metrics in stacks:
+                service.drain(timeout=30.0)
+        finally:
+            router.stop()
+            for _pipe, service, _conn, _metrics in stacks:
+                service.stop()
+        lat = np.asarray(recorder.latencies(range(n_frames)))
+        per_replica = []
+        ledger_remainder = 0.0
+        for _pipe, service, _conn, metrics in stacks:
+            ledger = service.ledger()
+            ledger_remainder += abs(ledger["in_system"])
+            per_replica.append({
+                "completed": int(ledger["completed"]),
+                "admitted": int(ledger["admitted"]),
+                "rejected": {k: int(v) for k, v in metrics
+                             .counters_with_prefix("frames_rejected_")
+                             .items()},
+            })
+        completed_by_n[n] = len(lat)
+        row = {
+            "replicas": n,
+            "offered_hz": round(offered_hz, 1),
+            "offered_frames": n_frames,
+            "completed_frames": int(len(lat)),
+            "completed_hz": round(len(lat) / seconds, 1),
+            "per_replica": per_replica,
+            "ledger_remainder_after_drain": ledger_remainder,
+        }
+        if len(lat):
+            row["e2e_p50_ms"] = round(float(np.percentile(lat, 50)) * 1e3, 1)
+            row["e2e_p99_ms"] = round(float(np.percentile(lat, 99)) * 1e3, 1)
+        rows.append(row)
+        print(json.dumps(row), file=sys.stderr)
+    scaling = {}
+    base = completed_by_n.get(replica_counts[0], 0)
+    for n in replica_counts[1:]:
+        if base:
+            scaling[f"x{n}"] = round(completed_by_n[n] / base, 3)
+    return {
+        "note": (f"fixed offered load ({offered_factor:g}x one replica's "
+                 f"{capacity_fps:g} frames/s capacity wall) over {topics} "
+                 "camera topics, rendezvous-routed across N in-process "
+                 "replicas (each the canonical overload stack). Completed "
+                 "frames scale with N until the offered load is the "
+                 "ceiling; p99 reflects per-replica admission keeping "
+                 "queues shallow."),
+        "config": {"batch_size": batch_size, "dispatch_s": dispatch_s,
+                   "capacity_fps": capacity_fps, "offered_hz": offered_hz,
+                   "topics": topics, "seconds": seconds},
+        "rows": rows,
+        "scaling": scaling,
+        "scaling_2x_ok": bool(scaling.get("x2", 0.0) >= 1.6),
+    }
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--rates", type=float, nargs="+",
@@ -558,6 +668,7 @@ def main(argv=None):
         artifact = run_smoke(write=False)
         artifact["overload_sweep"] = run_overload_sweep()
         artifact["tracing_overhead"] = run_tracing_overhead()
+        artifact["replica_scaleout"] = run_replica_scaleout()
         with open("BENCH_SERVING_smoke.json", "w") as fh:
             json.dump(artifact, fh, indent=2)
         print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
@@ -566,6 +677,7 @@ def main(argv=None):
         sweep_4x = next((r for r in artifact["overload_sweep"]["rows"]
                          if r["offered_multiplier"] == 4.0), {})
         trace_cmp = artifact["tracing_overhead"]
+        scaleout = artifact["replica_scaleout"]
         print(json.dumps({
             "legacy_e2e_p50_ms": legacy.get("e2e_p50_ms"),
             "overlapped_e2e_p50_ms": overlap.get("e2e_p50_ms"),
@@ -581,10 +693,14 @@ def main(argv=None):
                 - sweep_4x.get("bulk_completed", 0)),
             "tracing_p50_ratio": trace_cmp.get("p50_ratio"),
             "tracing_within_gate": trace_cmp.get("within_gate"),
+            "replica_scaleout_x2": scaleout.get("scaling", {}).get("x2"),
+            "replica_scaleout_x4": scaleout.get("scaling", {}).get("x4"),
+            "replica_scaleout_ok": scaleout.get("scaling_2x_ok"),
         }))
-        # within_gate is always present (False on a failed measurement):
-        # the gate fails closed.
-        return 0 if trace_cmp.get("within_gate") else 3
+        # Both gates fail closed (False on a failed measurement): tracing
+        # overhead AND the 2-replica >= 1.6x completed-frames scaling.
+        return (0 if trace_cmp.get("within_gate")
+                and scaleout.get("scaling_2x_ok") else 3)
 
     import jax
 

@@ -280,6 +280,51 @@ def build_parser() -> argparse.ArgumentParser:
                    help="the two burn-rate windows (seconds): a severity "
                         "fires only when BOTH windows burn past its rate "
                         "(short reacts, long filters blips)")
+    # ---- multi-replica serving (runtime.replication; README
+    # "Horizontal scale-out") ----
+    p.add_argument("--replica-role", choices=["writer", "reader"],
+                   default="writer",
+                   help="role against a shared --state-dir. writer "
+                        "(default): owns enrollment — acquires the fcntl "
+                        "writer lease in the state dir and FAILS CLOSED "
+                        "when another live writer holds it (split-brain "
+                        "protection). reader: opens the WAL strictly "
+                        "read-only, anchors on the newest checkpoint, and "
+                        "tails new enrollment rows between batches; "
+                        "enroll commands are rejected with an explicit "
+                        "status. Only meaningful with --state-dir")
+    p.add_argument("--replica-poll-ms", type=float, default=50.0,
+                   help="reader role: WAL tail poll interval — bounds "
+                        "replication staleness (plus append visibility) "
+                        "per replica")
+    p.add_argument("--replication-lag-rows", type=int, default=4096,
+                   help="reader role with --slo: replication-lag gauge "
+                        "objective bound — unapplied WAL rows above this "
+                        "read as burn >= 1 (warn; critical at 6x feeds "
+                        "one level of brownout intake pressure)")
+    p.add_argument("--router", metavar="HOST:PORT[,HOST:PORT...]",
+                   help="run as a model-free TOPIC ROUTER instead of a "
+                        "recognizer: frames arriving on --source are "
+                        "spread across these replica endpoints (JSONL "
+                        "over TCP, i.e. each replica runs --source "
+                        "socket) by rendezvous-hashing their topic, with "
+                        "health-based failover; results/status fan back "
+                        "to the source. All model/gallery flags are "
+                        "ignored in this mode")
+    p.add_argument("--router-health", metavar="URL[,URL...]",
+                   help="per-replica /health URLs (same order as "
+                        "--router): 503/unreachable marks the replica "
+                        "critical and reroutes its topics. Unset = "
+                        "replicas are assumed healthy")
+    p.add_argument("--router-budget-fps", type=float, default=0.0,
+                   help="per-replica admission budget (frames/s token "
+                        "bucket): an over-budget topic spills to its "
+                        "next-preferred replica instead of overrunning "
+                        "one. 0 = unbudgeted")
+    p.add_argument("--router-writer", type=int, default=0, metavar="IDX",
+                   help="index (into --router) of the replica that owns "
+                        "enrollment: control-topic traffic routes only "
+                        "there")
     p.add_argument("--slo-loop-stale-s", type=float, default=30.0,
                    help="loop-liveness objective bound: seconds without a "
                         "serving-loop iteration before the gauge reads "
@@ -398,8 +443,108 @@ def _load_stack(args):
     return pipeline, names
 
 
+def run_router(args) -> int:
+    """Model-free router mode (``--router``): spread incoming camera
+    topics across replica endpoints with rendezvous hashing + health
+    failover (``runtime.replication.TopicRouter``), fanning results and
+    statuses back to the source. No model, no gallery, no device — the
+    whole process is transport + routing, so it starts in milliseconds
+    and can sit in front of replicas on other hosts."""
+    import signal
+    import threading
+
+    from opencv_facerecognizer_tpu.runtime.connector import (
+        WILDCARD_TOPIC, JSONLConnector, SocketConnector,
+    )
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        RESULT_TOPIC, STATUS_TOPIC,
+    )
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        ReplicaHandle, TopicRouter, http_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    metrics = Metrics()
+    tracer = None
+    if args.flight_dir or args.expo_port is not None:
+        tracer = Tracer(ring_size=args.trace_ring, sample=args.trace_sample,
+                        dump_dir=args.flight_dir, metrics=metrics)
+    endpoints = [e.strip() for e in args.router.split(",") if e.strip()]
+    healths = ([u.strip() or None for u in args.router_health.split(",")]
+               if args.router_health else [None] * len(endpoints))
+    if len(healths) != len(endpoints):
+        raise SystemExit(f"--router-health lists {len(healths)} URLs for "
+                         f"{len(endpoints)} --router endpoints")
+    if not 0 <= args.router_writer < len(endpoints):
+        raise SystemExit(f"--router-writer {args.router_writer} is out of "
+                         f"range for {len(endpoints)} endpoints")
+    replicas = []
+    for i, endpoint in enumerate(endpoints):
+        host, _, port = endpoint.rpartition(":")
+        try:
+            conn = SocketConnector(host=host or "127.0.0.1", port=int(port),
+                                   listen=False, metrics=metrics)
+            conn.start()  # a replica that was never there is a config error
+        except (OSError, ValueError) as exc:
+            raise SystemExit(f"--router endpoint {endpoint!r}: {exc}")
+        replicas.append(ReplicaHandle(
+            endpoint, conn,
+            health_fn=(http_health_probe(healths[i]) if healths[i] else None),
+            budget_fps=args.router_budget_fps or None,
+            writer=i == args.router_writer))
+    router = TopicRouter(replicas, metrics=metrics, tracer=tracer)
+    if args.source == "socket":
+        upstream = SocketConnector(host=args.host, port=args.port,
+                                   listen=True, metrics=metrics)
+    else:
+        upstream = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)
+    upstream.subscribe(WILDCARD_TOPIC,
+                       lambda topic, msg: router.publish(topic, msg))
+    for topic in (RESULT_TOPIC, STATUS_TOPIC):
+        upstream_topic = topic
+        router.subscribe(topic, lambda _t, msg, _up=upstream_topic:
+                         upstream.publish(_up, msg))
+    expo = None
+    if args.expo_port is not None:
+        from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
+
+        expo = ExpoServer(metrics=metrics, tracer=tracer, router=router,
+                          port=args.expo_port)
+        expo.start()
+        print(f"router expo endpoint: http://{expo.host}:{expo.port}/",
+              file=sys.stderr)
+    term_event = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: term_event.set())
+    except ValueError:
+        pass
+    router.start()
+    upstream.start()
+    print(f"routing {len(replicas)} replicas: {', '.join(endpoints)}",
+          file=sys.stderr)
+    try:
+        while not upstream.eof.wait(timeout=0.5):
+            if term_event.is_set():
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if expo is not None:
+            expo.stop()
+        upstream.stop()
+        router.stop()
+        for handle in replicas:
+            handle.connector.stop()
+        print(f"router registry at shutdown: "
+              f"{[r['name'] for r in router.registry()]}", file=sys.stderr)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.router:
+        return run_router(args)
     from opencv_facerecognizer_tpu.runtime.connector import (
         FakeConnector, JSONLConnector, SocketConnector, encode_frame,
     )
@@ -474,7 +619,34 @@ def main(argv=None) -> int:
                if args.dead_letter_journal else None)
 
     state = None
-    if args.state_dir:
+    replica = None
+    lease = None
+    if args.state_dir and args.replica_role == "reader":
+        # Read replica: strictly read-only against the shared state dir —
+        # no lease, no WAL writes, no checkpoints. Initial sync anchors
+        # on the newest checkpoint and replays the WAL tail; the serving
+        # loop then polls for new rows between batches.
+        from opencv_facerecognizer_tpu.runtime.replication import ReadReplica
+
+        replica = ReadReplica(args.state_dir, pipeline.gallery, names,
+                              metrics=metrics, tracer=tracer,
+                              poll_interval_s=args.replica_poll_ms / 1e3)
+        report = replica.resync()
+        print(f"replica initial sync: {report}", file=sys.stderr)
+    elif args.state_dir:
+        # Writer role: exactly one enrollment owner per state dir. The
+        # fcntl lease is taken BEFORE the lifecycle touches anything — a
+        # split-brain second writer must fail closed with zero side
+        # effects on the live writer's WAL/checkpoints.
+        from opencv_facerecognizer_tpu.runtime.replication import (
+            WriterLease, WriterLeaseHeldError,
+        )
+
+        lease = WriterLease(args.state_dir, metrics=metrics)
+        try:
+            lease.acquire()
+        except WriterLeaseHeldError as exc:
+            raise SystemExit(f"ocvf-recognize: {exc}")
         state = StateLifecycle(
             args.state_dir, metrics=metrics,
             keep_checkpoints=args.keep_checkpoints,
@@ -569,7 +741,21 @@ def main(argv=None) -> int:
         cpu_fallback=rebuild_pipeline_on_cpu if args.probe_on_degraded else None,
         tracer=tracer,
         slo_monitor=slo_monitor,
+        replica=replica,
     )
+    if slo_monitor is not None and replica is not None:
+        # Stale-replica brownout: the lag gauge objective rides the same
+        # health verdict the brownout controller already consumes at
+        # critical, so a replica that falls behind sheds bulk serving
+        # load until its tail catches up.
+        from opencv_facerecognizer_tpu.runtime.slo import (
+            replication_lag_objective,
+        )
+
+        short_s, long_s = args.slo_windows
+        slo_monitor.add_objective(replication_lag_objective(
+            replica, rows_bound=args.replication_lag_rows,
+            short_s=short_s, long_s=long_s))
     if slo_monitor is not None and args.slo_loop_stale_s > 0:
         # Registered after construction: the gauge closes over the
         # service, which is built WITH the monitor (runtime.slo
@@ -705,6 +891,11 @@ def main(argv=None) -> int:
             journal.close()
         if span_journal is not None:
             span_journal.close()
+        if lease is not None:
+            # Last: the final checkpoint/WAL truncate above ran under the
+            # lease; releasing it hands enrollment ownership to the next
+            # writer with the state dir already quiesced.
+            lease.release()
         if metrics_sink:
             metrics_sink.close()
     return 0

@@ -23,6 +23,14 @@ from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
 from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
 from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
 from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
+from opencv_facerecognizer_tpu.runtime.replication import (
+    ReadReplica,
+    ReplicaHandle,
+    TopicRouter,
+    WALTailer,
+    WriterLease,
+    WriterLeaseHeldError,
+)
 from opencv_facerecognizer_tpu.runtime.resilience import (
     BrownoutPolicy,
     ResiliencePolicy,
@@ -33,6 +41,7 @@ from opencv_facerecognizer_tpu.runtime.slo import (
     SLOMonitor,
     default_objectives,
     loop_liveness_objective,
+    replication_lag_objective,
 )
 from opencv_facerecognizer_tpu.runtime.state_store import (
     CheckpointStore,
@@ -56,13 +65,20 @@ __all__ = [
     "MiddlewareConnector",
     "PRIORITY_BULK",
     "PRIORITY_INTERACTIVE",
+    "ReadReplica",
     "RecognizerService",
+    "ReplicaHandle",
     "ResiliencePolicy",
+    "TopicRouter",
+    "WALTailer",
+    "WriterLease",
+    "WriterLeaseHeldError",
     "SLO",
     "SLOMonitor",
     "ServiceSupervisor",
     "default_objectives",
     "loop_liveness_objective",
+    "replication_lag_objective",
     "StateLifecycle",
     "TheTrainer",
     "TokenBucket",

@@ -41,6 +41,12 @@ from opencv_facerecognizer_tpu.utils import metric_names as mn
 
 Handler = Callable[[str, Dict[str, Any]], None]
 
+#: subscribe() under this topic receives EVERY message regardless of its
+#: topic (the handler's first argument carries the real one). The
+#: replication topic router forwards arbitrary camera topics wholesale —
+#: without a wildcard it would have to know every topic up front.
+WILDCARD_TOPIC = "*"
+
 
 def encode_frame(frame: np.ndarray) -> Dict[str, Any]:
     frame = np.ascontiguousarray(frame)
@@ -88,6 +94,8 @@ class FakeConnector(MiddlewareConnector):
         with self._lock:
             self.sent.append((topic, message))
             handlers = list(self._handlers.get(topic, ()))
+            if topic != WILDCARD_TOPIC:
+                handlers += list(self._handlers.get(WILDCARD_TOPIC, ()))
         for handler in handlers:
             handler(topic, message)
 
@@ -142,6 +150,8 @@ class _TopicDispatchConnector(MiddlewareConnector):
     def _dispatch(self, topic: str, data: Dict[str, Any]) -> None:
         with self._lock:
             handlers = list(self._handlers.get(topic, ()))
+            if topic != WILDCARD_TOPIC:
+                handlers += list(self._handlers.get(WILDCARD_TOPIC, ()))
         for handler in handlers:
             handler(topic, data)
 

@@ -37,6 +37,11 @@ path                    payload
                         values beyond ``SPAN_LIMIT_MAX`` are clamped)
 ``/attribution``        stage-attribution gauges, refreshed on read (see
                         ``fold_attribution``)
+``/replicas``           the topic router's replica registry
+                        (``runtime.replication.TopicRouter.registry``):
+                        per-replica health, routed counts, observed topic
+                        assignment; ``{"replicas": null}`` when no router
+                        is wired
 ======================  =====================================================
 
 **Read-only contract**: every verb except GET is answered ``405 Method Not
@@ -180,7 +185,7 @@ class ExpoServer:
                  host: str = "127.0.0.1", port: int = 0,
                  refresh_s: float = 2.0,
                  bench_path: str = DEFAULT_BENCH_PATH,
-                 slo=None):
+                 slo=None, router=None):
         self.service = service
         self.tracer = tracer if tracer is not None else getattr(
             service, "tracer", None)
@@ -191,6 +196,11 @@ class ExpoServer:
         #: when the serving loop (its primary ticker) is wedged — which is
         #: exactly when an orchestrator polls /health hardest.
         self.slo = slo if slo is not None else getattr(service, "slo", None)
+        #: optional runtime.replication.TopicRouter behind ``/replicas``:
+        #: the replica registry (health, routed counts, observed topic
+        #: assignment) as a read-only snapshot — what an orchestrator
+        #: polls to see where failover moved the traffic.
+        self.router = router
         self.refresh_s = float(refresh_s)
         self.bench_path = bench_path
         self._started_t = time.monotonic()
@@ -283,7 +293,8 @@ class ExpoServer:
         if path in ("/", "/index"):
             return {
                 "endpoints": ["/", "/metrics", "/prom", "/health", "/ledger",
-                              "/brownout", "/spans", "/attribution"],
+                              "/brownout", "/spans", "/attribution",
+                              "/replicas"],
                 "uptime_s": round(time.monotonic() - self._started_t, 1),
                 "brownout_level": getattr(service, "brownout_level", None),
                 "health": (self.slo.state if self.slo is not None else None),
@@ -310,6 +321,12 @@ class ExpoServer:
         if path == "/attribution":
             return fold_attribution(self.tracer, self.metrics,
                                     bench_path=self.bench_path)
+        if path == "/replicas":
+            # Same unwired shape as /health: a null payload with a
+            # pointer, never a 404 — the path is part of the contract.
+            if self.router is None:
+                return {"replicas": None, "detail": "no topic router wired"}
+            return {"replicas": self.router.registry()}
         raise KeyError(path)
 
     @staticmethod

@@ -186,6 +186,43 @@ def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,
     return pipeline, service, connector
 
 
+def build_replica_fleet(n_replicas: int, frame_shape=(32, 32),
+                        batch_size: int = 8, dispatch_s: float = 0.04,
+                        health_interval_s: float = 0.1,
+                        budget_fps=None, router_metrics=None,
+                        tracer=None):
+    """N in-process serving replicas behind one ``TopicRouter`` — the
+    deterministic scale-out harness: each replica is the canonical
+    overload stack (``build_overload_stack``: a hard ``batch_size /
+    dispatch_s`` frames/s capacity wall with admission/brownout armed)
+    with its OWN ``Metrics``, and the router spreads camera topics across
+    them with rendezvous hashing + in-process health probes. Shared by
+    ``bench_serving.run_replica_scaleout`` and the replication chaos
+    scenario, so the bench ladder and the soak's failover assertions
+    exercise one configuration. Returns ``(router, stacks)`` where each
+    stack is ``(pipeline, service, connector, metrics)``."""
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        ReplicaHandle, TopicRouter, service_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    stacks = []
+    handles = []
+    for i in range(n_replicas):
+        metrics = Metrics()
+        pipeline, service, connector = build_overload_stack(
+            frame_shape=frame_shape, batch_size=batch_size,
+            dispatch_s=dispatch_s, metrics=metrics)
+        stacks.append((pipeline, service, connector, metrics))
+        handles.append(ReplicaHandle(
+            f"replica-{i}", connector,
+            health_fn=service_health_probe(service),
+            budget_fps=budget_fps))
+    router = TopicRouter(handles, metrics=router_metrics, tracer=tracer,
+                         health_interval_s=health_interval_s)
+    return router, stacks
+
+
 class TrafficRecorder:
     """Seq-tagged send/receive recorder for driving a service under
     offered load: stamps each frame at offer time, collects its result

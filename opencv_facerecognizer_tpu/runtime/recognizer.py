@@ -279,6 +279,14 @@ class RecognizerService:
         # critical — one extra level of brownout intake pressure. None =
         # no SLO evaluation (zero overhead).
         slo_monitor=None,
+        # Read-replica role (runtime.replication.ReadReplica): the serving
+        # loop polls the shared WAL between batches and applies new
+        # enrollment rows through the same gallery.add route replay uses.
+        # A service with a replica is read-only for enrollment — enroll
+        # commands are rejected with an explicit status (the writer lease
+        # in the shared state dir owns the write path). None = this
+        # process owns its own state (the pre-replication behavior).
+        replica=None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -316,6 +324,7 @@ class RecognizerService:
         self._reject_lock = threading.Lock()
         self.tracer = tracer
         self.slo = slo_monitor
+        self.replica = replica
         # Serving-loop progress stamp, refreshed every loop iteration
         # (batch AND idle — get_batch's flush timeout guarantees regular
         # iterations even with zero traffic). Read by the loop_liveness
@@ -726,6 +735,18 @@ class RecognizerService:
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
         cmd = message.get("cmd")
+        if cmd == "enroll" and self.replica is not None:
+            # Read replicas fail enrollment closed: the writer lease owns
+            # the WAL, and a reader mutating its local gallery outside the
+            # replication stream would permanently fork it from the
+            # writer's history.
+            self.metrics.incr(mn.REPLICATION_ENROLL_REJECTED)
+            self._publish_status({"status": "rejected",
+                                  "reason": "read_replica",
+                                  "detail": "enrollment is writer-only; "
+                                            "route enroll to the writer "
+                                            "replica"})
+            return
         if cmd == "enroll":
             name = str(message.get("subject", f"subject_{len(self.subject_names)}"))
             count = int(message.get("count", 5))
@@ -934,6 +955,19 @@ class RecognizerService:
             # when traffic stops — recovery is part of the signal.
             if self.slo is not None:
                 self.slo.tick()
+            # Read-replica tick: tail the shared WAL and apply new rows
+            # between batches (interval-gated inside poll; the non-due
+            # path is one clock read). A poll failure (disk blip on the
+            # shared dir) must cost this poll, never the serving loop —
+            # the lag gauges and SLO objective surface a replica that
+            # stops advancing.
+            if self.replica is not None:
+                try:
+                    self.replica.poll()
+                except Exception:  # noqa: BLE001 — replication must not kill serving
+                    logging.getLogger(__name__).exception(
+                        "read-replica WAL poll failed")
+                    self.metrics.incr(mn.REPLICATION_POLL_ERRORS)
             if batch is None:
                 if not self._running:
                     break

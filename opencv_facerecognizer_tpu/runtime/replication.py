@@ -1,0 +1,925 @@
+"""Multi-replica serving: single-writer / N-reader replication over the
+PR-4 state lifecycle, plus a health-routed topic router (ROADMAP #4 —
+"the refactor that unlocks millions of users").
+
+Everything before this module is one process. The durable state layer
+(``runtime.state_store``) already made the gallery *shared state in
+waiting*: enrollment is an always-fsync write-ahead log (ack == durable)
+and checkpoints are atomic, checksummed, and stamped with the WAL
+sequence they cover. This module adds the replication protocol that lets
+N recognizer replicas serve that one logical gallery:
+
+- **WriterLease** — an fcntl ``flock`` lockfile (``<state-dir>/
+  writer.lease``) serializing enrollment ownership. Exactly one process
+  may hold it; a second writer **fails closed** at startup
+  (``WriterLeaseHeldError``) instead of silently interleaving WAL
+  appends — flock conflicts across processes AND across file
+  descriptors within one process, and the kernel releases it on any
+  death, so a crashed writer never needs a lease-breaking tool. The
+  file's JSON contents (pid/host/ts) are diagnostics only; the flock is
+  the truth.
+- **WALTailer** — a strictly read-only incremental reader of the
+  enrollment WAL. It advances only past complete (newline-terminated)
+  lines, so a writer's in-progress append is never half-read; an
+  unparseable line (a torn tail the writer later sealed) is skipped
+  exactly as replay skips it. Compaction (``truncate_below`` atomically
+  swaps in a rewritten file) is detected by inode change / size
+  shrinkage on the **open fd** (stat-then-open would race the swap) and
+  answered by re-reading from offset zero — row-level dedup is the
+  consumer's job, keyed on the monotonic ``seq``.
+- **ReadReplica** — the tailer composed with a live gallery: initial
+  sync loads the newest readable checkpoint (read-only — corrupt files
+  are skipped and counted, never quarantined: renames belong to the
+  writer) and anchors ``applied_seq`` at its published ``wal_seq``, then
+  every ``poll()`` applies new WAL rows through the same
+  ``ShardedGallery.add`` route WAL replay uses (IVF incremental
+  assignment and epoch fencing ride along unchanged). A WAL reopen
+  whose newest checkpoint has advanced past ``applied_seq`` re-anchors
+  via a full resync; an abort tombstone arriving *after* its enroll was
+  applied (the writer rolled back a failed apply) also forces a resync —
+  a replica must never serve rows the writer's gallery never kept.
+  ``replication_lag_rows`` / ``replication_lag_s`` gauges feed the SLO
+  monitor (``runtime.slo.replication_lag_objective``) so a stale
+  replica's brownout composes with the existing controller.
+- **TopicRouter** — a ``MiddlewareConnector`` that spreads camera
+  topics across replicas with rendezvous (highest-random-weight)
+  hashing: each topic hashes to a stable preference order over replica
+  names, so adding/removing a replica only moves the topics that hashed
+  to it. Per-replica admission budgets (token buckets) spill an
+  over-budget topic to its next-preferred replica; health-based
+  failover (each replica's PR-9 ``/health`` verdict, via an in-process
+  probe or HTTP) excludes critical replicas from routing — rendezvous
+  re-routes their topics automatically, the flight recorder fires, and
+  recovery reinstates them. Results/status from every replica fan back
+  in to the router's own subscribers.
+
+Consistency contract: a read replica serves a *prefix* of the
+acknowledged enrollment history — every row it holds was fsync-durable
+on the writer before the replica applied it, and once its lag reaches
+zero it holds exactly the acknowledged history (the replication chaos
+scenario asserts bit-equal rows across writer death and replica death).
+Staleness is bounded by the poll interval plus WAL append visibility;
+it is surfaced, never hidden (the lag gauges + SLO objective).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.runtime.admission import TokenBucket
+from opencv_facerecognizer_tpu.runtime.connector import MiddlewareConnector
+from opencv_facerecognizer_tpu.runtime.state_store import (
+    CheckpointCorruptError,
+    CheckpointVersionError,
+    StateLifecycle,
+    _decode_checkpoint,
+    decode_enroll_record,
+    read_checkpoint_header,
+    scan_checkpoint_files,
+)
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.tracing import LIFECYCLE_TOPIC
+
+LEASE_NAME = "writer.lease"
+
+logger = logging.getLogger(__name__)
+
+
+class WriterLeaseHeldError(RuntimeError):
+    """Another process holds the writer lease — the second writer MUST
+    fail closed (split-brain WAL appends would interleave sequences and
+    silently corrupt every replica's replay)."""
+
+
+class WriterLease:
+    """Exclusive enrollment-ownership lease over one ``--state-dir``
+    (module docstring). ``acquire`` is non-blocking by design: a blocked
+    writer waiting for a lease it may never get is indistinguishable
+    from a hang — the operator should see the conflict immediately."""
+
+    def __init__(self, state_dir: str, metrics=None):
+        self.state_dir = str(state_dir)
+        self.path = os.path.join(self.state_dir, LEASE_NAME)
+        self.metrics = metrics
+        self._fd: Optional[int] = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self) -> "WriterLease":
+        """Take the lease or raise ``WriterLeaseHeldError``. Idempotent
+        while held. The holder info is written AFTER the flock wins —
+        never clobber a live holder's diagnostics with a loser's."""
+        if self._fd is not None:
+            return self
+        os.makedirs(self.state_dir, exist_ok=True)
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            holder = ""
+            try:
+                holder = os.read(fd, 4096).decode("utf-8", "replace").strip()
+            except OSError:
+                pass
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+            if self.metrics is not None:
+                self.metrics.incr(mn.REPLICATION_LEASE_CONFLICTS)
+            raise WriterLeaseHeldError(
+                f"writer lease {self.path} is held"
+                + (f" (holder: {holder})" if holder else "")
+                + " — refusing to start a second writer (split-brain "
+                "fails closed)")
+        info = {"pid": os.getpid(), "host": socket.gethostname(),
+                "acquired_ts": time.time()}
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, (json.dumps(info) + "\n").encode("utf-8"))
+            os.fsync(fd)
+        except OSError:
+            # Diagnostics only — the flock (already won) is the guard.
+            logger.exception("writer lease holder info write failed")
+        self._fd = fd
+        if self.metrics is not None:
+            self.metrics.incr(mn.REPLICATION_LEASE_ACQUIRED)
+        return self
+
+    def release(self) -> None:
+        """Drop the lease. The file stays behind (its contents are stale
+        diagnostics) — the flock vanishes with the fd, which is also what
+        happens automatically when the holding process dies."""
+        fd, self._fd = self._fd, None
+        if fd is None:
+            return
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        try:
+            os.close(fd)
+        except OSError:
+            pass
+
+    close = release
+
+    def __enter__(self) -> "WriterLease":
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class WALTailer:
+    """Strictly read-only incremental reader of one WAL file (module
+    docstring). Single-consumer by design — ``poll`` runs on the owning
+    replica's serving-loop thread (or the verifier's main thread), so it
+    needs no lock and never holds one across file I/O."""
+
+    def __init__(self, path: str, metrics=None):
+        self.path = str(path)
+        self.metrics = metrics
+        self._offset = 0
+        self._inode: Optional[int] = None
+        self.reopens = 0
+        self.malformed_lines = 0
+
+    def reset(self) -> None:
+        """Forget the read position — the next ``poll`` re-reads the file
+        from the beginning (resync path; dedup is the consumer's job)."""
+        self._offset = 0
+        self._inode = None
+
+    def poll(self) -> Tuple[List[Dict[str, Any]], Dict[str, Any]]:
+        """Read every COMPLETE line appended since the last poll; returns
+        ``(records, info)`` where records are the parsed JSON objects in
+        file order and ``info`` flags ``reopened`` (compaction swapped a
+        new file in — earlier rows may have been truncated away) and
+        ``partial`` (an in-progress append is pending past the offset).
+        Unparseable / non-object lines (torn remnants) are skipped and
+        counted, exactly like replay."""
+        info: Dict[str, Any] = {"reopened": False, "partial": False}
+        try:
+            fd = os.open(self.path, os.O_RDONLY)
+        except FileNotFoundError:
+            info["missing"] = True
+            return [], info
+        except OSError:
+            if self.metrics is not None:
+                self.metrics.incr(mn.REPLICATION_POLL_ERRORS)
+            info["error"] = True
+            return [], info
+        try:
+            st = os.fstat(fd)
+            if (self._inode is not None
+                    and (st.st_ino != self._inode
+                         or st.st_size < self._offset)):
+                # truncate_below installed a rewritten file (new inode),
+                # or the file shrank under us: restart from zero — the
+                # consumer dedups by seq.
+                self._offset = 0
+                self.reopens += 1
+                info["reopened"] = True
+                if self.metrics is not None:
+                    self.metrics.incr(mn.REPLICATION_WAL_REOPENS)
+            self._inode = st.st_ino
+            if st.st_size <= self._offset:
+                return [], info
+            os.lseek(fd, self._offset, os.SEEK_SET)
+            chunks = []
+            while True:
+                chunk = os.read(fd, 1 << 20)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+            blob = b"".join(chunks)
+        finally:
+            os.close(fd)
+        nl = blob.rfind(b"\n")
+        if nl < 0:
+            # A single in-progress append with no newline yet: the writer
+            # is mid-write (or crashed torn — the seal at its next open
+            # will terminate it). Never advance past incomplete bytes.
+            info["partial"] = True
+            return [], info
+        self._offset += nl + 1
+        if nl + 1 < len(blob):
+            info["partial"] = True
+        records: List[Dict[str, Any]] = []
+        for line in blob[:nl].split(b"\n"):
+            text = line.strip()
+            if not text:
+                continue
+            try:
+                record = json.loads(text.decode("utf-8", errors="replace"))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                record = None
+            if not isinstance(record, dict):
+                # Sealed torn remnant (an unacknowledged crash leftover),
+                # skipped exactly as replay skips it.
+                self.malformed_lines += 1
+                continue
+            records.append(record)
+        return records, info
+
+
+def load_latest_checkpoint_readonly(ckpt_dir: str, metrics=None):
+    """Newest fully-verified checkpoint as ``(header, state_dict, path)``
+    or None — the read replica's sibling of
+    ``CheckpointStore.load_latest`` that NEVER mutates the directory:
+    corrupt files are logged + counted and skipped (quarantine renames
+    belong to the writer; a reader racing a writer's in-progress rename
+    must not move files under it)."""
+    from flax import serialization as flax_serialization
+
+    for _seq, path in scan_checkpoint_files(ckpt_dir):
+        try:
+            with open(path, "rb") as fh:
+                header, payload = _decode_checkpoint(fh.read(), path)
+            state = flax_serialization.msgpack_restore(payload)
+            emb = np.asarray(state["emb"], np.float32)
+            lab = np.asarray(state["lab"], np.int32)
+            val = np.asarray(state["val"], bool)
+        except CheckpointVersionError as exc:
+            logger.warning("replica: newer-format checkpoint skipped: %s",
+                           exc)
+            continue
+        except (OSError, CheckpointCorruptError, KeyError, TypeError,
+                ValueError) as exc:
+            logger.warning("replica: unreadable checkpoint skipped "
+                           "(read-only, not quarantined): %s: %r", path, exc)
+            if metrics is not None:
+                metrics.incr(mn.CHECKPOINTS_CORRUPT)
+            continue
+        return header, {"emb": emb, "lab": lab, "val": val}, path
+    return None
+
+
+def newest_checkpoint_wal_seq(ckpt_dir: str) -> int:
+    """The published ``wal_seq`` of the newest header-verified checkpoint
+    (0 when none): the re-anchor point a replica compares its
+    ``applied_seq`` against after every WAL compaction. Header-only reads
+    — a few KB per file, never the payload."""
+    for _seq, path in scan_checkpoint_files(ckpt_dir):
+        try:
+            header = read_checkpoint_header(path)
+        except (OSError, CheckpointCorruptError, CheckpointVersionError):
+            continue
+        return int(header.get("meta", {}).get("wal_seq", 0))
+    return 0
+
+
+class ReadReplica:
+    """One read replica's view of a shared ``--state-dir`` (module
+    docstring): checkpoint anchor + WAL tail applied into a live gallery
+    between batches. Single-threaded by contract — ``poll()`` runs on the
+    owning serving loop (``RecognizerService(replica=...)`` ticks it), so
+    gallery application interleaves with dispatch exactly like the
+    writer's own enrolment applies do."""
+
+    def __init__(self, state_dir: str, gallery, subject_names: Optional[list] = None,
+                 metrics=None, tracer=None, poll_interval_s: float = 0.05,
+                 name: str = "replica"):
+        self.state_dir = str(state_dir)
+        self.wal_path = os.path.join(self.state_dir, "enroll.wal")
+        self.ckpt_dir = os.path.join(self.state_dir, "checkpoints")
+        self.gallery = gallery
+        self.subject_names = subject_names if subject_names is not None else []
+        self.metrics = metrics
+        self.tracer = tracer
+        self.poll_interval_s = float(poll_interval_s)
+        self.name = str(name)
+        self.tailer = WALTailer(self.wal_path, metrics=metrics)
+        #: highest WAL seq applied to (or covered by the checkpoint under)
+        #: the local gallery.
+        self.applied_seq = 0
+        #: highest WAL seq OBSERVED in the file (applied or not) — the
+        #: lag_rows numerator.
+        self.seen_seq = 0
+        self.anchor_checkpoint: Optional[str] = None
+        self.lag_rows = 0
+        self.lag_s = 0.0
+        self._synced = False
+        self._resync_needed = False
+        self._last_poll_t = 0.0
+        #: the wal_seq the last resync anchored at, and the abort seqs
+        #: already accounted for: a compaction reopen re-reads the whole
+        #: file, so surviving tombstones for rows this replica only ever
+        #: BURNED (never applied) come around again — without these two
+        #: filters every such re-read would force a needless full resync
+        #: (checkpoint reload on the serving thread) and a false
+        #: aborts_after_apply count.
+        self._anchor_seq = 0
+        self._aborted_seen: set = set()
+
+    # ---- sync ----
+
+    def resync(self) -> Dict[str, Any]:
+        """Full re-anchor: newest readable checkpoint -> ``load_snapshot``
+        (or an empty gallery when none exists yet), ``applied_seq`` = its
+        published ``wal_seq``, then one full WAL read applying every
+        surviving row past the anchor — abort tombstones are honored
+        across the whole file here, exactly like writer-side replay."""
+        report = {"checkpoint": None, "applied_records": 0,
+                  "applied_rows": 0}
+        loaded = load_latest_checkpoint_readonly(self.ckpt_dir,
+                                                 metrics=self.metrics)
+        if loaded is not None:
+            header, state, path = loaded
+            meta = header.get("meta", {})
+            dim = int(meta.get("dim", -1))
+            if dim != self.gallery.dim:
+                raise ValueError(
+                    f"replica {self.name}: state dir {self.state_dir!r} "
+                    f"holds dim={dim} checkpoints but the gallery is "
+                    f"dim={self.gallery.dim} — wrong --state-dir for this "
+                    f"model?")
+            size = int(meta.get("size", int(state["val"].sum())))
+            self.gallery.load_snapshot(state["emb"], state["lab"],
+                                       state["val"], size)
+            self.subject_names[:] = [str(s) for s
+                                     in meta.get("subject_names", [])]
+            self.applied_seq = int(meta.get("wal_seq", 0))
+            self.anchor_checkpoint = path
+            report["checkpoint"] = path
+        else:
+            # No checkpoint yet (a brand-new writer): replay the whole
+            # WAL onto an empty gallery.
+            if self.gallery.size:
+                self.gallery.reset()
+            self.subject_names[:] = []
+            self.applied_seq = 0
+            self.anchor_checkpoint = None
+        self.seen_seq = self.applied_seq
+        self._anchor_seq = self.applied_seq
+        self._aborted_seen.clear()
+        self.tailer.reset()
+        records, _info = self.tailer.poll()
+        applied = self._apply_records(records)
+        report["applied_records"] = applied["records"]
+        report["applied_rows"] = applied["rows"]
+        self._synced = True
+        self._resync_needed = False
+        self._update_lag()
+        if self.metrics is not None:
+            self.metrics.incr(mn.REPLICATION_RESYNCS)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "wal_tail",
+                             topic=LIFECYCLE_TOPIC, replica=self.name,
+                             resync=True, applied_seq=self.applied_seq,
+                             rows=applied["rows"],
+                             checkpoint=report["checkpoint"])
+        return report
+
+    # ---- the tail loop ----
+
+    def poll(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        """Apply whatever the WAL grew since the last poll (interval-
+        gated; ``force`` bypasses the gate). Called by the serving loop
+        between batches; the non-due path is one clock read. Returns the
+        poll summary, or None when not due."""
+        now = time.monotonic()
+        if not force and now - self._last_poll_t < self.poll_interval_s:
+            return None
+        self._last_poll_t = now
+        if self.metrics is not None:
+            self.metrics.incr(mn.REPLICATION_POLLS)
+        if not self._synced or self._resync_needed:
+            return self.resync()
+        records, info = self.tailer.poll()
+        if info["reopened"]:
+            # Compaction: rows <= the newest checkpoint's wal_seq were
+            # truncated away. If that anchor has moved past what we
+            # applied, the truncated rows are ones we never saw — only
+            # the checkpoint still has them. Re-anchor fully.
+            anchor = newest_checkpoint_wal_seq(self.ckpt_dir)
+            if anchor > self.applied_seq:
+                return self.resync()
+        applied = self._apply_records(records)
+        if self._resync_needed:
+            # An abort tombstone arrived for a row we already applied:
+            # the local gallery holds rows the writer rolled back. Rebuild
+            # from the checkpoint rather than serve phantoms.
+            return self.resync()
+        self._update_lag()
+        if applied["rows"] and self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "wal_tail",
+                             topic=LIFECYCLE_TOPIC, replica=self.name,
+                             resync=False, rows=applied["rows"],
+                             records=applied["records"],
+                             applied_seq=self.applied_seq,
+                             lag_s=round(self.lag_s, 4))
+        return applied
+
+    def _apply_records(self, records: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Apply one poll batch in file order with batch-local abort
+        filtering (the writer appends an abort right after a failed
+        apply, so enroll+abort almost always land in one read). An abort
+        whose enroll was applied in an EARLIER poll flags a resync."""
+        applied_at_entry = self.applied_seq
+        aborted = set()
+        for record in records:
+            seq = record.get("seq")
+            if record.get("kind") == "abort" and isinstance(seq, (int, float)):
+                seq = int(seq)
+                aborted.add(seq)
+                # "After apply" only when this tombstone is genuinely NEW
+                # (not a compaction-reopen replay of one we already
+                # burned/handled) and not covered by the resync anchor
+                # (the checkpoint never held the aborted row). Only then
+                # may the local gallery hold a row the writer rolled
+                # back, and only then is a resync warranted.
+                if (seq <= applied_at_entry and seq > self._anchor_seq
+                        and seq not in self._aborted_seen):
+                    logger.warning(
+                        "replica %s: abort for already-applied seq %d — "
+                        "scheduling resync", self.name, seq)
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.REPLICATION_ABORTS_AFTER_APPLY)
+                    self._resync_needed = True
+                self._aborted_seen.add(seq)
+                if len(self._aborted_seen) > 1 << 16:
+                    # Pathological abort volume: resync rather than grow
+                    # the dedup set unboundedly (the anchor advances, so
+                    # the set restarts empty and covered tombstones stop
+                    # mattering).
+                    self._resync_needed = True
+        out = {"records": 0, "rows": 0}
+        oldest_applied_ts: Optional[float] = None
+        for record in records:
+            seq = record.get("seq")
+            if isinstance(seq, (int, float)):
+                self.seen_seq = max(self.seen_seq, int(seq))
+            if record.get("kind") != "enroll" or not isinstance(
+                    seq, (int, float)):
+                continue
+            seq = int(seq)
+            if seq <= self.applied_seq:
+                continue  # dedup: already applied or checkpoint-covered
+            if seq in aborted:
+                self.applied_seq = seq  # tombstoned: burn it, apply nothing
+                continue
+            decoded = decode_enroll_record(record)
+            if decoded is None:
+                # A parseable record failing crc/base64 was acknowledged
+                # and is now unreadable — count it loudly; the row cannot
+                # be applied (real loss is the verifier's verdict).
+                if self.metrics is not None:
+                    self.metrics.incr(mn.REPLICATION_CORRUPT_RECORDS)
+                logger.error("replica %s: corrupt acked WAL record seq %d",
+                             self.name, seq)
+                self.applied_seq = seq
+                continue
+            self.gallery.add(decoded["embeddings"], decoded["labels_np"])
+            StateLifecycle._grow_names(self.subject_names, decoded)
+            self.applied_seq = seq
+            out["records"] += 1
+            out["rows"] += int(decoded["n"])
+            ts = record.get("ts")
+            if isinstance(ts, (int, float)) and oldest_applied_ts is None:
+                oldest_applied_ts = float(ts)
+        if out["rows"]:
+            if self.metrics is not None:
+                self.metrics.incr(mn.REPLICATION_RECORDS_APPLIED,
+                                  out["records"])
+                self.metrics.incr(mn.REPLICATION_ROWS_APPLIED, out["rows"])
+            if oldest_applied_ts is not None:
+                # Age of the oldest row at the moment it became visible
+                # here: the honest staleness sample (0 once caught up).
+                self.lag_s = max(0.0, time.time() - oldest_applied_ts)
+        else:
+            self.lag_s = 0.0
+        return out
+
+    def _update_lag(self) -> None:
+        self.lag_rows = max(0, self.seen_seq - self.applied_seq)
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.REPLICATION_LAG_ROWS, self.lag_rows)
+            self.metrics.set_gauge(mn.REPLICATION_LAG_S,
+                                   round(self.lag_s, 4))
+
+    def stats(self) -> Dict[str, Any]:
+        return {"name": self.name, "applied_seq": self.applied_seq,
+                "seen_seq": self.seen_seq, "lag_rows": self.lag_rows,
+                "lag_s": round(self.lag_s, 4),
+                "wal_reopens": self.tailer.reopens,
+                "anchor_checkpoint": self.anchor_checkpoint,
+                "gallery_size": int(self.gallery.size)}
+
+
+# ---- health probes ---------------------------------------------------------
+
+
+def service_health_probe(service) -> Callable[[], int]:
+    """In-process health: critical when the service stopped or crashed,
+    else the SLO monitor's state code (ok when no monitor is wired) —
+    the same verdict ``/health`` serves, read without HTTP."""
+    from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL, STATE_OK
+
+    def probe() -> int:
+        if service.loop_crashed or not service._running:
+            return STATE_CRITICAL
+        monitor = getattr(service, "slo", None)
+        return monitor.state_code if monitor is not None else STATE_OK
+
+    return probe
+
+
+def http_health_probe(url: str, timeout_s: float = 2.0) -> Callable[[], int]:
+    """Probe a replica's PR-9 ``GET /health`` endpoint: 503 reads as
+    critical (the endpoint's contract — load balancers key on the status
+    alone), 200 reads the JSON ``state_code`` (ok when absent). Any other
+    failure raises — the router counts it and fails the replica closed."""
+    import urllib.error
+    import urllib.request
+
+    def probe() -> int:
+        from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL
+
+        try:
+            with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+                body = resp.read(1 << 16)
+        except urllib.error.HTTPError as exc:
+            if exc.code == 503:
+                return STATE_CRITICAL
+            raise
+        try:
+            return int(json.loads(body.decode("utf-8")).get("state_code", 0))
+        except (json.JSONDecodeError, UnicodeDecodeError, TypeError,
+                ValueError, AttributeError):
+            return 0  # 200 with an unparseable body: reachable == serving
+
+    return probe
+
+
+# ---- the topic router ------------------------------------------------------
+
+
+class ReplicaHandle:
+    """One routable replica: a connector to reach it, an optional health
+    probe (callable returning a ``runtime.slo`` state code; raising reads
+    as down), and an optional per-replica admission budget (frames/s
+    token bucket — over-budget topics spill to their next-preferred
+    replica instead of overrunning this one)."""
+
+    def __init__(self, name: str, connector: MiddlewareConnector,
+                 health_fn: Optional[Callable[[], int]] = None,
+                 budget_fps: Optional[float] = None,
+                 budget_burst_s: float = 1.0, writer: bool = False):
+        self.name = str(name)
+        self.connector = connector
+        self.health_fn = health_fn
+        self.budget = (TokenBucket(float(budget_fps),
+                                   float(budget_fps) * float(budget_burst_s))
+                       if budget_fps else None)
+        self.budget_fps = budget_fps
+        #: enrollment owner: control-topic traffic routes here only.
+        self.writer = bool(writer)
+        self.healthy = True
+        self.health_state = 0
+        self.routed = 0
+        self.last_probe_error: Optional[str] = None
+
+
+class TopicRouter(MiddlewareConnector):
+    """Rendezvous-hashing topic router over N replicas (module
+    docstring). Producers ``publish(<camera topic>, frame_msg)`` into the
+    router; each topic forwards to its chosen replica's ``FRAME_TOPIC``.
+    Results and statuses from every replica fan back in to the router's
+    own subscribers (status messages gain a ``replica`` field).
+
+    Health checking runs on a dedicated daemon thread (probes may be
+    HTTP — they must never block a producer's publish); the routing path
+    only reads the per-replica ``healthy`` flags. A replica turning
+    critical is a **failover**: counted, spanned (``failover``), flight-
+    recorder dumped, and excluded from rendezvous until it recovers —
+    nothing is queued in the router itself, so "drain + reroute" is
+    simply the next frame hashing elsewhere while the replica's own
+    supervisor/restart rung (unchanged) nurses it back.
+    """
+
+    def __init__(self, replicas: List[ReplicaHandle], metrics=None,
+                 tracer=None, health_interval_s: float = 1.0):
+        from opencv_facerecognizer_tpu.runtime.recognizer import (
+            CONTROL_TOPIC, FRAME_TOPIC, RESULT_TOPIC, STATUS_TOPIC,
+        )
+
+        self.metrics = metrics
+        self.tracer = tracer
+        self.health_interval_s = float(health_interval_s)
+        self.frame_topic = FRAME_TOPIC
+        self.control_topic = CONTROL_TOPIC
+        self.status_topic = STATUS_TOPIC
+        self._result_topics = (RESULT_TOPIC, STATUS_TOPIC)
+        self._lock = threading.Lock()
+        self._replicas: List[ReplicaHandle] = list(replicas)
+        self._handlers: Dict[str, List] = {}
+        #: topic -> (replica name, last routed monotonic t): the observed
+        #: assignment map behind ``GET /replicas`` (bounded, best-effort).
+        self._topic_map: Dict[str, Tuple[str, float]] = {}
+        self._topic_map_max = 4096
+        self._order_cache: Dict[str, List[ReplicaHandle]] = {}
+        self._health_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        for handle in self._replicas:
+            self._wire_replica(handle)
+        self._set_replica_gauges()
+
+    # ---- registry ----
+
+    def _wire_replica(self, handle: ReplicaHandle) -> None:
+        for topic in self._result_topics:
+            handle.connector.subscribe(
+                topic, self._make_fan_in(topic, handle.name))
+
+    def _make_fan_in(self, topic: str, name: str):
+        # Status messages are stamped with the originating replica (an
+        # orchestrator needs to know WHICH replica went degraded); result
+        # messages pass through untouched — keyed on the subscription
+        # topic, never sniffed from the payload.
+        stamp = topic == self.status_topic
+
+        def fan_in(_topic, message, _name=name, _up=topic, _stamp=stamp):
+            if _stamp and isinstance(message, dict):
+                message = {**message, "replica": _name}
+            self._dispatch_up(_up, message)
+
+        return fan_in
+
+    def replace_connector(self, name: str,
+                          connector: MiddlewareConnector) -> None:
+        """Point one replica at a fresh connector — the restarted-process
+        case: the replica came back at a new address/connector, keeping
+        its name (so rendezvous hands it exactly its old topics). Fan-in
+        handlers are re-subscribed on the new connector; without that,
+        results from the restarted replica would publish into a connector
+        nobody listens to and silently vanish. The old connector's
+        subscriptions are left behind on the dead object (harmless —
+        nothing publishes into it again). Raises ``KeyError`` on an
+        unknown name."""
+        with self._lock:
+            handle = next((r for r in self._replicas if r.name == name),
+                          None)
+        if handle is None:
+            raise KeyError(f"no replica named {name!r}")
+        handle.connector = connector
+        self._wire_replica(handle)
+
+    def _dispatch_up(self, topic: str, message: Dict[str, Any]) -> None:
+        with self._lock:
+            handlers = list(self._handlers.get(topic, ()))
+        for handler in handlers:
+            handler(topic, message)
+
+    def subscribe(self, topic: str, handler) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(handler)
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas)
+
+    def registry(self) -> List[Dict[str, Any]]:
+        """Snapshot for ``GET /replicas``: per-replica health, routing
+        stats and the recently-observed topic assignment."""
+        from opencv_facerecognizer_tpu.runtime.slo import STATE_NAMES
+
+        with self._lock:
+            handles = list(self._replicas)
+            topic_map = dict(self._topic_map)
+        by_name: Dict[str, List[str]] = {}
+        for topic, (name, _t) in topic_map.items():
+            by_name.setdefault(name, []).append(topic)
+        out = []
+        for handle in handles:
+            out.append({
+                "name": handle.name,
+                "writer": handle.writer,
+                "healthy": handle.healthy,
+                "health_state": STATE_NAMES[min(handle.health_state,
+                                                len(STATE_NAMES) - 1)],
+                "routed": handle.routed,
+                "budget_fps": handle.budget_fps,
+                "topics": sorted(by_name.get(handle.name, ())),
+                "probe_error": handle.last_probe_error,
+            })
+        return out
+
+    def _set_replica_gauges(self) -> None:
+        if self.metrics is None:
+            return
+        with self._lock:
+            total = len(self._replicas)
+            healthy = sum(1 for r in self._replicas if r.healthy)
+        self.metrics.set_gauge(mn.ROUTER_REPLICAS, total)
+        self.metrics.set_gauge(mn.ROUTER_HEALTHY_REPLICAS, healthy)
+
+    # ---- rendezvous routing ----
+
+    @staticmethod
+    def _weight(topic: str, name: str) -> int:
+        import hashlib
+
+        digest = hashlib.blake2b(f"{topic}\x00{name}".encode("utf-8"),
+                                 digest_size=8).digest()
+        return int.from_bytes(digest, "big")
+
+    def _preference_order(self, topic: str) -> List[ReplicaHandle]:
+        """Stable highest-random-weight order of ALL replicas for one
+        topic (health filtering happens at route time, so a recovered
+        replica reclaims exactly its own topics). Cached per topic,
+        bounded; the replica set is fixed at construction, so cached
+        orders never go stale."""
+        with self._lock:
+            order = self._order_cache.get(topic)
+            if order is not None:
+                return order
+            order = sorted(self._replicas,
+                           key=lambda r: self._weight(topic, r.name),
+                           reverse=True)
+            if len(self._order_cache) < self._topic_map_max:
+                self._order_cache[topic] = order
+            return order
+
+    def route(self, topic: str) -> Optional[ReplicaHandle]:
+        """The replica this topic forwards to right now: rendezvous
+        order, filtered to healthy, spilled past exhausted budgets.
+        Returns None (counted) when nothing can take it."""
+        spilled = False
+        for handle in self._preference_order(topic):
+            if not handle.healthy:
+                continue
+            if handle.budget is not None and not handle.budget.try_acquire():
+                spilled = True
+                if self.metrics is not None:
+                    self.metrics.incr(mn.ROUTER_BUDGET_SPILLS)
+                continue
+            return handle
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROUTER_REJECTED_PREFIX
+                              + ("budget" if spilled else "no_replica"))
+        return None
+
+    def publish(self, topic: str, message: Dict[str, Any]) -> None:
+        if topic == self.control_topic:
+            self._publish_control(message)
+            return
+        handle = self.route(topic)
+        if handle is None:
+            return
+        handle.routed += 1
+        now = time.monotonic()
+        with self._lock:
+            if (topic in self._topic_map
+                    or len(self._topic_map) < self._topic_map_max):
+                self._topic_map[topic] = (handle.name, now)
+        # Forward OUTSIDE the router lock: the replica connector may
+        # dispatch handlers synchronously (FakeConnector) or write a
+        # socket — neither belongs under a routing lock.
+        forwarded = message
+        if topic != self.frame_topic:
+            forwarded = {**message, "_route_topic": topic}
+        handle.connector.publish(self.frame_topic, forwarded)
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROUTER_ROUTED)
+
+    #: test/bench ergonomics, same as FakeConnector.
+    inject = publish
+
+    def _publish_control(self, message: Dict[str, Any]) -> None:
+        """Control traffic (enrollment) routes to the writer replica
+        only — read replicas fail it closed themselves, but the router
+        should not even offer it to them."""
+        writer = next((r for r in self.replicas()
+                       if r.writer and r.healthy), None)
+        if writer is None:
+            if self.metrics is not None:
+                self.metrics.incr(mn.ROUTER_REJECTED_PREFIX + "no_writer")
+            return
+        writer.connector.publish(self.control_topic, message)
+
+    # ---- health-based failover ----
+
+    def check_health(self) -> None:
+        """Probe every replica once and apply transitions. Runs on the
+        health thread (probes may block on HTTP); tests call it directly
+        for determinism."""
+        from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL
+
+        for handle in self.replicas():
+            if handle.health_fn is None:
+                continue
+            try:
+                state = int(handle.health_fn())
+                handle.last_probe_error = None
+            except Exception as exc:  # noqa: BLE001 — a dead probe fails the replica closed
+                logger.warning("router: health probe for %s failed: %r",
+                               handle.name, exc)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.ROUTER_HEALTH_PROBE_FAILURES)
+                handle.last_probe_error = repr(exc)
+                state = STATE_CRITICAL
+            handle.health_state = state
+            healthy = state < STATE_CRITICAL
+            if healthy != handle.healthy:
+                self._transition(handle, healthy)
+        self._set_replica_gauges()
+
+    def _transition(self, handle: ReplicaHandle, healthy: bool) -> None:
+        handle.healthy = healthy
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROUTER_RECOVERIES if healthy
+                              else mn.ROUTER_FAILOVERS)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "failover",
+                             topic=LIFECYCLE_TOPIC, replica=handle.name,
+                             healthy=healthy,
+                             health_state=handle.health_state)
+            if not healthy:
+                # The flight recorder fires on failover: the rings hold
+                # what was routed when the replica went dark.
+                self.tracer.dump("failover",
+                                 extra={"replica": handle.name,
+                                        "registry": self.registry()})
+        logger.warning("router: replica %s %s", handle.name,
+                       "recovered" if healthy else
+                       "critical — draining + rerouting its topics")
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(timeout=self.health_interval_s):
+            try:
+                self.check_health()
+            except Exception:  # noqa: BLE001 — the health thread must live
+                logger.exception("router health sweep failed")
+                if self.metrics is not None:
+                    self.metrics.incr(mn.ROUTER_HEALTH_PROBE_FAILURES)
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        if self._health_thread is not None:
+            return
+        self._stop.clear()
+        self.check_health()
+        self._health_thread = threading.Thread(target=self._health_loop,
+                                               daemon=True,
+                                               name="ocvf-router-health")
+        self._health_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=2.0)
+            self._health_thread = None

@@ -193,6 +193,24 @@ def loop_liveness_objective(service, stale_s: float = 30.0,
                bound=float(stale_s), short_s=short_s, long_s=long_s)
 
 
+def replication_lag_objective(replica, rows_bound: float = 1024.0,
+                              short_s: float = 60.0,
+                              long_s: float = 600.0) -> SLO:
+    """Gauge objective over a read replica's ``lag_rows``
+    (``runtime.replication.ReadReplica``): WAL rows visible but not yet
+    applied locally. Warn once the backlog crosses ``rows_bound``,
+    critical at 6x — and because the brownout controller already consumes
+    a critical health verdict as one extra level of intake pressure, a
+    stale replica **browns itself out**: it sheds bulk serving load until
+    the tail catches up, composing with the existing controller instead
+    of adding a second one. Takes any object with a ``lag_rows``
+    attribute — the slo layer deliberately does not import replication
+    (replication imports the state store, which sits beside us)."""
+    return SLO(name="replication_lag", kind="gauge",
+               value_fn=lambda: float(replica.lag_rows),
+               bound=float(rows_bound), short_s=short_s, long_s=long_s)
+
+
 class SLOMonitor:
     """Evaluate a set of ``SLO`` objectives on a fixed interval and run
     the health state machine over them (module docstring)."""

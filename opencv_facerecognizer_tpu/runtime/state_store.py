@@ -154,6 +154,63 @@ def _decode_checkpoint(blob: bytes, path: str) -> Tuple[Dict[str, Any], bytes]:
     return header, payload
 
 
+def read_checkpoint_header(path: str) -> Dict[str, Any]:
+    """Parse + validate ONE checkpoint's header without reading its
+    payload: magic, header length, header-JSON and the 32-byte header
+    sha256 — a few KB of reads on a file that may hold a multi-MB
+    gallery. Read replicas re-anchor on the published ``wal_seq`` in this
+    header on every WAL compaction, so the cheap form matters. Raises
+    ``CheckpointCorruptError``/``CheckpointVersionError`` exactly like
+    ``_decode_checkpoint`` (payload checks excepted)."""
+    with open(path, "rb") as fh:
+        prefix = fh.read(len(CHECKPOINT_MAGIC) + 4)
+        if not prefix.startswith(CHECKPOINT_MAGIC) or len(prefix) < len(
+                CHECKPOINT_MAGIC) + 4:
+            raise CheckpointCorruptError(f"{path}: bad magic")
+        hlen = int.from_bytes(prefix[len(CHECKPOINT_MAGIC):], "big")
+        if hlen <= 0 or hlen > 64 << 20:
+            raise CheckpointCorruptError(f"{path}: bad header length")
+        header_blob = fh.read(hlen)
+        header_digest = fh.read(32)
+    if len(header_blob) < hlen or len(header_digest) < 32:
+        raise CheckpointCorruptError(f"{path}: truncated header")
+    if hashlib.sha256(header_blob).digest() != header_digest:
+        raise CheckpointCorruptError(f"{path}: header sha256 mismatch")
+    try:
+        header = json.loads(header_blob.decode("utf-8"))
+        if not isinstance(header, dict):
+            raise ValueError("header is not an object")
+        version = int(header.get("format_version", -1))
+    except (UnicodeDecodeError, json.JSONDecodeError, TypeError,
+            ValueError, AttributeError) as exc:
+        raise CheckpointCorruptError(f"{path}: header decode failed: "
+                                     f"{exc!r}") from exc
+    if version > CHECKPOINT_FORMAT_VERSION:
+        raise CheckpointVersionError(
+            f"{path}: format v{version} is newer than supported "
+            f"v{CHECKPOINT_FORMAT_VERSION}")
+    return header
+
+
+def scan_checkpoint_files(directory: str) -> List[Tuple[int, str]]:
+    """(seq, path) of every installed checkpoint in ``directory``, newest
+    first — the pure read-only sibling of
+    ``CheckpointStore.checkpoint_files`` for consumers (read replicas,
+    the offline verifier's ``--follow`` mode) that must never construct
+    the writer-side store against a live directory."""
+    out: List[Tuple[int, str]] = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        seq = CheckpointStore._seq_of(name)
+        if seq is not None:
+            out.append((seq, os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
 class CheckpointStore:
     """Atomic, checksummed, versioned checkpoints in one directory.
 

@@ -157,6 +157,34 @@ SLO_EVENTS_PREFIX = "slo_events_"
 #: prewarm design exists to prevent (the recompile watchdog's counter).
 RECOMPILES_POST_WARMUP = "recompiles_post_warmup"
 
+# ---- replication: writer lease / WAL-tailing read replicas -----------------
+REPLICATION_LEASE_ACQUIRED = "replication_lease_acquired"
+REPLICATION_LEASE_CONFLICTS = "replication_lease_conflicts"
+REPLICATION_POLLS = "replication_polls"
+REPLICATION_POLL_ERRORS = "replication_poll_errors"
+REPLICATION_RECORDS_APPLIED = "replication_records_applied"
+REPLICATION_ROWS_APPLIED = "replication_rows_applied"
+REPLICATION_CORRUPT_RECORDS = "replication_corrupt_records"
+REPLICATION_WAL_REOPENS = "replication_wal_reopens"
+REPLICATION_RESYNCS = "replication_resyncs"
+REPLICATION_ABORTS_AFTER_APPLY = "replication_aborts_after_apply"
+REPLICATION_ENROLL_REJECTED = "replication_enroll_rejected"
+#: replica staleness gauges: WAL rows visible but not yet applied, and the
+#: age (seconds) of the oldest row at the moment the replica applied it.
+REPLICATION_LAG_ROWS = "replication_lag_rows"
+REPLICATION_LAG_S = "replication_lag_s"
+
+# ---- topic router (runtime.replication.TopicRouter) ------------------------
+ROUTER_ROUTED = "router_routed"
+#: per-reason rejection family: ``router_rejected_<reason>``
+ROUTER_REJECTED_PREFIX = "router_rejected_"
+ROUTER_BUDGET_SPILLS = "router_budget_spills"
+ROUTER_FAILOVERS = "router_failovers"
+ROUTER_RECOVERIES = "router_recoveries"
+ROUTER_HEALTH_PROBE_FAILURES = "router_health_probe_failures"
+ROUTER_REPLICAS = "router_replicas"
+ROUTER_HEALTHY_REPLICAS = "router_healthy_replicas"
+
 # ---- supervisor ------------------------------------------------------------
 SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"
 SUPERVISOR_RESTARTS = "supervisor_restarts"

@@ -95,6 +95,14 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
     "tracing_p50_ratio": (
         lambda d: (d.get("tracing_overhead") or {}).get("p50_ratio"),
         "abs_max", 0.02, 0.0),
+    # Replica scale-out: completed-frames ratio at 2 replicas vs 1 (the
+    # router/fleet win). A candidate may not quietly lose the scaling the
+    # baseline demonstrated; artifacts predating the section ride the
+    # baseline-predates-metric skip.
+    "replica_scaleout_x2": (
+        lambda d: (d.get("replica_scaleout") or {})
+        .get("scaling", {}).get("x2"),
+        "ratio_min", 0.90, 0.0),
 }
 
 

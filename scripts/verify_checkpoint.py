@@ -111,6 +111,87 @@ def verify_state_dir(path: str) -> dict:
     return report
 
 
+def follow_wal(state_dir: str, duration_s: float = 10.0,
+               poll_s: float = 0.25) -> dict:
+    """``--follow``: validate a LIVE-tailed WAL exactly the way a read
+    replica reads it (``runtime.replication.WALTailer`` — complete lines
+    only, compaction detected on the open fd, checkpoint re-anchoring on
+    the published ``wal_seq``), so an operator can check what a reader
+    would see without stopping the writer. Strictly read-only, like the
+    static sweep.
+
+    Verdict: a PARSEABLE enroll record past the anchor that fails its
+    crc/base64 was acknowledged and is now unreadable to every replica —
+    real loss, ``ok: False``. Torn remnants, abort tombstones and
+    anchor-covered rows are counted, not failures."""
+    import time
+
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        WALTailer, newest_checkpoint_wal_seq,
+    )
+    from opencv_facerecognizer_tpu.runtime.state_store import (
+        decode_enroll_record,
+    )
+
+    wal_path = os.path.join(state_dir, "enroll.wal")
+    ckpt_dir = os.path.join(state_dir, "checkpoints")
+    anchor = newest_checkpoint_wal_seq(ckpt_dir)
+    tailer = WALTailer(wal_path)
+    applied = anchor
+    report = {"path": wal_path, "mode": "follow",
+              "duration_s": duration_s, "anchor_wal_seq": anchor,
+              "polls": 0, "valid_records": 0, "valid_rows": 0,
+              "corrupt_records": 0, "aborted_records": 0,
+              "anchor_covered": 0, "reanchors": 0, "ok": True}
+    aborted: set = set()
+    deadline = time.monotonic() + duration_s
+    while True:
+        records, info = tailer.poll()
+        report["polls"] += 1
+        if info.get("reopened"):
+            # Compaction swapped a rewritten WAL in: re-anchor at the
+            # newest checkpoint's published wal_seq, exactly as a replica
+            # that lagged past the truncation point would.
+            new_anchor = newest_checkpoint_wal_seq(ckpt_dir)
+            if new_anchor > applied:
+                applied = new_anchor
+                report["reanchors"] += 1
+                report["anchor_wal_seq"] = new_anchor
+        for record in records:
+            seq = record.get("seq")
+            if record.get("kind") == "abort" and isinstance(seq, (int, float)):
+                aborted.add(int(seq))
+        for record in records:
+            seq = record.get("seq")
+            if record.get("kind") != "enroll" or not isinstance(
+                    seq, (int, float)):
+                continue
+            seq = int(seq)
+            if seq <= applied and seq not in aborted:
+                report["anchor_covered"] += 1
+                continue
+            if seq in aborted:
+                report["aborted_records"] += 1
+                applied = max(applied, seq)
+                continue
+            decoded = decode_enroll_record(record)
+            if decoded is None:
+                report["corrupt_records"] += 1
+                report["ok"] = False
+            else:
+                report["valid_records"] += 1
+                report["valid_rows"] += int(decoded["n"])
+            applied = max(applied, seq)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        time.sleep(min(poll_s, remaining))
+    report["torn_lines"] = tailer.malformed_lines
+    report["wal_reopens"] = tailer.reopens
+    report["final_seq"] = applied
+    return report
+
+
 def verify_model_file(path: str) -> dict:
     from opencv_facerecognizer_tpu.utils.serialization import (
         CheckpointCorruptError, load_model,
@@ -136,8 +217,25 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("path", help="state directory (--state-dir layout or "
                                      "a checkpoints dir) or a model .ckpt file")
+    parser.add_argument("--follow", action="store_true",
+                        help="live-tail the state dir's WAL for --duration "
+                             "seconds, validating each new record the way a "
+                             "read replica applies it (complete lines only, "
+                             "compaction-aware, checkpoint re-anchoring); "
+                             "read-only and safe against a live writer")
+    parser.add_argument("--duration", type=float, default=10.0,
+                        help="--follow window in seconds")
+    parser.add_argument("--poll-ms", type=float, default=250.0,
+                        help="--follow poll interval")
     args = parser.parse_args(argv)
-    if os.path.isdir(args.path):
+    if args.follow:
+        if not os.path.isdir(args.path):
+            report = {"path": args.path, "ok": False,
+                      "reason": "--follow needs a state directory"}
+        else:
+            report = follow_wal(args.path, duration_s=args.duration,
+                                poll_s=args.poll_ms / 1e3)
+    elif os.path.isdir(args.path):
         report = verify_state_dir(args.path)
     elif os.path.exists(args.path):
         report = verify_model_file(args.path)

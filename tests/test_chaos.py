@@ -513,6 +513,18 @@ def test_overload_soak_long_randomized():
     assert report["ok"], report["failures"]
 
 
+@pytest.mark.slow
+def test_replication_soak_long_randomized():
+    """Random-seed replication soak (``--scenario replication``): 1 writer
+    + 2 WAL-tailing read replicas behind the topic router, reader killed
+    mid-traffic, writer killed mid-enrollment and restarted — survivor
+    p99, zero acked loss on every survivor, split-brain fail-closed, and
+    per-replica ledger exactness, at a fresh seed per run (the fast
+    pinned-seed variant lives in tests/test_replication.py)."""
+    report = chaos_soak.run_replication(seconds=10.0)
+    assert report["ok"], report["failures"]
+
+
 # ---------- review-hardening: degraded-path edges ----------
 
 

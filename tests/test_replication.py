@@ -1,0 +1,483 @@
+"""Multi-replica serving tests (``runtime.replication``): writer lease,
+WAL tailer, read replicas over a shared state dir, the rendezvous topic
+router with health failover, the ``/replicas`` expo surface, the
+``verify_checkpoint.py --follow`` live-tail mode, and the fast
+deterministic tier-1 variant of the replication chaos scenario
+(``scripts/chaos_soak.py --scenario replication``; the slow randomized
+soak lives in ``tests/test_chaos.py``)."""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.runtime import (
+    FakeConnector,
+    ReadReplica,
+    RecognizerService,
+    ReplicaHandle,
+    StateLifecycle,
+    TopicRouter,
+    WALTailer,
+    WriterLease,
+    WriterLeaseHeldError,
+)
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    CONTROL_TOPIC,
+    FRAME_TOPIC,
+    RESULT_TOPIC,
+    STATUS_TOPIC,
+)
+from opencv_facerecognizer_tpu.runtime.slo import STATE_CRITICAL, STATE_OK
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+DIM = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _writer(tmp_path, mesh, **kw):
+    gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(str(tmp_path), metrics=Metrics(),
+                           checkpoint_wal_rows=kw.pop("wal_rows", 1 << 30),
+                           checkpoint_every_s=1e9, **kw)
+    state.bind(gallery, names)
+    return state, gallery, names
+
+
+def _enroll(state, gallery, names, rng, i, n=1):
+    emb = rng.normal(size=(n, DIM)).astype(np.float32)
+    labels = np.full(n, i, np.int32)
+    names.append(f"s{i}")
+    state.append_enrollment(emb, labels, subject=f"s{i}", label=i,
+                            apply_fn=lambda e=emb, l=labels:
+                                gallery.add(e, l))
+
+
+def _assert_galleries_equal(a, b):
+    ae, al, _av, asz = a.snapshot()
+    be, bl, _bv, bsz = b.snapshot()
+    assert asz == bsz
+    assert np.array_equal(al[:asz], bl[:bsz])
+    assert np.allclose(ae[:asz], be[:bsz], rtol=0, atol=1e-6)
+
+
+# ---------- writer lease ----------
+
+
+def test_writer_lease_second_writer_fails_closed(tmp_path):
+    lease = WriterLease(str(tmp_path), metrics=Metrics()).acquire()
+    assert lease.held
+    # flock conflicts across file descriptors, even within one process.
+    with pytest.raises(WriterLeaseHeldError):
+        WriterLease(str(tmp_path)).acquire()
+    # Holder info is diagnostics: pid of the live holder.
+    with open(os.path.join(str(tmp_path), "writer.lease")) as fh:
+        assert json.load(fh)["pid"] == os.getpid()
+    lease.release()
+    assert not lease.held
+    # Release hands ownership over cleanly.
+    second = WriterLease(str(tmp_path)).acquire()
+    second.release()
+
+
+def test_writer_lease_acquire_is_idempotent_and_ctx(tmp_path):
+    lease = WriterLease(str(tmp_path))
+    with lease:
+        assert lease.acquire() is lease  # no self-deadlock
+        assert lease.held
+    assert not lease.held
+
+
+# ---------- WAL tailer ----------
+
+
+def test_tailer_reads_complete_lines_only(tmp_path):
+    path = str(tmp_path / "w.wal")
+    tailer = WALTailer(path)
+    records, info = tailer.poll()
+    assert records == [] and info.get("missing")
+    with open(path, "w") as fh:
+        fh.write('{"kind": "enroll", "seq": 1}\n{"kind": "enr')
+        fh.flush()
+    records, info = tailer.poll()
+    assert [r["seq"] for r in records] == [1]
+    assert info["partial"]
+    # The torn tail completes: only then is the second record visible.
+    with open(path, "a") as fh:
+        fh.write('oll", "seq": 2}\n')
+    records, _info = tailer.poll()
+    assert [r["seq"] for r in records] == [2]
+
+
+def test_tailer_skips_garbage_and_detects_swap(tmp_path):
+    path = str(tmp_path / "w.wal")
+    with open(path, "w") as fh:
+        fh.write('garbage-torn-line\n{"kind": "enroll", "seq": 5}\n')
+    tailer = WALTailer(path)
+    records, info = tailer.poll()
+    assert [r["seq"] for r in records] == [5]
+    assert tailer.malformed_lines == 1
+    assert not info["reopened"]
+    # Compaction: an atomically swapped-in rewrite (new inode).
+    with open(path + ".tmp", "w") as fh:
+        fh.write('{"kind": "enroll", "seq": 6}\n')
+    os.replace(path + ".tmp", path)
+    records, info = tailer.poll()
+    assert info["reopened"]
+    assert [r["seq"] for r in records] == [6]
+    assert tailer.reopens == 1
+
+
+# ---------- read replica over a live writer ----------
+
+
+def test_replica_tails_dedups_and_reanchors(tmp_path, mesh):
+    rng = np.random.default_rng(0)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i, n=2)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    rnames = []
+    metrics = Metrics()
+    rep = ReadReplica(str(tmp_path), rg, rnames, metrics=metrics,
+                      poll_interval_s=0.0, name="r0")
+    rep.resync()
+    _assert_galleries_equal(wg, rg)
+    assert rnames == wnames
+    # Incremental tail; polling again applies nothing twice (seq dedup).
+    for i in range(3, 6):
+        _enroll(state, wg, wnames, rng, i)
+    out = rep.poll(force=True)
+    assert out["rows"] == 3
+    assert rep.poll(force=True)["rows"] == 0
+    _assert_galleries_equal(wg, rg)
+    assert rep.lag_rows == 0
+    # Checkpoint + compaction: the replica detects the swapped WAL; a
+    # LATE replica anchors on the checkpoint and lands identical.
+    assert state.checkpoint_now(wait=True)
+    for i in range(6, 8):
+        _enroll(state, wg, wnames, rng, i)
+    rep.poll(force=True)
+    _assert_galleries_equal(wg, rg)
+    late_g = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    late = ReadReplica(str(tmp_path), late_g, [], poll_interval_s=0.0,
+                      name="late")
+    late.poll(force=True)
+    assert late.anchor_checkpoint is not None
+    _assert_galleries_equal(wg, late_g)
+    assert metrics.gauge("replication_lag_rows") == 0
+    state.close()
+
+
+def test_replica_abort_tombstones(tmp_path, mesh):
+    """An abort in the same poll batch filters its enroll; an abort for an
+    already-applied seq forces a resync that removes the phantom rows."""
+    rng = np.random.default_rng(1)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    _enroll(state, wg, wnames, rng, 0)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    metrics = Metrics()
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=metrics,
+                      poll_interval_s=0.0, name="r")
+    rep.poll(force=True)
+    assert rg.size == 1
+    # Same-batch abort: appended enroll + its tombstone land in one poll
+    # (the writer's failed-apply shape) — nothing is applied.
+    emb = rng.normal(size=(1, DIM)).astype(np.float32)
+    state.wal.append_enroll(2, emb, np.zeros(1, np.int32))
+    state.wal.append_abort(2)
+    out = rep.poll(force=True)
+    assert out["rows"] == 0
+    assert rg.size == 1
+    # Abort arriving a poll LATER than its (applied) enroll: the replica
+    # must resync rather than serve rows the writer rolled back.
+    state.wal.append_enroll(3, emb, np.zeros(1, np.int32))
+    assert rep.poll(force=True)["rows"] == 1
+    assert rg.size == 2
+    state.wal.append_abort(3)
+    out = rep.poll(force=True)
+    assert metrics.counter("replication_aborts_after_apply") == 1
+    assert rg.size == 1  # the resync rebuilt without the aborted row
+    state.close()
+
+
+def test_replica_service_applies_while_serving(tmp_path, mesh):
+    """RecognizerService(replica=...): the serving loop itself polls the
+    WAL tail between batches, and enroll commands are rejected."""
+    rng = np.random.default_rng(2)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    _enroll(state, wg, wnames, rng, 0)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=Metrics(),
+                      poll_interval_s=0.01, name="r")
+    rep.poll(force=True)
+    pipe = InstantPipeline((16, 16))
+    pipe.gallery = rg
+    connector = FakeConnector()
+    service = RecognizerService(pipe, connector, batch_size=4,
+                                frame_shape=(16, 16), flush_timeout=0.02,
+                                metrics=Metrics(), replica=rep)
+    service.start(warmup=False)
+    try:
+        for i in range(1, 4):
+            _enroll(state, wg, wnames, rng, i)
+        deadline = time.monotonic() + 5.0
+        while rg.size < wg.size and time.monotonic() < deadline:
+            time.sleep(0.02)
+        _assert_galleries_equal(wg, rg)
+        # Enrollment is writer-only on a read replica.
+        connector.inject(CONTROL_TOPIC, {"cmd": "enroll", "subject": "x"})
+        statuses = connector.messages(STATUS_TOPIC)
+        assert any(m.get("reason") == "read_replica" for m in statuses)
+        assert service.metrics.counter("replication_enroll_rejected") == 1
+    finally:
+        service.stop()
+    state.close()
+
+
+# ---------- topic router ----------
+
+
+def _handles(n, health=None, budget_fps=None):
+    out = []
+    for i in range(n):
+        out.append(ReplicaHandle(
+            f"replica-{i}", FakeConnector(),
+            health_fn=(health[i] if health else None),
+            budget_fps=budget_fps, writer=i == 0))
+    return out
+
+
+def test_router_rendezvous_is_stable_and_minimal():
+    """Rendezvous property: removing one replica moves ONLY the topics
+    that hashed to it; every other topic keeps its assignment."""
+    handles = _handles(3)
+    router = TopicRouter(handles, metrics=Metrics())
+    topics = [f"camera/{i}" for i in range(64)]
+    before = {t: router.route(t).name for t in topics}
+    assert len(set(before.values())) == 3  # all replicas used
+    handles[1].healthy = False
+    after = {t: router.route(t).name for t in topics}
+    for t in topics:
+        if before[t] != "replica-1":
+            assert after[t] == before[t]
+        else:
+            assert after[t] != "replica-1"
+
+
+def test_router_forwards_and_fans_in():
+    handles = _handles(2)
+    router = TopicRouter(handles, metrics=Metrics())
+    got = []
+    router.subscribe(RESULT_TOPIC, lambda t, m: got.append(m))
+    router.publish("camera/a", {"frame": "x", "meta": {"seq": 1}})
+    # The chosen replica's connector received it on FRAME_TOPIC.
+    fwd = [(h, m) for h in handles
+           for t, m in h.connector.sent if t == FRAME_TOPIC]
+    assert len(fwd) == 1
+    handle, msg = fwd[0]
+    assert msg["_route_topic"] == "camera/a" and msg["meta"]["seq"] == 1
+    # Results fan back in to the router's subscribers.
+    handle.connector.publish(RESULT_TOPIC, {"meta": {"seq": 1}, "faces": []})
+    assert got and got[0]["meta"]["seq"] == 1
+    # Control traffic goes to the writer replica only.
+    router.publish(CONTROL_TOPIC, {"cmd": "enroll"})
+    assert any(t == CONTROL_TOPIC for t, _m in handles[0].connector.sent)
+    assert not any(t == CONTROL_TOPIC for t, _m in handles[1].connector.sent)
+    # Status fan-in stamps the originating replica; results stay clean.
+    handle.connector.publish(STATUS_TOPIC, {"status": "degraded"})
+    statuses = []
+    router.subscribe(STATUS_TOPIC, lambda t, m: statuses.append(m))
+    handle.connector.publish(STATUS_TOPIC, {"status": "degraded"})
+    assert statuses[0]["replica"] == handle.name
+    assert "replica" not in got[0]
+
+
+def test_router_replace_connector_rewires_fan_in():
+    """A restarted replica comes back on a fresh connector: rewiring must
+    re-subscribe the fan-in there, or its results silently vanish."""
+    handles = _handles(1)
+    router = TopicRouter(handles, metrics=Metrics())
+    got = []
+    router.subscribe(RESULT_TOPIC, lambda t, m: got.append(m))
+    fresh = FakeConnector()
+    router.replace_connector("replica-0", fresh)
+    router.publish("camera/a", {"frame": "x", "meta": {"seq": 9}})
+    assert any(t == FRAME_TOPIC for t, _m in fresh.sent)  # routed anew
+    fresh.publish(RESULT_TOPIC, {"meta": {"seq": 9}, "faces": []})
+    assert got and got[0]["meta"]["seq"] == 9  # fan-in reached upstream
+    with pytest.raises(KeyError):
+        router.replace_connector("nope", FakeConnector())
+
+
+def test_router_budget_spills_to_next_replica():
+    metrics = Metrics()
+    handles = _handles(2, budget_fps=1.0)  # burst 1: one token each
+    router = TopicRouter(handles, metrics=metrics)
+    first = router.route("camera/a")
+    second = router.route("camera/a")  # first's bucket is empty: spill
+    assert first is not None and second is not None
+    assert second.name != first.name
+    assert metrics.counter("router_budget_spills") == 1
+    # Both exhausted: rejected with the budget reason.
+    assert router.route("camera/a") is None
+    assert metrics.counter("router_rejected_budget") == 1
+
+
+def test_router_health_failover_and_recovery():
+    state = {"replica-0": STATE_OK, "replica-1": STATE_OK}
+    metrics = Metrics()
+    handles = _handles(2, health=[lambda: state["replica-0"],
+                                  lambda: state["replica-1"]])
+    router = TopicRouter(handles, metrics=metrics)
+    router.check_health()
+    assert all(h.healthy for h in handles)
+    # One replica goes critical: excluded, counted, topics move.
+    state["replica-0"] = STATE_CRITICAL
+    router.check_health()
+    assert not handles[0].healthy
+    assert metrics.counter("router_failovers") == 1
+    assert router.route("camera/x").name == "replica-1"
+    assert metrics.gauge("router_healthy_replicas") == 1
+    # A RAISING probe also fails the replica closed.
+    handles[1].health_fn = lambda: (_ for _ in ()).throw(OSError("down"))
+    router.check_health()
+    assert not handles[1].healthy
+    assert metrics.counter("router_health_probe_failures") == 1
+    assert router.route("camera/x") is None
+    assert metrics.counter("router_rejected_no_replica") == 1
+    # Recovery reinstates.
+    state["replica-0"] = STATE_OK
+    handles[1].health_fn = lambda: STATE_OK
+    router.check_health()
+    assert all(h.healthy for h in handles)
+    assert metrics.counter("router_recoveries") == 2
+
+
+def test_router_registry_and_expo_replicas_endpoint():
+    import urllib.error
+    import urllib.request
+
+    from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
+
+    handles = _handles(2)
+    router = TopicRouter(handles, metrics=Metrics())
+    router.publish("camera/a", {"frame": "x"})
+    registry = router.registry()
+    assert {r["name"] for r in registry} == {"replica-0", "replica-1"}
+    assert sum(r["routed"] for r in registry) == 1
+    routed_topics = [t for r in registry for t in r["topics"]]
+    assert routed_topics == ["camera/a"]
+    expo = ExpoServer(metrics=Metrics(), router=router, port=0)
+    expo.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{expo.host}:{expo.port}/replicas", timeout=5) as r:
+            body = json.loads(r.read())
+        assert {x["name"] for x in body["replicas"]} == {"replica-0",
+                                                         "replica-1"}
+        # Unwired router answers the null shape, not a 404.
+        bare = ExpoServer(metrics=Metrics(), port=0)
+        bare.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{bare.host}:{bare.port}/replicas",
+                    timeout=5) as r:
+                assert json.loads(r.read())["replicas"] is None
+        finally:
+            bare.stop()
+    finally:
+        expo.stop()
+
+
+# ---------- verify_checkpoint --follow ----------
+
+
+def test_verify_follow_validates_live_tail(tmp_path, mesh):
+    rng = np.random.default_rng(3)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(2):
+        _enroll(state, wg, wnames, rng, i)
+    verify = _load_script("verify_checkpoint")
+    stop = threading.Event()
+
+    def keep_enrolling():
+        i = 2
+        while not stop.is_set():
+            _enroll(state, wg, wnames, rng, i)
+            i += 1
+            time.sleep(0.05)
+
+    writer_thread = threading.Thread(target=keep_enrolling, daemon=True)
+    writer_thread.start()
+    try:
+        report = verify.follow_wal(str(tmp_path), duration_s=0.6,
+                                   poll_s=0.05)
+    finally:
+        stop.set()
+        writer_thread.join(timeout=5.0)
+    assert report["ok"], report
+    assert report["valid_records"] >= 2
+    assert report["corrupt_records"] == 0
+    assert report["polls"] > 1
+    state.close()
+
+
+def test_verify_follow_flags_corrupt_acked_record(tmp_path, mesh):
+    rng = np.random.default_rng(4)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    _enroll(state, wg, wnames, rng, 0)
+    # A parseable enroll record with a broken crc: acked-then-unreadable.
+    with open(os.path.join(str(tmp_path), "enroll.wal"), "a") as fh:
+        fh.write(json.dumps({"kind": "enroll", "seq": 99, "n": 1,
+                             "dim": DIM, "labels": [0], "label": 0,
+                             "subject": "x", "emb": "AAAA", "crc32": 1,
+                             "ts": time.time()}) + "\n")
+    verify = _load_script("verify_checkpoint")
+    report = verify.follow_wal(str(tmp_path), duration_s=0.1, poll_s=0.05)
+    assert not report["ok"]
+    assert report["corrupt_records"] == 1
+    assert report["valid_records"] == 1
+    # The CLI surfaces it as rc 2 (same contract as the static sweep).
+    rc = verify.main([str(tmp_path), "--follow", "--duration", "0.1"])
+    assert rc == 2
+    state.close()
+
+
+# ---------- the replication chaos scenario (fast tier-1 variant) ----------
+
+
+def test_replication_soak_fast_deterministic():
+    """Tier-1 variant of ``--scenario replication``: 1 writer + 2 read
+    replicas under routed traffic; a reader dies mid-traffic, the writer
+    dies mid-enrollment and restarts; survivor p99 holds, every acked
+    enrollment is bit-equal on every survivor, the ledgers settle
+    exactly, and a REAL second process's writer-lease grab fails closed."""
+    chaos_soak = _load_script("chaos_soak")
+    report = chaos_soak.run_replication(seconds=3.0, seed=7)
+    assert report["ok"], report["failures"]
+    assert report["split_brain_rc"] == 3
+    assert report["acked_enrollments"] > 0
+    assert report["router"].get("router_failovers", 0) >= 1

@@ -885,7 +885,7 @@ def test_expo_concurrent_get_hammer_no_500s_counters_consistent():
 
 
 def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
-               offered=120, ratio=1.0):
+               offered=120, ratio=1.0, scaleout_x2=2.0):
     return {
         "modes": {"overlapped": {
             "e2e_p50_ms": e2e, "dropped_frames": dropped,
@@ -895,6 +895,7 @@ def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
              "interactive_offered": offered,
              "interactive_completed": done}]},
         "tracing_overhead": {"p50_ratio": ratio},
+        "replica_scaleout": {"scaling": {"x2": scaleout_x2}},
     }
 
 
@@ -930,10 +931,15 @@ def test_bench_compare_flags_each_regression_direction(tmp_path):
     # tracing overhead ratio drifted past the absolute threshold.
     assert bench_compare.main(
         [base, _write(tmp_path, "c.json", _smoke_doc(ratio=1.05))]) == 1
+    # Replica scale-out collapsed: below 0.90x of the baseline's 2.0x
+    # (a candidate may not quietly lose the router's scaling win).
+    assert bench_compare.main(
+        [base, _write(tmp_path, "e.json", _smoke_doc(scaleout_x2=1.2))]) == 1
     # Small jitter inside thresholds stays green.
     assert bench_compare.main(
         [base, _write(tmp_path, "d.json",
-                      _smoke_doc(e2e=10.6, p99=85.0, done=118))]) == 0
+                      _smoke_doc(e2e=10.6, p99=85.0, done=118,
+                                 scaleout_x2=1.9))]) == 0
 
 
 def test_bench_compare_missing_metric_and_overrides(tmp_path):

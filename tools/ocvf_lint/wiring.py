@@ -32,6 +32,10 @@ ATTR_HINTS: Dict[str, str] = {
     "slo": "SLOMonitor",
     "connector": "JSONLConnector",
     "pipeline": "RecognitionPipeline",
+    "replica": "ReadReplica",
+    "router": "TopicRouter",
+    "tailer": "WALTailer",
+    "lease": "WriterLease",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -75,9 +79,17 @@ WAL_WRITE_METHODS: FrozenSet[str] = frozenset({
     "append", "append_record", "truncate", "truncate_below", "rotate",
 })
 
-#: The durability layer that owns the _enroll_lock -> append_enrollment
-#: sequencing; gallery/WAL mutations inside it ARE the sanctioned path.
-WAL_EXEMPT_SUFFIXES: Tuple[str, ...] = ("runtime/state_store.py",)
+#: The durability layers whose gallery/WAL mutations ARE the sanctioned
+#: path: state_store owns the _enroll_lock -> append_enrollment
+#: sequencing, and replication's read replicas APPLY rows the writer
+#: already WAL-sequenced and fsynced — write-ahead holds for every one of
+#: their gallery.add calls by construction (the row was durable before
+#: the replica could even see it), so flagging them would invert the
+#: rule's own invariant.
+WAL_EXEMPT_SUFFIXES: Tuple[str, ...] = (
+    "runtime/state_store.py",
+    "runtime/replication.py",
+)
 
 #: Calls whose result is a DEVICE value (taint seeds for host-sync):
 #: terminal attribute names of producer calls in the serving runtime.
