@@ -639,6 +639,194 @@ def run_replica_scaleout(replica_counts=(1, 2, 4), seconds=3.0,
     }
 
 
+def run_rollout_smoke(seconds: float = 2.0, batch_size: int = 8,
+                      frame_hw=(32, 32), dispatch_s: float = 0.01,
+                      topics: int = 12, offered_hz: float = 60.0,
+                      n_rows: int = 24, seed: int = 7):
+    """Live embedder-rollout smoke (ISSUE 11): a writer + 2 WAL-tailing
+    read replicas behind the rendezvous router serve steady traffic while
+    the writer runs a full rollout — staged re-embed, dual-score parity
+    window, WAL-fenced atomic cutover, replica re-anchor through the
+    router cordon. Two load-bearing numbers come out:
+
+    - ``parity_agreement``: the dual-score window's old-vs-new top-1
+      identity agreement on identity queries (the gate the cutover is
+      allowed through — a fine-tune that actually changes identities
+      shows up here first);
+    - ``cutover_window_completed_ratio``: completed-frames/s through the
+      cutover + re-anchor window over the steady-state rate — the
+      serving-never-blanks number (1.0 = the fleet absorbed the rollout
+      invisibly; the router cordon + epoch-fenced swap are what keep it
+      there).
+
+    Deterministic: InstantPipeline capacity walls, a seeded rotation as
+    the "new embedder", synchronous phases. ``scripts/bench_compare.py``
+    tracks both numbers across artifacts (baseline-predates skip for
+    older files)."""
+    import shutil
+    import tempfile
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        FakeConnector, ReadReplica, RecognizerService, ReplicaHandle,
+        ResiliencePolicy, RolloutCoordinator, StateLifecycle, TopicRouter,
+        WriterLease,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, TrafficRecorder,
+    )
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        service_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    DIM = 8
+    rng = np.random.default_rng(seed)
+    mesh = make_mesh()
+    state_dir = tempfile.mkdtemp(prefix="ocvf_rollout_bench_")
+    Q, _ = np.linalg.qr(rng.normal(size=(DIM, DIM)))
+    Q = Q.astype(np.float32)
+
+    def old_embed(crops):
+        return np.asarray(crops, np.float32).reshape(len(crops), -1)[:, :DIM]
+
+    def new_embed(crops):
+        return old_embed(crops) @ Q
+
+    writer_metrics = Metrics()
+    lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+    gallery = ShardedGallery(capacity=256, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(state_dir, metrics=writer_metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9)
+    state.bind(gallery, names)
+    source_rows = []
+    for i in range(n_rows):
+        emb = rng.normal(size=(1, DIM)).astype(np.float32)
+        names.append(f"s{i}")
+        state.append_enrollment(
+            emb, np.full(1, i, np.int32), subject=f"s{i}", label=i,
+            apply_fn=lambda e=emb, i=i: gallery.add(
+                e, np.full(1, i, np.int32)))
+        source_rows.append(emb[0] / max(np.linalg.norm(emb[0]), 1e-12))
+    state.checkpoint_now(wait=True)
+
+    def make_service(g, metrics, replica=None):
+        pipe = InstantPipeline(frame_hw, dispatch_s=dispatch_s)
+        pipe.gallery = g
+        return RecognizerService(
+            pipe, FakeConnector(), batch_size=batch_size,
+            frame_shape=frame_hw, flush_timeout=0.02, inflight_depth=2,
+            similarity_threshold=0.0, metrics=metrics,
+            resilience=ResiliencePolicy(readback_deadline_s=2.0),
+            replica=replica)
+
+    writer_svc = make_service(gallery, writer_metrics)
+    readers = []
+    for i in range(2):
+        rmetrics = Metrics()
+        rgallery = ShardedGallery(capacity=256, dim=DIM, mesh=mesh)
+        rep = ReadReplica(state_dir, rgallery, [], metrics=rmetrics,
+                          poll_interval_s=0.02, name=f"reader-{i}")
+        rep.poll(force=True)
+        readers.append({"replica": rep, "gallery": rgallery,
+                        "svc": make_service(rgallery, rmetrics,
+                                            replica=rep)})
+    router_metrics = Metrics()
+    handles = [ReplicaHandle("writer", writer_svc.connector,
+                             health_fn=service_health_probe(writer_svc),
+                             writer=True)]
+    for i, reader in enumerate(readers):
+        handles.append(ReplicaHandle(
+            f"reader-{i}", reader["svc"].connector,
+            health_fn=service_health_probe(reader["svc"])))
+    router = TopicRouter(handles, metrics=router_metrics,
+                         health_interval_s=0.05)
+    for i, reader in enumerate(readers):
+        reader["replica"].on_resync = router.cordon_hook(f"reader-{i}")
+    recorder = TrafficRecorder(router)
+    frame_msg = encode_frame(np.zeros(frame_hw, np.float32))
+    seq_box = {"seq": 0}
+
+    def pump(duration_s):
+        interval = 1.0 / offered_hz
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            seq = seq_box["seq"]
+            seq_box["seq"] = seq + 1
+            recorder.send_t[seq] = time.monotonic()
+            router.publish(f"camera/{seq % topics}",
+                           {**frame_msg, "meta": {"seq": seq}})
+            time.sleep(interval)
+
+    def completions_in(t0, t1):
+        return sum(1 for t in recorder.done_t.values() if t0 <= t <= t1)
+
+    out = {"note": ("writer + 2 read replicas behind the rendezvous "
+                    "router under steady offered load; the writer runs a "
+                    "full embedder rollout (staged re-embed -> parity "
+                    "gate -> WAL-fenced cutover -> replica re-anchor "
+                    "through the router cordon) mid-traffic. The ratio "
+                    "compares completed-frames/s through the cutover "
+                    "window against steady state."),
+           "config": {"offered_hz": offered_hz, "topics": topics,
+                      "rows": n_rows, "seconds": seconds}}
+    try:
+        writer_svc.start(warmup=False)
+        for reader in readers:
+            reader["svc"].start(warmup=False)
+        router.start()
+        steady_t0 = time.monotonic()
+        pump(max(1.0, seconds / 2))
+        steady_t1 = time.monotonic()
+        steady_hz = completions_in(steady_t0, steady_t1) / (
+            steady_t1 - steady_t0)
+
+        coordinator = RolloutCoordinator(
+            state, gallery, lambda rows: rows @ Q, 2,
+            old_embed_fn=old_embed, new_embed_fn=new_embed,
+            parity_min_samples=8, parity_threshold=0.95, chunk_rows=8,
+            metrics=writer_metrics)
+        coordinator.run_stage()
+        coordinator.score_parity([row.reshape(2, 4)
+                                  for row in source_rows[:12]])
+        out["parity_agreement"] = (coordinator.parity.agreement
+                                   if coordinator.parity else None)
+        cut_t0 = time.monotonic()
+        coordinator.cutover()
+        deadline = time.monotonic() + 15.0
+        while (any(r["replica"].embedder_version != 2 for r in readers)
+               and time.monotonic() < deadline):
+            pump(0.1)
+        pump(max(0.5, seconds / 4))  # post-re-anchor tail
+        cut_t1 = time.monotonic()
+        cutover_hz = completions_in(cut_t0, cut_t1) / (cut_t1 - cut_t0)
+        out.update({
+            "steady_completed_hz": round(steady_hz, 1),
+            "cutover_window_completed_hz": round(cutover_hz, 1),
+            "cutover_window_completed_ratio": (
+                round(cutover_hz / steady_hz, 3) if steady_hz else None),
+            "cutover_window_s": round(cut_t1 - cut_t0, 2),
+            "readers_reanchored": all(
+                r["replica"].embedder_version == 2 for r in readers),
+            "router_cutover_drains": int(
+                router_metrics.counter("router_cutover_drains")),
+        })
+        for svc in [writer_svc] + [r["svc"] for r in readers]:
+            svc.drain(timeout=15.0)
+    finally:
+        router.stop()
+        for svc in [writer_svc] + [r["svc"] for r in readers]:
+            svc.stop()
+        lease.release()
+        state.close()
+        shutil.rmtree(state_dir, ignore_errors=True)
+    print(json.dumps(out), file=sys.stderr)
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--rates", type=float, nargs="+",
@@ -669,6 +857,7 @@ def main(argv=None):
         artifact["overload_sweep"] = run_overload_sweep()
         artifact["tracing_overhead"] = run_tracing_overhead()
         artifact["replica_scaleout"] = run_replica_scaleout()
+        artifact["rollout"] = run_rollout_smoke()
         with open("BENCH_SERVING_smoke.json", "w") as fh:
             json.dump(artifact, fh, indent=2)
         print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
@@ -696,6 +885,10 @@ def main(argv=None):
             "replica_scaleout_x2": scaleout.get("scaling", {}).get("x2"),
             "replica_scaleout_x4": scaleout.get("scaling", {}).get("x4"),
             "replica_scaleout_ok": scaleout.get("scaling_2x_ok"),
+            "rollout_parity_agreement": artifact["rollout"].get(
+                "parity_agreement"),
+            "rollout_cutover_completed_ratio": artifact["rollout"].get(
+                "cutover_window_completed_ratio"),
         }))
         # Both gates fail closed (False on a failed measurement): tracing
         # overhead AND the 2-replica >= 1.6x completed-frames scaling.

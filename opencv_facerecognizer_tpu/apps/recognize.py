@@ -195,6 +195,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "the --gallery startup enrollment); enrollments "
                         "accepted while serving then survive restarts. "
                         "Unset = state lives only in memory")
+    p.add_argument("--embedder-version", type=int, default=0, metavar="N",
+                   help="declare the loaded --model's embedder version "
+                        "(rollout fencing; README 'Live embedder "
+                        "rollout'). 0 (default) = adopt whatever version "
+                        "the state dir's newest checkpoint carries. "
+                        "Nonzero: startup FAILS CLOSED when the recovered "
+                        "state serves a different version — a new "
+                        "embedder's rows must arrive via the staged "
+                        "re-embed cutover (or this binary must complete a "
+                        "pending one), never by silently mixing spaces")
     p.add_argument("--checkpoint-every-s", type=float, default=300.0,
                    help="age threshold for background checkpoints: WAL "
                         "entries older than this trigger one (only "
@@ -396,7 +406,8 @@ def _load_stack(args):
                              async_grow=args.async_grow,
                              store_dtype=(jnp.bfloat16
                                           if args.gallery_dtype == "bf16"
-                                          else jnp.float32))
+                                          else jnp.float32),
+                             embedder_version=args.embedder_version or 1)
     gallery.add(emb, labels)  # ocvf-lint: boundary=wal-before-mutate -- startup ingest of the model's frozen subject set, BEFORE recovery/serving; durable enrollments arrive later via StateLifecycle replay
     if args.match_mode == "ivf" and gallery_mesh.size > 1:
         # Fail fast, like the pp guard above: the two-stage path is
@@ -633,6 +644,14 @@ def main(argv=None) -> int:
                               poll_interval_s=args.replica_poll_ms / 1e3)
         report = replica.resync()
         print(f"replica initial sync: {report}", file=sys.stderr)
+        if (args.embedder_version
+                and replica.embedder_version != args.embedder_version):
+            raise SystemExit(
+                f"ocvf-recognize: --embedder-version {args.embedder_version}"
+                f" declared but the state dir's checkpoint serves embedder "
+                f"v{replica.embedder_version} — a reader never mixes "
+                f"versions; start with the matching model (or wait for the "
+                f"writer's cutover checkpoint to land)")
     elif args.state_dir:
         # Writer role: exactly one enrollment owner per state dir. The
         # fcntl lease is taken BEFORE the lifecycle touches anything — a
@@ -659,6 +678,20 @@ def main(argv=None) -> int:
         # part of the state dir's own first checkpoint, taken below).
         report = state.recover(pipeline.gallery, names)
         print(f"state recovery: {report}", file=sys.stderr)
+        recovered_version = int(report.get("embedder_version", 1))
+        if args.embedder_version and recovered_version != args.embedder_version:
+            # Version fence at the front door: serving a v-N model over
+            # v-M rows is exactly the mixed-score corruption the rollout
+            # subsystem exists to prevent. (A PENDING cutover to the
+            # declared version is completed inside recover() and lands
+            # here as a match.)
+            raise SystemExit(
+                f"ocvf-recognize: --embedder-version {args.embedder_version}"
+                f" declared but recovery landed on embedder "
+                f"v{recovered_version} — refusing to serve mixed spaces. "
+                f"Roll the new embedder out via the staged re-embed "
+                f"(runtime.rollout: stage + parity gate + cutover), or "
+                f"start the matching model")
         if report["recovered_checkpoint"] is None and not report["replayed_records"]:
             # First run against this state dir: make the baseline gallery
             # durable NOW, so a crash before the first enrollment still

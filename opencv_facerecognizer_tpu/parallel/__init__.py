@@ -13,10 +13,14 @@ host-level application transport stays a separate layer (``runtime``).
 """
 
 from opencv_facerecognizer_tpu.parallel.mesh import initialize_multihost, make_mesh
-from opencv_facerecognizer_tpu.parallel.gallery import ShardedGallery
+from opencv_facerecognizer_tpu.parallel.gallery import (
+    EmbeddingDimMismatchError,
+    ShardedGallery,
+)
 
-__all__ = ["CoarseQuantizer", "ShardedGallery", "TwoStagePipeline",
-           "initialize_multihost", "make_mesh", "split_mesh"]
+__all__ = ["CoarseQuantizer", "EmbeddingDimMismatchError", "ShardedGallery",
+           "TwoStagePipeline", "initialize_multihost", "make_mesh",
+           "split_mesh"]
 
 
 def __getattr__(name):

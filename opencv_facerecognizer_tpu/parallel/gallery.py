@@ -167,6 +167,17 @@ def match_pod_pallas(q, g, valid, labels, *, k: int, mesh: Mesh,
     return mapped(q, g, valid, labels)
 
 
+class EmbeddingDimMismatchError(ValueError):
+    """A gallery swap was attempted across embedding dimensions. A new
+    embedder with a different D produces vectors in a DIFFERENT space —
+    installing them over rows scored in the old space would silently mix
+    embedder versions in one served shard set. The only sanctioned route
+    is the staged re-embed rollout (``runtime.rollout``): re-embed every
+    row into the new space, fence the WAL with a cutover record, then
+    install the staged set whole. Subclasses ``ValueError`` so pre-rollout
+    callers that caught the old dim-mismatch error keep working."""
+
+
 class GalleryData(NamedTuple):
     """One immutable snapshot of the device-visible gallery state.
 
@@ -228,8 +239,16 @@ class ShardedGallery:
         use_pallas: Optional[bool] = None,
         async_grow: bool = False,
         store_dtype: Any = jnp.float32,
+        embedder_version: int = 1,
     ):
         self.mesh = mesh
+        #: version of the embedder whose space EVERY row in this gallery
+        #: lives in — one gallery never mixes versions (the rollout
+        #: subsystem's fencing invariant, ``runtime.rollout``). Stamped
+        #: into checkpoint headers and WAL rows by ``StateLifecycle``;
+        #: changed only by a whole-set install (``load_snapshot`` /
+        #: ``swap_from`` adopting the donor's version) — never row-wise.
+        self.embedder_version = int(embedder_version)
         self._use_pallas_cfg = use_pallas
         tp = mesh.shape[TP_AXIS]
         # Round capacity up so every tp shard is equal (static shapes).
@@ -925,18 +944,26 @@ class ShardedGallery:
                 self._write_lock.release()
 
     def load_snapshot(self, emb: np.ndarray, lab: np.ndarray,
-                      val: np.ndarray, size: int) -> None:
+                      val: np.ndarray, size: int,
+                      embedder_version: Optional[int] = None) -> None:
         """Install host-mirror arrays from a prior ``snapshot()`` as the
         live gallery — the supervisor's last-known-good restore path
         (runtime.resilience.ServiceSupervisor): a crash mid-enrolment must
         not leave a half-written gallery serving. Adopts the snapshot's
         capacity (grows since the checkpoint are rolled back with it) and
-        invalidates any in-flight async grow, exactly like ``swap_from``."""
+        invalidates any in-flight async grow, exactly like ``swap_from``.
+        ``embedder_version`` (when given) re-stamps the gallery's version
+        along with the whole-set install — the rollout cutover and the
+        replica's new-version re-anchor both change version and rows in
+        this one atomic publish, so serving can never observe rows from
+        one version stamped with another."""
         emb = np.array(emb, np.float32, copy=True)
         if emb.ndim != 2 or emb.shape[1] != self.dim:
             raise ValueError(f"snapshot must be [capacity, {self.dim}], "
                              f"got {emb.shape}")
         with self._write_lock:
+            if embedder_version is not None:
+                self.embedder_version = int(embedder_version)
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
             self._pending_count = 0
@@ -966,11 +993,27 @@ class ShardedGallery:
         way, so the device snapshot is simply rebuilt at THIS gallery's
         width (one extra H2D; a reload already pays one). The installed
         snapshot therefore always carries self.store_dtype, so compiled
-        cache keys (which carry capacity, not dtype) never alias."""
+        cache keys (which carry capacity, not dtype) never alias.
+
+        A ``dim`` mismatch FAILS CLOSED (``EmbeddingDimMismatchError``):
+        a donor built by a different-D embedder is a different embedding
+        space, and a raw swap would publish scores against rows the query
+        embedder cannot compare to. Different-D embedders roll out through
+        the staged re-embed path (``runtime.rollout``), never a swap. The
+        donor's ``embedder_version`` is adopted atomically with its rows —
+        same-version retrain reloads are unaffected (both default 1)."""
         if other.dim != self.dim:
-            raise ValueError(f"dim mismatch: {other.dim} != {self.dim}")
+            raise EmbeddingDimMismatchError(
+                f"swap_from refused: donor gallery dim {other.dim} != "
+                f"serving dim {self.dim}. A different-D embedder must roll "
+                f"out via the staged re-embed path (runtime.rollout: "
+                f"stage + cutover record + checkpoint), never a raw swap "
+                f"— mixing embedding spaces in one served shard set would "
+                f"corrupt every published score.")
         recast = other.store_dtype != self.store_dtype
         with self._write_lock:
+            self.embedder_version = int(getattr(other, "embedder_version",
+                                                self.embedder_version))
             self._epoch += 1  # invalidate any in-flight async grow
             self._pending.clear()
             self._pending_count = 0

@@ -750,6 +750,13 @@ class CoarseQuantizer:
             "trained_size": self.trained_size,
             "spill_count": self._spill_count,
             "version": self.version,
+            # Embedder version the centroids were trained in: derived
+            # state is space-bound — a sidecar surviving a rollout
+            # cutover must fail closed to a retrain (state_store checks
+            # this on restore; wal_seq keying covers the common case,
+            # this is the defense-in-depth).
+            "embedder_version": int(getattr(self._gallery,
+                                            "embedder_version", 1)),
         }
 
     def install_from_arrays(self, centroids: np.ndarray,
@@ -818,6 +825,7 @@ def encode_sidecar(payload: Dict[str, Any], wal_seq: int) -> bytes:
         "seed": int(payload["seed"]),
         "trained_size": int(payload["trained_size"]),
         "version": int(payload["version"]),
+        "embedder_version": int(payload.get("embedder_version", 1)),
         "crc32_centroids": binascii.crc32(cent_b) & 0xFFFFFFFF,
         "crc32_assign": binascii.crc32(assign_b) & 0xFFFFFFFF,
         "created_ts": time.time(),

@@ -31,6 +31,13 @@ from opencv_facerecognizer_tpu.runtime.replication import (
     WriterLease,
     WriterLeaseHeldError,
 )
+from opencv_facerecognizer_tpu.runtime.rollout import (
+    DualScoreParity,
+    ReEmbedStage,
+    RolloutCoordinator,
+    RolloutGateError,
+    RolloutStateError,
+)
 from opencv_facerecognizer_tpu.runtime.resilience import (
     BrownoutPolicy,
     ResiliencePolicy,
@@ -42,9 +49,11 @@ from opencv_facerecognizer_tpu.runtime.slo import (
     default_objectives,
     loop_liveness_objective,
     replication_lag_objective,
+    rollout_parity_objective,
 )
 from opencv_facerecognizer_tpu.runtime.state_store import (
     CheckpointStore,
+    EmbedderVersionMismatchError,
     EnrollmentWAL,
     StateLifecycle,
     graceful_shutdown,
@@ -56,6 +65,8 @@ __all__ = [
     "BrownoutPolicy",
     "CheckpointStore",
     "DeadLetterJournal",
+    "DualScoreParity",
+    "EmbedderVersionMismatchError",
     "EnrollmentWAL",
     "ExpoServer",
     "FakeConnector",
@@ -66,9 +77,13 @@ __all__ = [
     "PRIORITY_BULK",
     "PRIORITY_INTERACTIVE",
     "ReadReplica",
+    "ReEmbedStage",
     "RecognizerService",
     "ReplicaHandle",
     "ResiliencePolicy",
+    "RolloutCoordinator",
+    "RolloutGateError",
+    "RolloutStateError",
     "TopicRouter",
     "WALTailer",
     "WriterLease",
@@ -79,6 +94,7 @@ __all__ = [
     "default_objectives",
     "loop_liveness_objective",
     "replication_lag_objective",
+    "rollout_parity_objective",
     "StateLifecycle",
     "TheTrainer",
     "TokenBucket",

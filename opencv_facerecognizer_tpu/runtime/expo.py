@@ -42,6 +42,10 @@ path                    payload
                         per-replica health, routed counts, observed topic
                         assignment; ``{"replicas": null}`` when no router
                         is wired
+``/rollout``            the in-flight embedder rollout's status
+                        (``runtime.rollout.RolloutCoordinator.status``):
+                        phase, staged-re-embed watermark, dual-score
+                        parity verdict; ``{"rollout": null}`` when none
 ======================  =====================================================
 
 **Read-only contract**: every verb except GET is answered ``405 Method Not
@@ -185,7 +189,7 @@ class ExpoServer:
                  host: str = "127.0.0.1", port: int = 0,
                  refresh_s: float = 2.0,
                  bench_path: str = DEFAULT_BENCH_PATH,
-                 slo=None, router=None):
+                 slo=None, router=None, rollout=None):
         self.service = service
         self.tracer = tracer if tracer is not None else getattr(
             service, "tracer", None)
@@ -201,6 +205,13 @@ class ExpoServer:
         #: assignment) as a read-only snapshot — what an orchestrator
         #: polls to see where failover moved the traffic.
         self.router = router
+        #: optional runtime.rollout.RolloutCoordinator behind ``/rollout``:
+        #: phase / staged watermark / parity-window verdict as a read-only
+        #: snapshot (the ``rollout_*`` gauges carry the same numbers on
+        #: /prom; this is the structured view an operator polls while
+        #: deciding whether to cut over). Falls back to the service's
+        #: attached coordinator so late attachment is visible.
+        self.rollout = rollout
         self.refresh_s = float(refresh_s)
         self.bench_path = bench_path
         self._started_t = time.monotonic()
@@ -294,7 +305,7 @@ class ExpoServer:
             return {
                 "endpoints": ["/", "/metrics", "/prom", "/health", "/ledger",
                               "/brownout", "/spans", "/attribution",
-                              "/replicas"],
+                              "/replicas", "/rollout"],
                 "uptime_s": round(time.monotonic() - self._started_t, 1),
                 "brownout_level": getattr(service, "brownout_level", None),
                 "health": (self.slo.state if self.slo is not None else None),
@@ -327,6 +338,12 @@ class ExpoServer:
             if self.router is None:
                 return {"replicas": None, "detail": "no topic router wired"}
             return {"replicas": self.router.registry()}
+        if path == "/rollout":
+            coordinator = (self.rollout if self.rollout is not None
+                           else getattr(service, "rollout", None))
+            if coordinator is None:
+                return {"rollout": None, "detail": "no rollout in flight"}
+            return {"rollout": coordinator.status()}
         raise KeyError(path)
 
     @staticmethod

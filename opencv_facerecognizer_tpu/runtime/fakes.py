@@ -70,7 +70,7 @@ class _GalleryStub:
     def snapshot(self):
         return ()
 
-    def load_snapshot(self, *parts) -> None:
+    def load_snapshot(self, *parts, embedder_version=None) -> None:
         pass
 
 
@@ -87,12 +87,19 @@ class InstantPipeline:
 
     def __init__(self, frame_shape: Tuple[int, int], top_k: int = 1,
                  max_faces: int = 2, compute_s: float = 0.0,
-                 sync_poll_floor_s: float = 0.0, dispatch_s: float = 0.0):
+                 sync_poll_floor_s: float = 0.0, dispatch_s: float = 0.0,
+                 faces_per_frame: int = 0):
         self.frame_shape = tuple(frame_shape)
         self.top_k = int(top_k)
         self.max_faces = int(max_faces)
         self.compute_s = float(compute_s)
         self.sync_poll_floor_s = float(sync_poll_floor_s)
+        #: scripted detections: the first N face slots of every frame come
+        #: back valid (fixed box, det_score 1, label 0, sim 1) instead of
+        #: the default zero-face result — what the rollout parity hook and
+        #: the enrolment-collection paths need to fire without a real
+        #: detector. 0 keeps the historical zero-face behavior.
+        self.faces_per_frame = min(int(faces_per_frame), int(max_faces))
         #: host-side seconds charged INSIDE each dispatch call (the serve
         #: thread sleeps it out). ``compute_s`` is pure latency — batches
         #: overlap through the in-flight queue and never limit throughput;
@@ -135,8 +142,18 @@ class InstantPipeline:
                                    "mode": "fake"}
         self.compiled_batch_sizes.add(b)
         # pack_result layout: boxes(4) | det_score | valid | labels(k) |
-        # sims(k); valid=0 everywhere -> zero faces per frame.
+        # sims(k); valid=0 everywhere -> zero faces per frame (unless
+        # faces_per_frame scripts some detections in).
         packed = np.zeros((b, self.max_faces, 6 + 2 * self.top_k), np.float32)
+        if self.faces_per_frame:
+            h, w = self.frame_shape
+            for j in range(self.faces_per_frame):
+                packed[:, j, 0:4] = (2.0, 2.0, max(6.0, h - 2.0),
+                                     max(6.0, w - 2.0))  # y0 x0 y1 x1
+                packed[:, j, 4] = 1.0   # det_score
+                packed[:, j, 5] = 1.0   # valid
+                packed[:, j, 6] = 0.0   # top-1 label
+                packed[:, j, 6 + self.top_k] = 1.0  # top-1 similarity
         return FakePacked(packed, time.monotonic() + self.compute_s,
                           poll_cost_s=self.sync_poll_floor_s)
 

@@ -55,6 +55,17 @@ BOUNDARIES: Dict[str, tuple] = {
     # against the checkpoint's recorded WAL sequence.
     "wal": ("torn", "crash"),
     "checkpoint": ("torn", "crash", "late"),
+    # Embedder-rollout boundaries (runtime.rollout): "stage" faults hit
+    # the background re-embed's progress append ("torn" = a partial chunk
+    # line lands then the process dies — resume must re-stage that chunk;
+    # "crash" = death before any byte); "cutover" faults hit the atomic
+    # swap ("crash_before_record" = the stage delta is durable but the
+    # fence record never landed — recovery stays on the old version;
+    # "crash_after_record" = the fence is durable but the in-memory swap
+    # and its checkpoint never ran — recovery must COMPLETE the cutover
+    # from the staged shard set).
+    "stage": ("torn", "crash"),
+    "cutover": ("crash_before_record", "crash_after_record"),
 }
 
 
@@ -269,6 +280,19 @@ class FaultInjector:
         installs it), ``"late"`` (the checkpoint lands; die before the WAL
         truncation that follows), or None."""
         return self._draw("checkpoint")
+
+    def on_stage(self) -> Optional[str]:
+        """Rollout stage-append boundary (the background re-embed's
+        progress journal): the WRITER enacts the fault — ``"torn"``
+        persists a partial chunk line then raises, ``"crash"`` raises
+        before any byte lands — so the torn bytes are its real encoding."""
+        return self._draw("stage")
+
+    def on_cutover(self) -> Optional[str]:
+        """Atomic-cutover boundary (``StateLifecycle.perform_cutover``):
+        returns which side of the fence record the simulated kill lands
+        on, or None."""
+        return self._draw("cutover")
 
     def summary(self) -> Dict[str, int]:
         return dict(self.injected)

@@ -325,6 +325,12 @@ class RecognizerService:
         self.tracer = tracer
         self.slo = slo_monitor
         self.replica = replica
+        # Embedder-rollout coordinator (runtime.rollout.RolloutCoordinator),
+        # attached by the rollout orchestration when a dual-score parity
+        # window is live: _publish samples detected face crops into it
+        # (rate-limited, copied, scored on the rollout thread — the hot
+        # path pays one attribute read when unset). None = no rollout.
+        self.rollout = None
         # Serving-loop progress stamp, refreshed every loop iteration
         # (batch AND idle — get_batch's flush timeout guarantees regular
         # iterations even with zero traffic). Read by the loop_liveness
@@ -1031,6 +1037,18 @@ class RecognizerService:
             # view, not a copy, so steady state allocates nothing.
             bucket = self._pick_bucket(count)
             view = frames[:bucket] if bucket < len(frames) else frames
+            # Embedder-version stamp captured AT DISPATCH: the batch's
+            # scores are computed against the gallery data this dispatch
+            # reads, so its published results carry the version serving
+            # when the batch entered the device — a cutover swapping the
+            # gallery later never back-stamps an in-flight batch. (The
+            # version moves monotonically and exactly once per rollout,
+            # so per-replica result stamps form a clean old->new prefix —
+            # the no-mixed-scores assertion chaos_soak checks.)
+            gallery_ver = getattr(self.pipeline.gallery,
+                                  "embedder_version", None)
+            if gallery_ver is not None:
+                gallery_ver = int(gallery_ver)
             packed = self._dispatch_with_retry(view)
             if packed is None:
                 # Retries exhausted or the error was permanent (poisoned
@@ -1056,7 +1074,7 @@ class RecognizerService:
                 self._inflight.append((packed, frames, metas, count,
                                        batch.enqueue_ts, t0, t_disp, deadline,
                                        trace_ids, batch_tid,
-                                       batch.priorities))
+                                       batch.priorities, gallery_ver))
                 accounted = True
                 self._inflight_cv.notify_all()
         except BaseException:
@@ -1324,8 +1342,8 @@ class RecognizerService:
                         return
                     continue
                 packed, frames, metas, count, enqueue_ts, t0, t_disp, \
-                    deadline, trace_ids, batch_tid, priorities \
-                    = self._inflight[0]
+                    deadline, trace_ids, batch_tid, priorities, \
+                    gallery_ver = self._inflight[0]
             try:
                 ready = self._await_ready(packed, deadline)
             except Exception:  # noqa: BLE001 — outage at the readback side
@@ -1349,7 +1367,8 @@ class RecognizerService:
                                   batch_tid)
                 continue
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp, trace_ids, batch_tid, priorities)
+                                t0, t_disp, trace_ids, batch_tid, priorities,
+                                gallery_ver)
 
     def _await_ready(self, packed, deadline: float) -> bool:
         """Wait for one batch's transfer, bounded by its deadline. Returns
@@ -1396,7 +1415,8 @@ class RecognizerService:
         could wedge."""
         while self._inflight:
             packed, frames, metas, count, enqueue_ts, t0, t_disp, deadline, \
-                trace_ids, batch_tid, priorities = self._inflight[0]
+                trace_ids, batch_tid, priorities, gallery_ver \
+                = self._inflight[0]
             ready = self._is_ready(packed)
             if not ready:
                 if time.monotonic() >= deadline:
@@ -1421,11 +1441,12 @@ class RecognizerService:
                     continue
             self._pop_inflight_head()
             self._complete_head(packed, frames, metas, count, enqueue_ts,
-                                t0, t_disp, trace_ids, batch_tid, priorities)
+                                t0, t_disp, trace_ids, batch_tid, priorities,
+                                gallery_ver)
 
     def _complete_head(self, packed, frames, metas, count, enqueue_ts,
                        t0, t_disp, trace_ids=(), batch_tid=0,
-                       priorities=()) -> None:
+                       priorities=(), gallery_ver=None) -> None:
         """Materialize + publish one POPPED batch and settle its accounting
         — the shared tail of the readback worker and the fallback drain
         (the two paths must stay behaviorally identical apart from
@@ -1466,7 +1487,8 @@ class RecognizerService:
                              frames=count)
         t_pub = time.perf_counter()
         try:
-            self._publish(arr, frames, metas, count, trace_ids, batch_tid)
+            self._publish(arr, frames, metas, count, trace_ids, batch_tid,
+                          gallery_ver)
         except BaseException:
             self._mark_completed()
             raise
@@ -1500,10 +1522,11 @@ class RecognizerService:
             self._inflight_cv.notify_all()
 
     def _publish(self, packed, frames, metas, count, trace_ids=(),
-                 batch_tid=0) -> None:
+                 batch_tid=0, gallery_ver=None) -> None:
         from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
 
         published = 0
+        rollout = self.rollout
         try:
             result = unpack_result(np.asarray(packed), self.pipeline.top_k)  # no-op if already host
             boxes = result.boxes
@@ -1533,9 +1556,26 @@ class RecognizerService:
                         "similarity": sim,
                     })
                 self._maybe_collect_enrolment(frames[i], faces)
-                self.connector.publish(RESULT_TOPIC, {"meta": metas[i], "faces": faces})
+                payload = {"meta": metas[i], "faces": faces}
+                if gallery_ver is not None:
+                    # The embedder version the batch was SCORED against
+                    # (captured + int-coerced at dispatch) — consumers and
+                    # the rollout chaos scenario key the no-mixed-scores
+                    # invariant on this stamp.
+                    payload["embedder_version"] = gallery_ver
+                self.connector.publish(RESULT_TOPIC, payload)
                 published += 1
                 self.metrics.incr(mn.FACES_FOUND, len(faces))
+                if rollout is not None and faces:
+                    # Dual-score parity sampling (rate-limited + copied
+                    # inside; scored on the rollout thread). A coordinator
+                    # bug must cost a counter, never the publish path.
+                    try:
+                        rollout.offer_live(frames[i], faces)
+                    except Exception:  # noqa: BLE001 — observation only
+                        logging.getLogger(__name__).exception(
+                            "rollout live-parity offer failed")
+                        self.metrics.incr(mn.ROLLOUT_OBSERVE_ERRORS)
         finally:
             # Ledger settlement happens HERE, per batch, whatever exits:
             # frames that made it out are completed; on a crash escaping
@@ -1583,6 +1623,13 @@ class RecognizerService:
         from opencv_facerecognizer_tpu.ops import image as image_ops
 
         face_size = self.pipeline.face_size
+        # Version fence stamp, read BEFORE the embed: these crops are
+        # about to be embedded by the CURRENT model — if a rollout
+        # cutover swaps the space before the WAL append below, the
+        # lifecycle refuses the stale-space rows closed
+        # (EmbedderVersionMismatchError) instead of mixing them in.
+        enrol_version = getattr(self.pipeline.gallery, "embedder_version",
+                                None)
         crops = np.stack(
             [np.asarray(image_ops.resize(c, face_size)) for c in enrolment.crops]  # ocvf-lint: boundary=host-sync -- enrolment readback: _finish_enrolment runs on its own daemon thread, off the serving loop by design
         )
@@ -1615,7 +1662,8 @@ class RecognizerService:
                 self.state.append_enrollment(
                     emb, labels_arr, subject=enrolment.subject_name,
                     label=label,
-                    apply_fn=lambda: self.pipeline.gallery.add(emb, labels_arr))
+                    apply_fn=lambda: self.pipeline.gallery.add(emb, labels_arr),
+                    embedder_version=enrol_version)
             else:
                 self.pipeline.gallery.add(emb, labels_arr)  # ocvf-lint: boundary=wal-before-mutate -- explicit no-state-dir mode: nothing durable exists to sequence against, and the operator chose volatility
             grown = self.pipeline.gallery.grow_count - before_grow

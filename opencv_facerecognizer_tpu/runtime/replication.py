@@ -307,18 +307,28 @@ def load_latest_checkpoint_readonly(ckpt_dir: str, metrics=None):
     return None
 
 
-def newest_checkpoint_wal_seq(ckpt_dir: str) -> int:
-    """The published ``wal_seq`` of the newest header-verified checkpoint
-    (0 when none): the re-anchor point a replica compares its
-    ``applied_seq`` against after every WAL compaction. Header-only reads
-    — a few KB per file, never the payload."""
+def newest_checkpoint_info(ckpt_dir: str) -> Tuple[int, int]:
+    """``(wal_seq, embedder_version)`` of the newest header-verified
+    checkpoint (``(0, 0)`` when none): the re-anchor point a replica
+    compares its ``applied_seq`` against after every WAL compaction, and
+    — during a rollout — the signal that the writer's post-cutover
+    checkpoint landed. Header-only reads — a few KB per file, never the
+    payload."""
     for _seq, path in scan_checkpoint_files(ckpt_dir):
         try:
             header = read_checkpoint_header(path)
         except (OSError, CheckpointCorruptError, CheckpointVersionError):
             continue
-        return int(header.get("meta", {}).get("wal_seq", 0))
-    return 0
+        meta = header.get("meta", {})
+        return (int(meta.get("wal_seq", 0)),
+                int(meta.get("embedder_version", 1)))
+    return 0, 0
+
+
+def newest_checkpoint_wal_seq(ckpt_dir: str) -> int:
+    """Back-compat form of ``newest_checkpoint_info`` (the verifier's
+    ``--follow`` mode keys on the sequence alone)."""
+    return newest_checkpoint_info(ckpt_dir)[0]
 
 
 class ReadReplica:
@@ -363,6 +373,23 @@ class ReadReplica:
         #: aborts_after_apply count.
         self._anchor_seq = 0
         self._aborted_seen: set = set()
+        #: embedder version this replica's gallery currently serves
+        #: (anchored from the checkpoint header; rollout fencing).
+        self.embedder_version = int(getattr(gallery, "embedder_version", 1))
+        #: a cutover fence was observed in the tail: ``{"to_version",
+        #: "seq"}``. While set, NOTHING is applied — the replica keeps
+        #: serving its pure old-version gallery and re-anchors only once
+        #: the writer's NEW-version checkpoint lands (the PR-10 resync
+        #: path pointed at the post-cutover state). Applying new-space
+        #: rows to the old gallery, or half-resyncing onto a pre-cutover
+        #: checkpoint, would both violate the no-mixing invariant.
+        self._await_cutover: Optional[Dict[str, int]] = None
+        #: optional drain hook, called ``on_resync("begin"|"end")`` around
+        #: every full re-anchor — the fleet wiring points it at
+        #: ``TopicRouter.set_cordon`` so this replica's topics route to
+        #: peers while the checkpoint load runs on the serving thread and
+        #: fleet-wide completed-frames never blanks through a cutover.
+        self.on_resync: Optional[Callable[[str], None]] = None
 
     # ---- sync ----
 
@@ -374,45 +401,78 @@ class ReadReplica:
         across the whole file here, exactly like writer-side replay."""
         report = {"checkpoint": None, "applied_records": 0,
                   "applied_rows": 0}
-        loaded = load_latest_checkpoint_readonly(self.ckpt_dir,
-                                                 metrics=self.metrics)
-        if loaded is not None:
-            header, state, path = loaded
-            meta = header.get("meta", {})
-            dim = int(meta.get("dim", -1))
-            if dim != self.gallery.dim:
-                raise ValueError(
-                    f"replica {self.name}: state dir {self.state_dir!r} "
-                    f"holds dim={dim} checkpoints but the gallery is "
-                    f"dim={self.gallery.dim} — wrong --state-dir for this "
-                    f"model?")
-            size = int(meta.get("size", int(state["val"].sum())))
-            self.gallery.load_snapshot(state["emb"], state["lab"],
-                                       state["val"], size)
-            self.subject_names[:] = [str(s) for s
-                                     in meta.get("subject_names", [])]
-            self.applied_seq = int(meta.get("wal_seq", 0))
-            self.anchor_checkpoint = path
-            report["checkpoint"] = path
-        else:
-            # No checkpoint yet (a brand-new writer): replay the whole
-            # WAL onto an empty gallery.
-            if self.gallery.size:
-                self.gallery.reset()
-            self.subject_names[:] = []
-            self.applied_seq = 0
-            self.anchor_checkpoint = None
-        self.seen_seq = self.applied_seq
-        self._anchor_seq = self.applied_seq
-        self._aborted_seen.clear()
-        self.tailer.reset()
-        records, _info = self.tailer.poll()
-        applied = self._apply_records(records)
-        report["applied_records"] = applied["records"]
-        report["applied_rows"] = applied["rows"]
-        self._synced = True
-        self._resync_needed = False
-        self._update_lag()
+        if self.on_resync is not None:
+            # Planned drain window: the router cordons this replica so
+            # its topics route to peers while the checkpoint load runs
+            # on the serving thread (completed-frames continuity through
+            # a cutover re-anchor).
+            try:
+                self.on_resync("begin")
+            except Exception:  # noqa: BLE001 — a drain hook bug must not block the resync itself
+                logger.exception("replica %s on_resync(begin) failed",
+                                 self.name)
+        try:
+            loaded = load_latest_checkpoint_readonly(self.ckpt_dir,
+                                                     metrics=self.metrics)
+            prior_version = self.embedder_version
+            if loaded is not None:
+                header, state, path = loaded
+                meta = header.get("meta", {})
+                dim = int(meta.get("dim", -1))
+                if dim != self.gallery.dim:
+                    raise ValueError(
+                        f"replica {self.name}: state dir {self.state_dir!r} "
+                        f"holds dim={dim} checkpoints but the gallery is "
+                        f"dim={self.gallery.dim} — wrong --state-dir for this "
+                        f"model?")
+                size = int(meta.get("size", int(state["val"].sum())))
+                ckpt_version = int(meta.get("embedder_version", 1))
+                self.gallery.load_snapshot(state["emb"], state["lab"],
+                                           state["val"], size,
+                                           embedder_version=ckpt_version)
+                self.subject_names[:] = [str(s) for s
+                                         in meta.get("subject_names", [])]
+                self.applied_seq = int(meta.get("wal_seq", 0))
+                self.anchor_checkpoint = path
+                self.embedder_version = ckpt_version
+                report["checkpoint"] = path
+                if ckpt_version != prior_version:
+                    # The rollout re-anchor: this replica just crossed the
+                    # version fence onto the writer's post-cutover state.
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.ROLLOUT_REPLICA_REANCHORS)
+                    logger.info("replica %s re-anchored onto embedder "
+                                "v%d (was v%d)", self.name, ckpt_version,
+                                prior_version)
+            else:
+                # No checkpoint yet (a brand-new writer): replay the whole
+                # WAL onto an empty gallery.
+                if self.gallery.size:
+                    self.gallery.reset()
+                self.subject_names[:] = []
+                self.applied_seq = 0
+                self.anchor_checkpoint = None
+            self.seen_seq = max(self.seen_seq, self.applied_seq)
+            self._anchor_seq = self.applied_seq
+            self._aborted_seen.clear()
+            self._await_cutover = None
+            if self.metrics is not None:
+                self.metrics.set_gauge(mn.ROLLOUT_REPLICA_AWAITING, 0)
+            self.tailer.reset()
+            records, _info = self.tailer.poll()
+            applied = self._apply_records(records)
+            report["applied_records"] = applied["records"]
+            report["applied_rows"] = applied["rows"]
+            self._synced = True
+            self._resync_needed = False
+            self._update_lag()
+        finally:
+            if self.on_resync is not None:
+                try:
+                    self.on_resync("end")
+                except Exception:  # noqa: BLE001 — see begin
+                    logger.exception("replica %s on_resync(end) failed",
+                                     self.name)
         if self.metrics is not None:
             self.metrics.incr(mn.REPLICATION_RESYNCS)
         if self.tracer is not None:
@@ -420,6 +480,7 @@ class ReadReplica:
                              topic=LIFECYCLE_TOPIC, replica=self.name,
                              resync=True, applied_seq=self.applied_seq,
                              rows=applied["rows"],
+                             embedder_version=self.embedder_version,
                              checkpoint=report["checkpoint"])
         return report
 
@@ -438,6 +499,32 @@ class ReadReplica:
             self.metrics.incr(mn.REPLICATION_POLLS)
         if not self._synced or self._resync_needed:
             return self.resync()
+        if self._await_cutover is not None:
+            # Parked on a cutover fence: keep serving the pure old-version
+            # gallery and watch (header-only, cheap) for the writer's
+            # post-cutover checkpoint; re-anchor the moment one lands. The
+            # unpark key is the SEQUENCE, not the awaited version: any
+            # checkpoint whose wal_seq covers the fence was snapshotted
+            # after the swap (version + wal_seq are read in one critical
+            # section on the writer), so it necessarily carries the
+            # post-cutover version — or a LATER one, when cutovers
+            # stacked because the first post-cutover checkpoint failed;
+            # waiting for the exact awaited version would strand the
+            # replica on stale rows forever in that supported sequence.
+            # The tail still advances ``seen_seq`` so the lag gauges stay
+            # honest about the backlog building up behind the fence.
+            anchor_seq, _anchor_version = newest_checkpoint_info(
+                self.ckpt_dir)
+            if anchor_seq >= self._await_cutover["seq"]:
+                return self.resync()
+            records, _info = self.tailer.poll()
+            for record in records:
+                seq = record.get("seq")
+                if isinstance(seq, (int, float)):
+                    self.seen_seq = max(self.seen_seq, int(seq))
+            self._update_lag()
+            return {"records": 0, "rows": 0, "awaiting_version":
+                    self._await_cutover["to_version"]}
         records, info = self.tailer.poll()
         if info["reopened"]:
             # Compaction: rows <= the newest checkpoint's wal_seq were
@@ -502,8 +589,35 @@ class ReadReplica:
             seq = record.get("seq")
             if isinstance(seq, (int, float)):
                 self.seen_seq = max(self.seen_seq, int(seq))
-            if record.get("kind") != "enroll" or not isinstance(
-                    seq, (int, float)):
+            kind = record.get("kind")
+            if kind == "cutover" and isinstance(seq, (int, float)):
+                seq = int(seq)
+                if seq <= self.applied_seq:
+                    continue  # covered by the anchor checkpoint: burned
+                to_version = int(record.get("to_version", 0))
+                if to_version == int(getattr(self.gallery,
+                                             "embedder_version",
+                                             self.embedder_version)):
+                    # Already on the target version (resync landed on the
+                    # post-cutover checkpoint whose wal_seq trails the
+                    # fence — cannot happen with the writer's ordering,
+                    # but burn it rather than park forever).
+                    self.applied_seq = seq
+                    continue
+                # Park on the fence: nothing past it is applicable until
+                # the writer's new-version checkpoint lands (poll watches
+                # for it). Everything already applied is pure old-version
+                # — serving continues un-blanked.
+                self._await_cutover = {"to_version": to_version, "seq": seq}
+                if self.metrics is not None:
+                    self.metrics.set_gauge(mn.ROLLOUT_REPLICA_AWAITING, 1)
+                logger.info(
+                    "replica %s: cutover fence seq %d -> embedder v%d "
+                    "observed; holding at v%d until the new-version "
+                    "checkpoint lands", self.name, seq, to_version,
+                    self.embedder_version)
+                break
+            if kind != "enroll" or not isinstance(seq, (int, float)):
                 continue
             seq = int(seq)
             if seq <= self.applied_seq:
@@ -511,6 +625,25 @@ class ReadReplica:
             if seq in aborted:
                 self.applied_seq = seq  # tombstoned: burn it, apply nothing
                 continue
+            if int(record.get("embedder_version", 1)) != int(
+                    getattr(self.gallery, "embedder_version",
+                            self.embedder_version)):
+                # Version fence without a visible cutover record (e.g. a
+                # late-start replica whose first tail read begins past a
+                # compacted fence): never apply across it — park exactly
+                # like the explicit fence and wait for the matching
+                # checkpoint.
+                self._await_cutover = {
+                    "to_version": int(record.get("embedder_version", 1)),
+                    "seq": seq}
+                if self.metrics is not None:
+                    self.metrics.set_gauge(mn.ROLLOUT_REPLICA_AWAITING, 1)
+                logger.warning(
+                    "replica %s: enroll seq %d carries embedder v%s but "
+                    "the gallery serves v%d — holding for a matching "
+                    "checkpoint (version fence)", self.name, seq,
+                    record.get("embedder_version"), self.embedder_version)
+                break
             decoded = decode_enroll_record(record)
             if decoded is None:
                 # A parseable record failing crc/base64 was acknowledged
@@ -556,6 +689,9 @@ class ReadReplica:
                 "lag_s": round(self.lag_s, 4),
                 "wal_reopens": self.tailer.reopens,
                 "anchor_checkpoint": self.anchor_checkpoint,
+                "embedder_version": self.embedder_version,
+                "awaiting_cutover": (dict(self._await_cutover)
+                                     if self._await_cutover else None),
                 "gallery_size": int(self.gallery.size)}
 
 
@@ -629,6 +765,12 @@ class ReplicaHandle:
         self.writer = bool(writer)
         self.healthy = True
         self.health_state = 0
+        #: planned-drain flag (``TopicRouter.set_cordon``): excluded from
+        #: rendezvous like an unhealthy replica, but deliberately — the
+        #: rollout re-anchor drains a replica through its cutover without
+        #: tripping failover machinery (no flight dump, no failover
+        #: counter; the replica IS healthy, just busy re-anchoring).
+        self.cordoned = False
         self.routed = 0
         self.last_probe_error: Optional[str] = None
 
@@ -727,6 +869,39 @@ class TopicRouter(MiddlewareConnector):
         with self._lock:
             self._handlers.setdefault(topic, []).append(handler)
 
+    def set_cordon(self, name: str, cordoned: bool) -> None:
+        """Planned drain for one replica (the rollout re-anchor path):
+        while cordoned, its topics rendezvous to their next-preferred
+        replicas — serving never blanks through a checkpoint reload — and
+        uncordoning hands exactly its own topics back (route-time
+        filtering over the stable preference order, same property as
+        health failover). Distinct from failover on purpose: no flight
+        dump, no failover counter — this is choreography, not an
+        incident. Raises ``KeyError`` on an unknown name."""
+        with self._lock:
+            handle = next((r for r in self._replicas if r.name == name),
+                          None)
+        if handle is None:
+            raise KeyError(f"no replica named {name!r}")
+        if cordoned and not handle.cordoned:
+            if self.metrics is not None:
+                self.metrics.incr(mn.ROUTER_CUTOVER_DRAINS)
+            if self.tracer is not None:
+                self.tracer.emit(self.tracer.new_trace(), "cutover_drain",
+                                 topic=LIFECYCLE_TOPIC, replica=name)
+        handle.cordoned = bool(cordoned)
+        logger.info("router: replica %s %s", name,
+                    "cordoned (draining topics to peers)" if cordoned
+                    else "uncordoned (topics handed back)")
+
+    def cordon_hook(self, name: str) -> Callable[[str], None]:
+        """The ``ReadReplica.on_resync`` adapter: cordon on "begin",
+        uncordon on "end" — one line of fleet wiring per replica."""
+        def hook(phase: str, _name=name) -> None:
+            self.set_cordon(_name, phase == "begin")
+
+        return hook
+
     def replicas(self) -> List[ReplicaHandle]:
         with self._lock:
             return list(self._replicas)
@@ -748,6 +923,7 @@ class TopicRouter(MiddlewareConnector):
                 "name": handle.name,
                 "writer": handle.writer,
                 "healthy": handle.healthy,
+                "cordoned": handle.cordoned,
                 "health_state": STATE_NAMES[min(handle.health_state,
                                                 len(STATE_NAMES) - 1)],
                 "routed": handle.routed,
@@ -799,7 +975,7 @@ class TopicRouter(MiddlewareConnector):
         Returns None (counted) when nothing can take it."""
         spilled = False
         for handle in self._preference_order(topic):
-            if not handle.healthy:
+            if not handle.healthy or handle.cordoned:
                 continue
             if handle.budget is not None and not handle.budget.try_acquire():
                 spilled = True

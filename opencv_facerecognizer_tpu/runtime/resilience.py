@@ -299,6 +299,7 @@ class ServiceSupervisor:
         self._last_health = -1
         self._snapshot: Optional[Tuple] = None
         self._snapshot_wal_seq: Optional[int] = None
+        self._snapshot_version: Optional[int] = None
         self._subject_names: Optional[list] = None
         self._thread: Optional[threading.Thread] = None
         self._running = False
@@ -343,11 +344,14 @@ class ServiceSupervisor:
         the WAL coverage the next durable checkpoint claims."""
         if self.state is not None:
             (self._snapshot_wal_seq, self._snapshot,
-             self._subject_names) = self.state.stamped_snapshot()
+             self._subject_names,
+             self._snapshot_version) = self.state.stamped_snapshot()
         else:
             self._snapshot_wal_seq = None
             self._snapshot = self.service.pipeline.gallery.snapshot()
             self._subject_names = list(self.service.subject_names)
+            self._snapshot_version = getattr(
+                self.service.pipeline.gallery, "embedder_version", None)
         self.service.metrics.incr(mn.SUPERVISOR_CHECKPOINTS)
 
     def _on_commit(self) -> None:
@@ -519,7 +523,14 @@ class ServiceSupervisor:
             self._restore_durable()
             return
         service = self.service
-        service.pipeline.gallery.load_snapshot(*self._snapshot)
+        # Rows + embedder version re-install in ONE atomic publish: a
+        # snapshot taken before a cutover restores the OLD version stamp
+        # with the old-space rows (never old rows under the new stamp),
+        # and replay_tail's version fence then keeps post-cutover records
+        # from mixing in.
+        service.pipeline.gallery.load_snapshot(
+            *self._snapshot,
+            embedder_version=getattr(self, "_snapshot_version", None))
         if self._subject_names is not None:
             # Same in-place trim/extend rule as the gallery restore: names
             # enrolled after the checkpoint have no committed rows anymore.

@@ -1,0 +1,818 @@
+"""Zero-downtime embedder rollout: version-fenced state, crash-safe
+staged re-embed, dual-score parity gating, atomic fleet cutover.
+
+Enrollment before this module was append-only against ONE frozen
+embedder: every gallery row, WAL record and checkpoint implicitly lived
+in that model's embedding space. A production fleet retrains (the
+multibatch metric-learning recipe in ``runtime.trainer`` produces the
+fine-tuned model), and rolling the new embedder out live has exactly one
+hard invariant: **no published score is ever computed against a gallery
+mixing embedder versions** — a query embedded by model A compared to
+rows embedded by model B is silent identity corruption, worse than
+downtime. This module makes the version an explicit, fenced, durable
+property of the state machinery PR 4/6/10 built:
+
+- **Version fencing** — ``ShardedGallery.embedder_version`` names the
+  one space every row in a served shard set lives in. ``StateLifecycle``
+  stamps it into checkpoint headers and every WAL enrollment row, and
+  fails an enrollment closed (``EmbedderVersionMismatchError``, inside
+  the enroll lock, before any sequence is burned) when the embedding's
+  version disagrees with the serving gallery's. Replay, read replicas
+  and the offline verifier all refuse to apply a row across the fence.
+- **Crash-safe background re-embed** (``ReEmbedStage``) — accumulated
+  enrollments are re-embedded off the hot thread into a staged shard
+  set: an append-only, fsync-always progress journal of fixed chunks
+  (``rollout/stage-v<N>.jsonl``), each crc-checked, with a torn tail
+  sealed at open exactly like the WAL. A kill at ANY point resumes from
+  the last durable watermark — re-embedding is deterministic over the
+  append-only source rows, so a re-staged chunk is bit-identical and
+  half-migrated rows are never served (the live gallery is untouched
+  until cutover).
+- **Dual-score parity window** (``DualScoreParity``) — before cutover is
+  allowed, old and new embedder score side-by-side on live traffic
+  (face crops sampled off the publish path, scored on the rollout
+  thread): top-1 identity agreement over a sliding window must clear a
+  gated threshold with a minimum sample count. Exported as ``rollout_*``
+  gauges on the shared Metrics surface (hence ``/prom``), with
+  ``runtime.slo.rollout_parity_objective`` feeding /health.
+- **Atomic cutover** (``RolloutCoordinator.cutover`` ->
+  ``StateLifecycle.perform_cutover``) — under the enroll lock: the
+  final enrollment delta is staged durably, a ``cutover`` WAL fence
+  record lands (strict fsync, write-ahead), then the gallery installs
+  the new-space arrays + version in ONE epoch-fenced publish
+  (in-flight batches keep the arrays they captured; the IVF quantizer
+  invalidates and retrains in the background — PR 6's derived-state
+  lifecycle rides the swap). A forced checkpoint follows; until it
+  lands, recovery COMPLETES the cutover from the durable stage. Read
+  replicas see the fence in the WAL tail, stop applying, and re-anchor
+  on the new-version checkpoint through the PR-10 resync path — the
+  ``TopicRouter`` cordons each replica through its re-anchor so its
+  topics drain to peers and fleet-wide completed-frames never hits
+  zero. **Rollback is the same mechanism pointed at the prior space**:
+  a new rollout whose ``reembed_fn`` maps rows back (``rollback()``).
+
+Crash matrix (what ``scripts/chaos_soak.py --scenario rollout``
+asserts): kill mid-re-embed -> resume from the watermark, old version
+serves untouched; kill after the fence record, before the swap or its
+checkpoint -> recovery installs the staged set at the new version,
+zero acked loss; kill a reader mid-re-anchor -> its replacement resyncs
+onto the new checkpoint; and in every interleaving, each published
+result's ``embedder_version`` stamp moves old -> new exactly once per
+replica, never mixed.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+from opencv_facerecognizer_tpu.runtime.state_store import (
+    EmbedderVersionMismatchError,
+    StateLifecycle,
+)
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.tracing import LIFECYCLE_TOPIC
+
+__all__ = [
+    "DualScoreParity",
+    "EmbedderVersionMismatchError",
+    "ReEmbedStage",
+    "RolloutCoordinator",
+    "RolloutGateError",
+    "RolloutStateError",
+    "load_stage",
+    "stage_path",
+]
+
+logger = logging.getLogger(__name__)
+
+#: state-dir subdirectory holding staged re-embed progress journals.
+ROLLOUT_DIR = "rollout"
+
+#: phase gauge codes (``rollout_phase`` on /prom).
+PHASE_CODES = {"idle": 0, "staging": 1, "parity": 2, "ready": 3,
+               "cutover": 4, "done": 5}
+
+
+class RolloutStateError(RuntimeError):
+    """Durable rollout state (the staged shard set) is missing or damaged
+    where correctness requires it — e.g. recovery found a fsynced cutover
+    fence but the stage file no longer covers the promised rows. Fails
+    CLOSED: serving a mixed- or partially-migrated gallery is the one
+    outcome this subsystem exists to prevent."""
+
+
+class RolloutGateError(RuntimeError):
+    """Cutover refused: the staged re-embed is not caught up or the
+    dual-score parity window has not cleared its gate. ``force=True``
+    overrides (the operator's explicit judgment call)."""
+
+
+def stage_path(state_dir: str, to_version: int) -> str:
+    return os.path.join(str(state_dir), ROLLOUT_DIR,
+                        f"stage-v{int(to_version)}.jsonl")
+
+
+def _l2norm(rows: np.ndarray) -> np.ndarray:
+    rows = np.asarray(rows, np.float32)
+    return rows / np.maximum(np.linalg.norm(rows, axis=-1, keepdims=True),
+                             1e-12)
+
+
+def _decode_stage_chunk(record: Dict[str, Any]
+                        ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+    """Validate + decode one parsed stage chunk -> (start, emb, labels),
+    or None when the record fails its crc/shape checks (a torn-then-
+    sealed remnant, or media damage — the caller decides whether a gap
+    is fatal)."""
+    try:
+        raw = base64.b64decode(record["emb"], validate=True)
+        if (binascii.crc32(raw) & 0xFFFFFFFF) != record["crc32"]:
+            return None
+        n, dim = int(record["n"]), int(record["dim"])
+        emb = np.frombuffer(raw, np.float32)
+        if emb.size != n * dim:
+            return None
+        labels = np.asarray(record["labels"], np.int32)
+        if labels.shape[0] != n:
+            return None
+        return int(record["start"]), emb.reshape(n, dim), labels
+    except (KeyError, TypeError, ValueError, binascii.Error):
+        return None
+
+
+def _read_stage_file(path: str) -> Tuple[Optional[Dict[str, Any]],
+                                         Dict[int, Tuple[np.ndarray,
+                                                         np.ndarray]], int]:
+    """Parse one stage journal -> (begin record or None, {start: (emb,
+    labels)} with later duplicates winning, torn/invalid line count).
+    Pure read — shared by the owning ``ReEmbedStage`` (resume) and the
+    recovery-side ``load_stage`` (which must never write)."""
+    begin: Optional[Dict[str, Any]] = None
+    chunks: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+    bad = 0
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as fh:
+            lines = fh.read().split("\n")
+    except OSError:
+        return None, {}, 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+            if not isinstance(record, dict):
+                raise ValueError("not an object")
+        except (json.JSONDecodeError, ValueError):
+            bad += 1
+            continue
+        kind = record.get("kind")
+        if kind == "stage_begin" and begin is None:
+            begin = record
+        elif kind == "stage":
+            decoded = _decode_stage_chunk(record)
+            if decoded is None:
+                bad += 1
+                continue
+            start, emb, labels = decoded
+            chunks[start] = (emb, labels)
+    return begin, chunks, bad
+
+
+def _coverage(chunks: Dict[int, Tuple[np.ndarray, np.ndarray]]) -> int:
+    """Contiguous watermark: the largest W with rows [0, W) fully staged.
+    Chunks may overlap after a crash-resume (the re-staged chunk is
+    bit-identical — re-embedding is deterministic over append-only
+    source rows), so walk starts in order and extend greedily."""
+    watermark = 0
+    for start in sorted(chunks):
+        n = chunks[start][0].shape[0]
+        if start <= watermark < start + n or start == watermark:
+            watermark = max(watermark, start + n)
+        elif start > watermark:
+            break  # gap: nothing past it is contiguous
+    return watermark
+
+
+def load_stage(state_dir: str, to_version: int,
+               expect_rows: Optional[int] = None,
+               expect_dim: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Recovery-side loader: the staged shard set as ``(embeddings
+    [rows, dim], labels [rows])`` — strictly read-only (the recovering
+    process may be completing another process's cutover). Raises
+    ``RolloutStateError`` when the file is absent, mis-headed, or does
+    not contiguously cover ``expect_rows`` — the fence record promised
+    those rows were durable, so anything less is media damage and the
+    caller must fail closed, never serve a partial migration."""
+    path = stage_path(state_dir, to_version)
+    begin, chunks, _bad = _read_stage_file(path)
+    if begin is None:
+        raise RolloutStateError(
+            f"stage file {path} is missing or headerless, but a durable "
+            f"cutover record references it — cannot complete the cutover "
+            f"(restore the rollout/ directory or roll back)")
+    if int(begin.get("to_version", -1)) != int(to_version) or (
+            expect_dim is not None
+            and int(begin.get("dim", -1)) != int(expect_dim)):
+        raise RolloutStateError(
+            f"stage file {path} header disagrees with the cutover record "
+            f"(header: {begin}, wanted to_version={to_version} "
+            f"dim={expect_dim})")
+    watermark = _coverage(chunks)
+    rows = int(expect_rows) if expect_rows is not None else watermark
+    if watermark < rows:
+        raise RolloutStateError(
+            f"stage file {path} covers only {watermark} contiguous rows "
+            f"of the {rows} the cutover record promised — damaged stage; "
+            f"refusing a partial migration")
+    dim = int(begin["dim"])
+    emb = np.zeros((rows, dim), np.float32)
+    labels = np.zeros((rows,), np.int32)
+    for start in sorted(chunks):
+        c_emb, c_lab = chunks[start]
+        if start >= rows:
+            continue
+        end = min(rows, start + c_emb.shape[0])
+        emb[start:end] = c_emb[:end - start]
+        labels[start:end] = c_lab[:end - start]
+    return emb, labels
+
+
+class ReEmbedStage:
+    """Crash-safe staged re-embed progress for one target version
+    (module docstring). Append-only JSONL, fsync on every chunk: the
+    watermark visible after ANY kill is exactly the set of chunks whose
+    append returned. Single-writer by contract — the rollout thread (or
+    the cutover's locked finalize) owns it."""
+
+    def __init__(self, state_dir: str, to_version: int, dim: int,
+                 from_version: int = 1, metrics=None, fault_injector=None):
+        self.state_dir = str(state_dir)
+        self.to_version = int(to_version)
+        self.from_version = int(from_version)
+        self.dim = int(dim)
+        self.metrics = metrics
+        self._faults = fault_injector
+        self.path = stage_path(state_dir, to_version)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._chunks: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+        self.watermark = 0
+        self.resumed = False
+        self._load_or_begin()
+
+    # ---- durable file plumbing ----
+
+    def _append_line(self, text: str, newline: bool = True) -> None:
+        with open(self.path, "a", encoding="utf-8") as fh:  # ocvf-lint: disable=non-atomic-write -- append-only progress journal (the WAL discipline): records are immutable once fsynced, torn tails are sealed at open and skipped by the crc'd reader; atomic-rewrite would destroy the resumability this file exists for
+            fh.write(text + ("\n" if newline else ""))
+            fh.flush()
+            os.fsync(fh.fileno())
+
+    def _seal_torn_tail(self) -> None:
+        try:
+            if not os.path.getsize(self.path):
+                return
+            with open(self.path, "rb+") as fh:
+                fh.seek(-1, os.SEEK_END)
+                if fh.read(1) != b"\n":
+                    fh.write(b"\n")
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        except OSError:
+            if self.metrics is not None:
+                self.metrics.incr(mn.ROLLOUT_STAGE_ERRORS)
+
+    def _load_or_begin(self) -> None:
+        if os.path.exists(self.path):
+            self._seal_torn_tail()
+            begin, chunks, _bad = _read_stage_file(self.path)
+            if (begin is not None
+                    and int(begin.get("to_version", -1)) == self.to_version
+                    and int(begin.get("dim", -1)) == self.dim):
+                self._chunks = chunks
+                self.watermark = _coverage(chunks)
+                self.resumed = bool(chunks)
+                if self.resumed and self.metrics is not None:
+                    self.metrics.incr(mn.ROLLOUT_STAGE_RESUMES)
+                if self.resumed:
+                    logger.info(
+                        "rollout stage v%d resumed at watermark %d "
+                        "(%s)", self.to_version, self.watermark, self.path)
+                return
+            # Config drift (different target dim/version reusing the
+            # file name): the old progress is unusable — start clean.
+            logger.warning("rollout stage %s header mismatch; restaging "
+                           "from zero", self.path)
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+        self._append_line(json.dumps({
+            "kind": "stage_begin", "to_version": self.to_version,
+            "from_version": self.from_version, "dim": self.dim,
+            "ts": time.time()}))
+
+    # ---- staging ----
+
+    def stage_chunk(self, start: int, emb: np.ndarray,
+                    labels: np.ndarray) -> None:
+        """Durably append one contiguous chunk of re-embedded rows
+        (raises on write failure or injected kill — the watermark only
+        advances once the fsync returned)."""
+        emb = np.ascontiguousarray(np.asarray(emb, np.float32))
+        labels = np.asarray(labels, np.int32)
+        if emb.ndim != 2 or emb.shape[1] != self.dim \
+                or emb.shape[0] != labels.shape[0]:
+            raise ValueError(f"stage chunk shape mismatch: emb {emb.shape} "
+                             f"labels {labels.shape} dim {self.dim}")
+        raw = emb.tobytes()
+        line = json.dumps({
+            "kind": "stage", "start": int(start), "n": int(emb.shape[0]),
+            "dim": self.dim, "labels": [int(v) for v in labels],
+            "emb": base64.b64encode(raw).decode("ascii"),
+            "crc32": binascii.crc32(raw) & 0xFFFFFFFF, "ts": time.time(),
+        })
+        fault = self._faults.on_stage() if self._faults is not None else None
+        if fault == "crash":
+            raise InjectedCrashError("crash before stage chunk append")
+        if fault == "torn":
+            self._append_line(line[:max(1, len(line) // 2)], newline=False)
+            raise InjectedCrashError("torn stage chunk append")
+        self._append_line(line)
+        self._chunks[int(start)] = (emb, labels)
+        self.watermark = _coverage(self._chunks)
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROLLOUT_STAGE_CHUNKS)
+            self.metrics.set_gauge(mn.ROLLOUT_STAGED_ROWS, self.watermark)
+
+    def arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """The staged set up to the watermark as (emb, labels)."""
+        emb = np.zeros((self.watermark, self.dim), np.float32)
+        labels = np.zeros((self.watermark,), np.int32)
+        for start in sorted(self._chunks):
+            c_emb, c_lab = self._chunks[start]
+            if start >= self.watermark:
+                continue
+            end = min(self.watermark, start + c_emb.shape[0])
+            emb[start:end] = c_emb[:end - start]
+            labels[start:end] = c_lab[:end - start]
+        return emb, labels
+
+    def discard(self) -> None:
+        """Delete the progress journal — ONLY after the post-cutover
+        checkpoint landed (until then, recovery needs this file to
+        complete a fenced-but-uncheckpointed cutover)."""
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
+class DualScoreParity:
+    """Old-vs-new embedder agreement over a sliding window of live
+    queries (module docstring). Pure host math on the galleries' f32
+    truth — it runs on the rollout thread, never the hot path."""
+
+    def __init__(self, old_embed_fn: Callable[[np.ndarray], np.ndarray],
+                 new_embed_fn: Callable[[np.ndarray], np.ndarray],
+                 threshold: float = 0.98, min_samples: int = 32,
+                 window: int = 512, metrics=None):
+        self.old_embed_fn = old_embed_fn
+        self.new_embed_fn = new_embed_fn
+        self.threshold = float(threshold)
+        self.min_samples = int(min_samples)
+        self.metrics = metrics
+        self._agreements: deque = deque(maxlen=int(window))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _top1(queries: np.ndarray, rows: np.ndarray,
+              labels: np.ndarray) -> np.ndarray:
+        """Top-1 gallery LABEL per query (lowest-index tie-break, like
+        the serving kernels); -1 when the gallery side is empty."""
+        if rows.shape[0] == 0 or queries.shape[0] == 0:
+            return np.full((queries.shape[0],), -1, np.int64)
+        sims = queries @ rows.T
+        return labels[np.argmax(sims, axis=1)]
+
+    def score(self, crops: np.ndarray, old_rows: np.ndarray,
+              old_labels: np.ndarray, new_rows: np.ndarray,
+              new_labels: np.ndarray) -> int:
+        """Score one batch of query crops through BOTH embedders against
+        their respective galleries; returns samples recorded."""
+        crops = np.asarray(crops, np.float32)
+        if crops.ndim == 2:
+            crops = crops[None]
+        old_q = _l2norm(np.asarray(self.old_embed_fn(crops), np.float32))
+        new_q = _l2norm(np.asarray(self.new_embed_fn(crops), np.float32))
+        old_top = self._top1(old_q, old_rows, old_labels)
+        new_top = self._top1(new_q, new_rows, new_labels)
+        with self._lock:
+            for a, b in zip(old_top, new_top):
+                self._agreements.append(1.0 if (a == b and a >= 0) else 0.0)
+            samples = len(self._agreements)
+            agreement = (sum(self._agreements) / samples) if samples else 0.0
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.ROLLOUT_PARITY_SAMPLES, samples)
+            self.metrics.set_gauge(mn.ROLLOUT_PARITY_AGREEMENT,
+                                   round(agreement, 4))
+        return int(old_top.shape[0])
+
+    @property
+    def samples(self) -> int:
+        with self._lock:
+            return len(self._agreements)
+
+    @property
+    def agreement(self) -> float:
+        with self._lock:
+            if not self._agreements:
+                return 0.0
+            return sum(self._agreements) / len(self._agreements)
+
+    @property
+    def disagreement(self) -> float:
+        """1 - agreement once the window has data; 0.0 below the sample
+        floor (no data is not a breach — the SLO gauge contract)."""
+        with self._lock:
+            n = len(self._agreements)
+            if n < self.min_samples:
+                return 0.0
+            return 1.0 - sum(self._agreements) / n
+
+    def ok(self) -> bool:
+        with self._lock:
+            n = len(self._agreements)
+            return (n >= self.min_samples
+                    and sum(self._agreements) / n >= self.threshold)
+
+
+class RolloutCoordinator:
+    """Drives one embedder rollout end to end (module docstring):
+    background staged re-embed with durable resume, the dual-score
+    parity window over live traffic, and the gated atomic cutover.
+
+    ``reembed_fn(rows) -> rows'`` maps the OLD gallery's (normalized,
+    host-truth) rows into the new embedder's space — in production the
+    fine-tuned model re-extracting from the enrollment source store, in
+    the chaos harness a fixed linear map. It must be deterministic over
+    its input: a crash-resumed chunk re-stages from the same source rows
+    and must reproduce the same bytes. ``old_embed_fn``/``new_embed_fn``
+    embed live QUERY crops for the parity window (both optional — without
+    them the parity gate never opens and cutover needs ``force=True``).
+    """
+
+    def __init__(self, state: StateLifecycle, gallery,
+                 reembed_fn: Callable[[np.ndarray], np.ndarray],
+                 to_version: int, *,
+                 old_embed_fn: Optional[Callable] = None,
+                 new_embed_fn: Optional[Callable] = None,
+                 parity_threshold: float = 0.98,
+                 parity_min_samples: int = 32,
+                 parity_window: int = 512,
+                 chunk_rows: int = 256,
+                 live_sample_interval_s: float = 0.05,
+                 face_size: Optional[Tuple[int, int]] = None,
+                 metrics=None, tracer=None, fault_injector=None):
+        self.state = state
+        self.gallery = gallery
+        self.reembed_fn = reembed_fn
+        self.to_version = int(to_version)
+        self.from_version = int(getattr(gallery, "embedder_version", 1))
+        if self.to_version <= self.from_version:
+            raise ValueError(
+                f"to_version {to_version} must exceed the serving version "
+                f"{self.from_version} (versions are monotonic; a rollback "
+                f"is a NEW version whose space equals the prior one)")
+        self.chunk_rows = max(1, int(chunk_rows))
+        self.metrics = metrics
+        self.tracer = tracer
+        self.face_size = face_size
+        # Kept verbatim so rollback() can clone the FULL configuration
+        # (the parity deque only remembers its maxlen indirectly).
+        self._parity_window = int(parity_window)
+        self._fault_injector = fault_injector
+        self.stage = ReEmbedStage(state.state_dir, self.to_version,
+                                  dim=int(gallery.dim),
+                                  from_version=self.from_version,
+                                  metrics=metrics,
+                                  fault_injector=fault_injector)
+        self.parity = (DualScoreParity(old_embed_fn, new_embed_fn,
+                                       threshold=parity_threshold,
+                                       min_samples=parity_min_samples,
+                                       window=parity_window, metrics=metrics)
+                       if old_embed_fn is not None
+                       and new_embed_fn is not None else None)
+        self._phase = "idle"
+        self._live_q: deque = deque(maxlen=64)
+        self._live_lock = threading.Lock()
+        self._live_interval_s = float(live_sample_interval_s)
+        self._last_live_t = 0.0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # reembed_fn comes in two shapes: ``fn(rows)`` (a space-to-space
+        # map — the chaos harness's linear transform) and
+        # ``fn(rows, start)`` (a source-store re-extract that needs the
+        # row indices — ``TheTrainer.make_reembed_fn``). Sniffed once.
+        try:
+            import inspect
+
+            self._reembed_wants_start = len(
+                inspect.signature(reembed_fn).parameters) >= 2
+        except (TypeError, ValueError):
+            self._reembed_wants_start = False
+        self._set_phase("idle")
+
+    def _reembed(self, rows: np.ndarray, start: int) -> np.ndarray:
+        if self._reembed_wants_start:
+            return self.reembed_fn(rows, start)
+        return self.reembed_fn(rows)
+
+    # ---- phase bookkeeping ----
+
+    def _set_phase(self, phase: str) -> None:
+        self._phase = phase
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.ROLLOUT_PHASE, PHASE_CODES[phase])
+            self.metrics.set_gauge(mn.ROLLOUT_TOTAL_ROWS,
+                                   int(self.gallery.size))
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "rollout_phase",
+                             topic=LIFECYCLE_TOPIC, phase=phase,
+                             to_version=self.to_version,
+                             staged=self.stage.watermark,
+                             total=int(self.gallery.size))
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    @property
+    def caught_up(self) -> bool:
+        return self.stage.watermark >= int(self.gallery.size)
+
+    # ---- staged re-embed ----
+
+    def run_stage_step(self) -> bool:
+        """Stage one chunk of not-yet-re-embedded rows; returns True when
+        a chunk was staged (False = caught up). Reads the gallery's host
+        truth via ``snapshot()`` — source rows are append-only, so a
+        chunk staged from one snapshot stays valid forever."""
+        emb, lab, _val, size = self.gallery.snapshot()
+        start = self.stage.watermark
+        if start >= size:
+            return False
+        if self._phase in ("idle", "done"):
+            self._set_phase("staging")
+        end = min(size, start + self.chunk_rows)
+        new_rows = _l2norm(self._reembed(emb[start:end], start))
+        if new_rows.shape != (end - start, self.stage.dim):
+            raise RolloutStateError(
+                f"reembed_fn returned {new_rows.shape}, expected "
+                f"{(end - start, self.stage.dim)}")
+        self.stage.stage_chunk(start, new_rows, lab[start:end])
+        return True
+
+    def run_stage(self, max_chunks: Optional[int] = None) -> int:
+        """Stage until caught up (or ``max_chunks``); returns chunks
+        staged. The synchronous form — chaos kills land mid-loop."""
+        staged = 0
+        while (max_chunks is None or staged < max_chunks):
+            if not self.run_stage_step():
+                break
+            staged += 1
+        if self.caught_up and self._phase in ("idle", "staging"):
+            self._set_phase("parity" if self.parity is not None else "ready")
+        return staged
+
+    # ---- the rollout thread ----
+
+    def start(self) -> None:
+        """Run staging + parity scoring on a background daemon thread —
+        the serving loop never pays for a re-embed."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ocvf-rollout")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                progressed = self.run_stage_step()
+                if self.caught_up and self._phase == "staging":
+                    self._set_phase("parity" if self.parity is not None
+                                    else "ready")
+                self._drain_live()
+                if (self._phase == "parity" and self.parity is not None
+                        and self.parity.ok()):
+                    self._set_phase("ready")
+            except InjectedCrashError:
+                raise  # simulated kill: the thread dies like the process
+            except Exception:  # noqa: BLE001 — staging must not die silently
+                logger.exception("rollout background step failed")
+                if self.metrics is not None:
+                    self.metrics.incr(mn.ROLLOUT_STAGE_ERRORS)
+                progressed = False
+            if not progressed:
+                self._stop.wait(timeout=0.02)
+
+    # ---- dual-score parity over live traffic ----
+
+    def offer_live(self, frame: np.ndarray, faces: List[Dict[str, Any]]) -> None:
+        """Publish-path hook (``RecognizerService._publish``): sample the
+        best detected face crop, rate-limited, COPIED (the frame lives in
+        a recycled staging buffer), onto the rollout thread's queue.
+        Cheap and non-blocking by contract — the hot path pays one clock
+        read in the common (not-due) case."""
+        if self.parity is None or not faces:
+            return
+        now = time.monotonic()
+        if now - self._last_live_t < self._live_interval_s:
+            return
+        self._last_live_t = now
+        best = max(faces, key=lambda f: f.get("detection_score", 0.0))
+        x0, y0, x1, y1 = (int(round(v)) for v in best["box"])
+        h, w = frame.shape[:2]
+        y0, y1 = max(0, y0), min(h, y1)
+        x0, x1 = max(0, x0), min(w, x1)
+        if y1 - y0 < 4 or x1 - x0 < 4:
+            return
+        with self._live_lock:
+            self._live_q.append(frame[y0:y1, x0:x1].copy())
+
+    def _drain_live(self) -> None:
+        with self._live_lock:
+            crops = list(self._live_q)
+            self._live_q.clear()
+        if crops:
+            self.score_parity(crops)
+
+    def score_parity(self, crops) -> int:
+        """Score query crops through both embedders (the rollout thread's
+        path for live samples; tests and the chaos harness call it
+        directly with synthetic traffic). No-op (0) until the stage has
+        rows to match against."""
+        if self.parity is None or self.stage.watermark == 0:
+            return 0
+        if self.face_size is not None:
+            from opencv_facerecognizer_tpu.ops import image as image_ops
+
+            crops = [np.asarray(image_ops.resize(np.asarray(c, np.float32),
+                                                 self.face_size))
+                     for c in crops]
+        batch = np.stack([np.asarray(c, np.float32) for c in crops])
+        old_emb, old_lab, _val, size = self.gallery.snapshot()
+        new_rows, new_labels = self.stage.arrays()
+        return self.parity.score(batch, old_emb[:size], old_lab[:size],
+                                 new_rows, new_labels)
+
+    def parity_ok(self) -> bool:
+        return self.parity is not None and self.parity.ok()
+
+    # ---- the gated atomic cutover ----
+
+    def cutover(self, force: bool = False) -> int:
+        """Atomic fleet cutover (module docstring): gate -> locked
+        finalize (stage the enrollment delta durably) -> WAL fence ->
+        epoch-fenced install -> forced checkpoint. Returns the fence
+        record's WAL sequence. Raises ``RolloutGateError`` when the stage
+        is far behind or the parity window has not cleared its threshold
+        (``force`` overrides both — and is required when no parity
+        embedders were wired)."""
+        if not force:
+            reasons = []
+            if not self.caught_up:
+                reasons.append(f"stage watermark {self.stage.watermark} < "
+                               f"gallery size {int(self.gallery.size)}")
+            if self.parity is None:
+                reasons.append("no parity window wired (old/new embed fns)")
+            elif not self.parity.ok():
+                reasons.append(
+                    f"parity gate not met: agreement "
+                    f"{self.parity.agreement:.4f} over "
+                    f"{self.parity.samples} samples (need >= "
+                    f"{self.parity.threshold:g} over >= "
+                    f"{self.parity.min_samples})")
+            if reasons:
+                if self.metrics is not None:
+                    self.metrics.incr(mn.ROLLOUT_CUTOVER_BLOCKED)
+                raise RolloutGateError("cutover refused: "
+                                       + "; ".join(reasons))
+        # Stop the background staging/parity thread BEFORE the locked
+        # finalize: ReEmbedStage is single-writer by contract, and the
+        # thread's run_stage_step would otherwise race build()'s own
+        # stage_chunk/arrays on the chunk map (and could even re-create a
+        # headerless stage file after discard()).
+        self.stop()
+
+        def build() -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+            # Runs under the lifecycle's enroll lock: no enrollment can
+            # land between the delta re-embed and the install, so the
+            # staged set covers EXACTLY the gallery being swapped.
+            emb, lab, _val, size = self.gallery.snapshot()
+            while self.stage.watermark < size:
+                start = self.stage.watermark
+                end = min(size, start + self.chunk_rows)
+                rows = _l2norm(self._reembed(emb[start:end], start))
+                self.stage.stage_chunk(start, rows, lab[start:end])
+            new_emb, new_lab = self.stage.arrays()
+            capacity = max(int(self.gallery.capacity), size)
+            emb_full = np.zeros((capacity, self.stage.dim), np.float32)
+            emb_full[:size] = new_emb[:size]
+            lab_full = np.full((capacity,),
+                               int(getattr(self.gallery, "labels_pad", -1)),
+                               np.int32)
+            lab_full[:size] = new_lab[:size]
+            val_full = np.zeros((capacity,), bool)
+            val_full[:size] = True
+            return emb_full, lab_full, val_full, size
+
+        self._set_phase("cutover")
+        seq = self.state.perform_cutover(self.to_version, build)
+        # Forced checkpoint: the cutover is fence-durable already (a crash
+        # here recovers INTO the new version from the stage); the
+        # checkpoint makes it cheap (no stage replay) and lets replicas
+        # re-anchor. The stage file is discarded only once it lands.
+        if self.state.checkpoint_now(wait=True):
+            self.stage.discard()
+        else:
+            self.state.maybe_checkpoint(force=True)
+            logger.warning(
+                "post-cutover checkpoint did not land; the stage file is "
+                "retained and the forced-checkpoint latch will retry")
+        self._set_phase("done")
+        return seq
+
+    def rollback(self, reembed_fn: Callable[[np.ndarray], np.ndarray],
+                 **overrides) -> "RolloutCoordinator":
+        """Rollback is the SAME mechanism pointed at the prior space: a
+        fresh coordinator whose ``reembed_fn`` maps the rolled-out rows
+        back into the previous embedder's space, at the next monotonic
+        version (versions never reuse numbers — the fence stays
+        unambiguous in the WAL). Stage -> parity -> cutover apply
+        unchanged; the returned coordinator is NOT started."""
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROLLOUT_ROLLBACKS)
+        kwargs: Dict[str, Any] = dict(
+            parity_threshold=(self.parity.threshold
+                              if self.parity is not None else 0.98),
+            parity_min_samples=(self.parity.min_samples
+                                if self.parity is not None else 32),
+            parity_window=self._parity_window,
+            chunk_rows=self.chunk_rows, metrics=self.metrics,
+            tracer=self.tracer, face_size=self.face_size,
+            live_sample_interval_s=self._live_interval_s,
+            fault_injector=self._fault_injector)
+        if self.parity is not None:
+            # The parity pair swaps roles: the NEW serving embedder is the
+            # one being rolled back FROM.
+            kwargs["old_embed_fn"] = self.parity.new_embed_fn
+            kwargs["new_embed_fn"] = self.parity.old_embed_fn
+        kwargs.update(overrides)
+        return RolloutCoordinator(self.state, self.gallery, reembed_fn,
+                                  self.to_version + 1, **kwargs)
+
+    # ---- observability ----
+
+    def status(self) -> Dict[str, Any]:
+        """JSON-able snapshot for ``GET /rollout`` and the chaos report."""
+        out = {
+            "phase": self._phase,
+            "from_version": self.from_version,
+            "to_version": self.to_version,
+            "staged_rows": self.stage.watermark,
+            "total_rows": int(self.gallery.size),
+            "caught_up": self.caught_up,
+            "stage_resumed": self.stage.resumed,
+            "parity": None,
+        }
+        if self.parity is not None:
+            out["parity"] = {
+                "samples": self.parity.samples,
+                "agreement": round(self.parity.agreement, 4),
+                "threshold": self.parity.threshold,
+                "min_samples": self.parity.min_samples,
+                "ok": self.parity.ok(),
+            }
+        return out

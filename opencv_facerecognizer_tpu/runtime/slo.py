@@ -211,6 +211,33 @@ def replication_lag_objective(replica, rows_bound: float = 1024.0,
                bound=float(rows_bound), short_s=short_s, long_s=long_s)
 
 
+def rollout_parity_objective(coordinator, min_agreement: float = 0.98,
+                             short_s: float = 60.0,
+                             long_s: float = 600.0) -> SLO:
+    """Gauge objective over a rollout's dual-score DISAGREEMENT fraction
+    (``runtime.rollout.RolloutCoordinator`` — old vs new embedder top-1
+    identity agreement on live traffic): warn once disagreement crosses
+    the budget ``1 - min_agreement``, critical at 6x. Below the parity
+    window's sample floor the gauge reads 0 (no data is not a breach —
+    the same contract every gauge objective keeps), so an idle rollout
+    never alarms; a rollout whose new embedder actually disagrees on
+    live identities alarms BEFORE anyone forces the cutover. Takes any
+    object with a ``parity`` attribute exposing ``disagreement`` — this
+    module deliberately does not import the rollout (which imports the
+    state store beside us)."""
+    budget = 1.0 - float(min_agreement)
+    if not budget > 0:
+        raise ValueError("min_agreement must be < 1.0 (a zero "
+                         "disagreement budget can never be scored)")
+
+    def value() -> float:
+        parity = getattr(coordinator, "parity", None)
+        return float(parity.disagreement) if parity is not None else 0.0
+
+    return SLO(name="rollout_parity", kind="gauge", value_fn=value,
+               bound=budget, short_s=short_s, long_s=long_s)
+
+
 class SLOMonitor:
     """Evaluate a set of ``SLO`` objectives on a fixed interval and run
     the health state machine over them (module docstring)."""

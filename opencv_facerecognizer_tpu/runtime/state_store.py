@@ -97,6 +97,16 @@ class CheckpointVersionError(ValueError):
     enrollments on rollback. Scans skip past it non-destructively."""
 
 
+class EmbedderVersionMismatchError(ValueError):
+    """An enrollment stamped with one embedder version tried to land in a
+    gallery serving another. One served shard set holds exactly one
+    version (``runtime.rollout``'s fencing invariant) — mixing spaces
+    row-wise would silently corrupt every published score against the
+    mixed rows. Fails CLOSED before any WAL sequence is burned: the
+    caller must route the enrollment through the rollout's staged
+    re-embed, or wait for the cutover to land."""
+
+
 def _encode_checkpoint(header: Dict[str, Any], payload: bytes) -> bytes:
     """``MAGIC + u32 header_len + header_json + sha256(header_json) +
     payload``. The raw 32-byte header digest covers the HEADER bytes —
@@ -504,11 +514,16 @@ class EnrollmentWAL(RotatingJournal):
 
     def append_enroll(self, seq: int, embeddings: np.ndarray,
                       labels: np.ndarray, subject: Optional[str] = None,
-                      label: Optional[int] = None) -> None:
+                      label: Optional[int] = None,
+                      embedder_version: int = 1) -> None:
         """Append one enrollment record; raises on write failure (strict)
         or injected crash. The caller acknowledges the enrollment only
         after this returns — with ``fsync="always"`` that acknowledgment
-        is a durability promise."""
+        is a durability promise. ``embedder_version`` stamps the embedding
+        space the rows live in (the rollout fencing key: replay, replicas
+        and the offline verifier all refuse to apply a row to a gallery
+        serving a different version; pre-rollout records without the field
+        read as version 1)."""
         emb = np.ascontiguousarray(np.asarray(embeddings, np.float32))
         labels = np.asarray(labels, np.int32)
         if emb.ndim != 2 or emb.shape[0] != labels.shape[0]:
@@ -524,6 +539,7 @@ class EnrollmentWAL(RotatingJournal):
             "labels": [int(v) for v in labels],
             "label": None if label is None else int(label),
             "subject": subject,
+            "embedder_version": int(embedder_version),
             "emb": base64.b64encode(raw).decode("ascii"),
             "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
         }
@@ -543,10 +559,31 @@ class EnrollmentWAL(RotatingJournal):
             self.metrics.incr(mn.WAL_APPENDS)
             self.metrics.incr(mn.WAL_ROWS_APPENDED, emb.shape[0])
 
+    def append_cutover(self, seq: int, from_version: int, to_version: int,
+                       rows: int, dim: int) -> None:
+        """Append one embedder-cutover fence record (strict: the in-memory
+        gallery swap is allowed only AFTER this fsyncs — write-ahead, like
+        enrollment). The record marks the exact WAL position where the
+        served embedding space changed: replay/replicas apply rows before
+        it at ``from_version`` and after it at ``to_version``, and a crash
+        between this append and the post-cutover checkpoint is recovered
+        by completing the cutover from the durable staged shard set
+        (``runtime.rollout`` — ``rows``/``dim`` are the completeness check
+        against that stage)."""
+        self.append_line(json.dumps({
+            "kind": "cutover", "seq": int(seq),
+            "from_version": int(from_version),
+            "to_version": int(to_version),
+            "rows": int(rows), "dim": int(dim), "ts": time.time(),
+        }), strict=True)
+        if self.metrics is not None:
+            self.metrics.incr(mn.WAL_CUTOVER_RECORDS)
+
     def scan(self) -> Tuple[List[Dict[str, Any]], int]:
-        """ONE parse of the whole WAL -> (surviving decoded enrollments
-        oldest-first, highest seq in ANY record). The max covers enrolls,
-        aborts, even crc-failed ones whose JSON still parses: the
+        """ONE parse of the whole WAL -> (surviving records oldest-first —
+        decoded enrollments plus raw ``cutover`` fence records, in file
+        order — and the highest seq in ANY record). The max covers
+        enrolls, aborts, even crc-failed ones whose JSON still parses: the
         lifecycle seeds ``_wal_seq`` from it, NOT from surviving
         enrollments — seeding from survivors would reuse an aborted
         record's seq for the next acknowledged enrollment, and the abort
@@ -565,9 +602,15 @@ class EnrollmentWAL(RotatingJournal):
                     aborted.add(int(seq))
         out = []
         for record in records:
-            if record.get("kind") != "enroll":
-                continue
+            kind = record.get("kind")
             seq = record.get("seq")
+            if kind == "cutover" and isinstance(seq, (int, float)):
+                # Version fence: flows through in order so replay and the
+                # tail consumers see exactly where the space changed.
+                out.append(dict(record))
+                continue
+            if kind != "enroll":
+                continue
             if isinstance(seq, (int, float)) and int(seq) in aborted:
                 continue
             decoded = decode_enroll_record(record)
@@ -599,8 +642,10 @@ class EnrollmentWAL(RotatingJournal):
         """Decoded enrollment records oldest-first, with aborted sequences
         (``append_abort`` tombstones) filtered out. Torn lines are already
         skipped by ``records``; a line that parses but fails crc/base64
-        validation is counted ``wal_corrupt_records`` and skipped too."""
-        return iter(self.scan()[0])
+        validation is counted ``wal_corrupt_records`` and skipped too.
+        Cutover fence records are filtered here (version-agnostic
+        consumers); version-aware consumers use ``scan`` directly."""
+        return iter(r for r in self.scan()[0] if r.get("kind") == "enroll")
 
     def truncate_below(self, seq: int) -> None:
         """Compact away records with ``seq`` <= the given sequence (they
@@ -740,6 +785,20 @@ class StateLifecycle:
     def rows_since_checkpoint(self) -> int:
         return self._rows_since_ckpt
 
+    @staticmethod
+    def _gallery_version(gallery) -> int:
+        """The embedder version the attached gallery currently serves
+        (pre-rollout galleries without the attribute read as 1)."""
+        return int(getattr(gallery, "embedder_version", 1))
+
+    @property
+    def embedder_version(self) -> int:
+        """The serving embedder version — read from the live gallery (the
+        one source of truth; checkpoints and WAL rows are stamped from
+        it)."""
+        gallery, _names = self._targets()
+        return self._gallery_version(gallery)
+
     # ---- recovery ----
 
     def recover(self, gallery=None, subject_names: Optional[list] = None) -> Dict[str, Any]:
@@ -759,23 +818,68 @@ class StateLifecycle:
         gallery, names = self._targets()
         report: Dict[str, Any] = {"recovered_checkpoint": None,
                                   "checkpoint_size": 0, "replayed_records": 0,
-                                  "replayed_rows": 0, "skipped_records": 0}
+                                  "replayed_rows": 0, "skipped_records": 0,
+                                  "version_skipped_records": 0}
         with self._enroll_lock:
-            base_seq = self._recover_checkpoint_locked(gallery, names, report)
-            # Quantizer sidecar BEFORE WAL replay: replayed enrollments
-            # then re-drive the same incremental assignments the live
-            # process made against the sidecar's centroids — identical
-            # derived state without a startup k-means.
-            self._restore_quantizer_locked(gallery, base_seq, report)
-            # WAL replay: acknowledged enrollments since that checkpoint
-            # (one scan pass also yields the seq high-water mark).
+            # One scan covers replay AND the pending-cutover probe (a
+            # dim-mismatched checkpoint is only recoverable when a durable
+            # cutover to THIS binary's dim follows it).
             surviving, highest = self.wal.scan()
+            base_seq, current_version, installed = (
+                self._recover_checkpoint_locked(gallery, names, report,
+                                                surviving))
+            # Pending cutover: a ``cutover`` fence past the recovered
+            # checkpoint is the crash window between the cutover append
+            # and the post-cutover checkpoint — the staged shard set is
+            # durable (write-ahead: stage fsyncs before the record), so
+            # recovery COMPLETES the cutover instead of losing it.
+            cutover = self._pending_cutover(surviving, base_seq)
+            effective_base = base_seq
+            if cutover is not None:
+                self._complete_cutover_locked(gallery, names, cutover,
+                                              report)
+                current_version = int(cutover["to_version"])
+                effective_base = int(cutover["seq"])
+            elif not installed and report["recovered_checkpoint"] is None:
+                pass  # empty dir: fresh start at the gallery's version
+            if cutover is None:
+                # Quantizer sidecar BEFORE WAL replay: replayed
+                # enrollments then re-drive the same incremental
+                # assignments the live process made against the sidecar's
+                # centroids — identical derived state without a startup
+                # k-means. Skipped entirely when a cutover was completed:
+                # the sidecar's centroids live in the OLD embedding space.
+                self._restore_quantizer_locked(gallery, base_seq, report)
+            # WAL replay: acknowledged enrollments since the effective
+            # anchor, fenced by embedder version — a row from another
+            # version's space is NEVER applied (it can only arise from a
+            # damaged fence; counted loudly, not mixed in).
             for record in surviving:
+                if record.get("kind") != "enroll":
+                    continue
                 seq = int(record["seq"])
                 if seq <= base_seq:
                     report["skipped_records"] += 1
                     if self.metrics is not None:
                         self.metrics.incr(mn.WAL_SKIPPED_RECORDS)
+                    continue
+                if seq <= effective_base:
+                    # Covered by the completed cutover's staged set: the
+                    # ROWS ride the stage (re-embedded), but the
+                    # label->name map still re-grows from the record.
+                    self._grow_names(names, record)
+                    report["skipped_records"] += 1
+                    continue
+                if int(record.get("embedder_version", 1)) != current_version:
+                    report["version_skipped_records"] += 1
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.ROLLOUT_VERSION_SKIPPED_ROWS,
+                                          int(record["n"]))
+                    logging.getLogger(__name__).error(
+                        "WAL record seq %d carries embedder version %s but "
+                        "recovery landed on version %d — row NOT applied "
+                        "(version fence; a mixed gallery is never served)",
+                        seq, record.get("embedder_version"), current_version)
                     continue
                 gallery.add(record["embeddings"], record["labels_np"])
                 self._grow_names(names, record)
@@ -794,10 +898,17 @@ class StateLifecycle:
         if wait_ready is not None:
             wait_ready(timeout=300.0)
         self._last_ckpt_t = time.monotonic()
+        if cutover is not None:
+            # The completed cutover is in memory + stage only until a
+            # NEW-version checkpoint lands; latch a forced checkpoint so
+            # the next tick makes it durable (and truncates the fenced
+            # WAL prefix).
+            self._force_pending = True
         if self.metrics is not None:
             self.metrics.incr(mn.STATE_RECOVERIES)
             self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         report["gallery_size"] = gallery.size
+        report["embedder_version"] = current_version
         # No (or stale) sidecar: the quantizer retrains in the background
         # (single-flight) while the exact matcher serves — startup never
         # blocks on a k-means.
@@ -845,7 +956,13 @@ class StateLifecycle:
         if (int(header.get("wal_seq", -1)) != int(base_seq)
                 or nlist_drift
                 or int(header.get("seed", -1)) != quantizer.seed
-                or int(header.get("dim", -1)) != gallery.dim):
+                or int(header.get("dim", -1)) != gallery.dim
+                # Derived state is version-bound: centroids trained in one
+                # embedder's space shortlist garbage in another's. The
+                # wal_seq key already fences most cases; this is the
+                # defense-in-depth for a sidecar surviving a cutover.
+                or int(header.get("embedder_version", 1))
+                != self._gallery_version(gallery)):
             logging.getLogger(__name__).info(
                 "quantizer sidecar stale (wal_seq %s vs checkpoint %s); "
                 "will retrain", header.get("wal_seq"), base_seq)
@@ -860,24 +977,102 @@ class StateLifecycle:
             if self.metrics is not None:
                 self.metrics.incr(mn.IVF_SIDECAR_STALE)
 
+    @staticmethod
+    def _pending_cutover(records: List[Dict[str, Any]],
+                         base_seq: int) -> Optional[Dict[str, Any]]:
+        """The NEWEST cutover fence record past the recovered checkpoint,
+        or None. Newest wins: stacked cutovers (a cutover whose forced
+        checkpoint failed, followed by another rollout) each stage the
+        FULL row set, so completing the last one alone is exact."""
+        pending = None
+        for record in records:
+            if (record.get("kind") == "cutover"
+                    and int(record.get("seq", 0)) > base_seq):
+                pending = record
+        return pending
+
+    def _complete_cutover_locked(self, gallery, names,
+                                 cutover: Dict[str, Any],
+                                 report: Dict[str, Any]) -> None:
+        """Finish a cutover whose record is durable but whose post-cutover
+        checkpoint never landed: install the staged shard set
+        (``runtime.rollout``'s stage file — fsync-durable BEFORE the
+        record was appended, by construction) as the whole gallery at the
+        new version. A missing/short stage here can only be media damage;
+        it raises (``RolloutStateError``) rather than mixing versions or
+        silently dropping the acknowledged cutover."""
+        from opencv_facerecognizer_tpu.runtime.rollout import load_stage
+
+        rows = int(cutover["rows"])
+        dim = int(cutover["dim"])
+        to_version = int(cutover["to_version"])
+        if dim != gallery.dim:
+            raise ValueError(
+                f"state dir {self.state_dir!r} holds a pending cutover to "
+                f"dim={dim} but the gallery is dim={gallery.dim} — wrong "
+                f"--state-dir (or wrong model) for completing this rollout?")
+        emb, labels = load_stage(self.state_dir, to_version,
+                                 expect_rows=rows, expect_dim=dim)
+        capacity = max(int(gallery.capacity), rows)
+        emb_full = np.zeros((capacity, dim), np.float32)
+        emb_full[:rows] = emb
+        lab_full = np.full((capacity,), getattr(gallery, "labels_pad", -1),
+                           np.int32)
+        lab_full[:rows] = labels
+        val_full = np.zeros((capacity,), bool)
+        val_full[:rows] = True
+        gallery.load_snapshot(emb_full, lab_full, val_full, rows,
+                              embedder_version=to_version)
+        report["completed_cutover"] = {
+            "seq": int(cutover["seq"]),
+            "from_version": int(cutover.get("from_version", 0)),
+            "to_version": to_version, "rows": rows,
+        }
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROLLOUT_CUTOVERS_COMPLETED_RECOVERY)
+        logging.getLogger(__name__).warning(
+            "completed pending embedder cutover v%s -> v%d from the staged "
+            "shard set (%d rows; the crash landed between the cutover "
+            "record and its checkpoint)", cutover.get("from_version"),
+            to_version, rows)
+
     def _recover_checkpoint_locked(self, gallery, names,
-                                   report: Dict[str, Any]) -> int:
+                                   report: Dict[str, Any],
+                                   wal_records: List[Dict[str, Any]],
+                                   ) -> Tuple[int, int, bool]:
         """Install the newest checkpoint that BOTH checksum-verifies and
         payload-decodes, quarantining + falling back past any that fails
         either test (a checksum-valid payload msgpack rejects is corrupt
         all the same — stopping at it would silently discard every older
-        valid checkpoint and recover WAL-only). Returns the installed
-        checkpoint's ``wal_seq`` (0 when none installed)."""
+        valid checkpoint and recover WAL-only). Returns ``(wal_seq,
+        embedder_version, installed)`` — ``installed`` is False when the
+        newest checkpoint predates a pending dim-changing cutover (its
+        rows are superseded by the staged set; only its ``wal_seq`` and
+        subject names are adopted)."""
         from flax import serialization as flax_serialization
 
         while True:
             loaded = self.store.load_latest()
             if loaded is None:
-                return 0
+                return 0, self._gallery_version(gallery), False
             header, payload, path = loaded
             meta = header.get("meta", {})
             dim = int(meta.get("dim", -1))
+            ckpt_version = int(meta.get("embedder_version", 1))
+            wal_seq = int(meta.get("wal_seq", 0))
             if dim != gallery.dim:
+                pending = self._pending_cutover(wal_records, wal_seq)
+                if (pending is not None
+                        and int(pending.get("dim", -1)) == gallery.dim):
+                    # Old-embedder checkpoint + a durable cutover to THIS
+                    # binary's dim: the caller completes the cutover from
+                    # the staged set — adopt only the names + anchor here.
+                    if names is not None:
+                        names[:] = [str(s) for s
+                                    in meta.get("subject_names", [])]
+                    report["recovered_checkpoint"] = path
+                    report["checkpoint_superseded_by_cutover"] = True
+                    return wal_seq, ckpt_version, False
                 raise ValueError(
                     f"state dir {self.state_dir!r} holds dim={dim} "
                     f"checkpoints but the gallery is dim={gallery.dim} — "
@@ -897,12 +1092,13 @@ class StateLifecycle:
                 self.store.quarantine(path)
                 continue
             size = int(meta.get("size", int(val.sum())))
-            gallery.load_snapshot(emb, lab, val, size)
+            gallery.load_snapshot(emb, lab, val, size,
+                                  embedder_version=ckpt_version)
             if names is not None:
                 names[:] = [str(s) for s in meta.get("subject_names", [])]
             report["recovered_checkpoint"] = path
             report["checkpoint_size"] = size
-            return int(meta.get("wal_seq", 0))
+            return wal_seq, ckpt_version, True
 
     @staticmethod
     def _grow_names(names: Optional[list], record: Dict[str, Any]) -> None:
@@ -924,19 +1120,42 @@ class StateLifecycle:
     def append_enrollment(self, embeddings: np.ndarray, labels: np.ndarray,
                           subject: Optional[str] = None,
                           label: Optional[int] = None,
-                          apply_fn: Optional[Callable[[], None]] = None) -> int:
+                          apply_fn: Optional[Callable[[], None]] = None,
+                          embedder_version: Optional[int] = None) -> int:
         """Write-ahead append + apply: the WAL record lands (fsynced per
         policy) BEFORE ``apply_fn`` mutates the gallery, both under the
         enroll lock, so (a) a crash after the append replays the rows on
         restart, and (b) a concurrent checkpoint can never capture gallery
         rows the WAL hasn't sequenced (its dedup would otherwise double-
         apply them). Returns the record's sequence number; raises when the
-        append fails — the caller must NOT acknowledge the enrollment."""
+        append fails — the caller must NOT acknowledge the enrollment.
+
+        ``embedder_version`` (when the caller knows which embedder
+        produced these rows) is the version FENCE: a mismatch against the
+        gallery's serving version raises ``EmbedderVersionMismatchError``
+        inside the enroll lock, BEFORE any sequence is burned — an
+        enrollment embedded by the outgoing model can never land after
+        the cutover swapped the space under it. The WAL record is always
+        stamped with the serving version it landed in."""
         n = int(np.asarray(labels).shape[0])
         t0 = time.monotonic()
         ok = False
         try:
             with self._enroll_lock:
+                # Version fence, read under the SAME lock the cutover
+                # mutates it under — the check and the append are atomic
+                # against a concurrent swap.
+                gallery, _names = self._targets()
+                gver = self._gallery_version(gallery)
+                if (embedder_version is not None
+                        and int(embedder_version) != gver):
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.ROLLOUT_VERSION_MISMATCHES)
+                    raise EmbedderVersionMismatchError(
+                        f"enrollment embedded by embedder v{embedder_version}"
+                        f" refused: the gallery serves v{gver} — one shard "
+                        f"set never mixes versions; re-embed through the "
+                        f"rollout stage or retry against the new model")
                 # Burn the sequence BEFORE attempting the append: a failed
                 # strict append (fsync raised) may still have landed the
                 # full record bytes — reissuing the seq to the next
@@ -946,7 +1165,8 @@ class StateLifecycle:
                 seq = self._wal_seq = self._wal_seq + 1
                 try:
                     self.wal.append_enroll(seq, embeddings, labels,
-                                           subject=subject, label=label)
+                                           subject=subject, label=label,
+                                           embedder_version=gver)
                 except InjectedCrashError:
                     raise  # simulated kill: no post-mortem writes
                 except BaseException:
@@ -983,14 +1203,19 @@ class StateLifecycle:
         return seq
 
     def stamped_snapshot(self):
-        """(wal_seq, gallery snapshot, subject-names copy) read atomically
-        against enrollments — ``ServiceSupervisor.checkpoint`` pairs its
-        in-memory snapshot with the WAL sequence it covers so a crash
-        restore can replay the acknowledged tail (``replay_tail``)."""
+        """(wal_seq, gallery snapshot, subject-names copy,
+        embedder_version) read atomically against enrollments —
+        ``ServiceSupervisor.checkpoint`` pairs its in-memory snapshot with
+        the WAL sequence it covers so a crash restore can replay the
+        acknowledged tail (``replay_tail``), and with the embedder version
+        the rows live in so the restore re-installs rows AND version in
+        one atomic publish (a snapshot straddling a cutover must never
+        install old-space rows under the new version's stamp)."""
         gallery, names = self._targets()
         with self._enroll_lock:
             return (self._wal_seq, gallery.snapshot(),
-                    list(names) if names is not None else None)
+                    list(names) if names is not None else None,
+                    self._gallery_version(gallery))
 
     def replay_tail(self, from_seq: int) -> int:
         """Re-apply acknowledged WAL records with ``seq > from_seq`` to
@@ -1004,9 +1229,21 @@ class StateLifecycle:
         gallery, names = self._targets()
         rows = 0
         with self._enroll_lock:
+            gver = self._gallery_version(gallery)
             surviving, _highest = self.wal.scan()
             for record in surviving:
+                if record.get("kind") != "enroll":
+                    continue
                 if int(record["seq"]) <= from_seq:
+                    continue
+                if int(record.get("embedder_version", 1)) != gver:
+                    # Version fence: a tail record from another embedder's
+                    # space never lands on this gallery (can only arise
+                    # when the restore snapshot straddles a cutover —
+                    # counted loudly, never mixed in).
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.ROLLOUT_VERSION_SKIPPED_ROWS,
+                                          int(record["n"]))
                     continue
                 gallery.add(record["embeddings"], record["labels_np"])
                 self._grow_names(names, record)
@@ -1014,6 +1251,72 @@ class StateLifecycle:
         if rows and self.metrics is not None:
             self.metrics.incr(mn.WAL_TAIL_REPLAYED_ROWS, rows)
         return rows
+
+    def perform_cutover(self, to_version: int,
+                        build_fn: Callable[[], Tuple[np.ndarray, np.ndarray,
+                                                     np.ndarray, int]]) -> int:
+        """The atomic embedder cutover (``runtime.rollout`` drives this):
+        under the enroll lock — so no enrollment can interleave between
+        the fence and the swap, and no checkpoint can snapshot across it —
+
+        1. ``build_fn()`` finalizes the staged shard set: it re-embeds the
+           last few rows enrolled since the background stage caught up,
+           appends them to the DURABLE stage file (fsync), and returns the
+           full new-space arrays ``(emb_padded, lab, val, size)``;
+        2. the ``cutover`` WAL fence record is appended (strict, fsynced)
+           — write-ahead: from this instant a crash recovers INTO the new
+           version (``_complete_cutover_locked``), never a mix;
+        3. the gallery installs the new arrays + version in one atomic
+           publish (``load_snapshot``), epoch-fenced so in-flight batches
+           keep the old arrays they captured and the IVF quantizer is
+           invalidated (exact matching serves until its background
+           retrain — the derived-state lifecycle rides the swap).
+
+        Returns the fence record's sequence. The caller MUST follow with a
+        forced checkpoint (``checkpoint_now(wait=True)`` /
+        ``maybe_checkpoint(force=True)``) — until it lands, recovery
+        completes the cutover from the stage file, which therefore must
+        not be discarded before the checkpoint succeeds. Read replicas see
+        the fence in the tail and re-anchor on that checkpoint."""
+        gallery, _names = self._targets()
+        t0 = time.monotonic()
+        with self._enroll_lock:
+            from_version = self._gallery_version(gallery)
+            emb, lab, val, size = build_fn()
+            fault = (self._faults.on_cutover()
+                     if self._faults is not None else None)
+            if fault == "crash_before_record":
+                raise InjectedCrashError("crash before cutover record: the "
+                                         "stage is durable, the fleet stays "
+                                         "on the old version")
+            seq = self._wal_seq = self._wal_seq + 1
+            self.wal.append_cutover(seq, from_version, int(to_version),
+                                    rows=int(size), dim=int(emb.shape[1]))
+            if fault == "crash_after_record":
+                raise InjectedCrashError("crash after cutover record, "
+                                         "before the in-memory swap: "
+                                         "recovery must complete the "
+                                         "cutover from the stage")
+            gallery.load_snapshot(emb, lab, val, int(size),
+                                  embedder_version=int(to_version))
+        # Derived state rides the swap: retrain in the background
+        # (single-flight); exact matching serves the interim. Outside the
+        # enroll lock — the poke only flips quantizer staleness flags.
+        poke = getattr(gallery, "_poke_quantizer", None)
+        if poke is not None:
+            poke()
+        if self.metrics is not None:
+            self.metrics.incr(mn.ROLLOUT_CUTOVERS)
+            self.metrics.set_gauge(mn.ROLLOUT_EMBEDDER_VERSION,
+                                   int(to_version))
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "cutover",
+                             topic=LIFECYCLE_TOPIC, t0=t0,
+                             dur=time.monotonic() - t0,
+                             from_version=from_version,
+                             to_version=int(to_version), rows=int(size),
+                             seq=seq)
+        return seq
 
     # ---- checkpointing ----
 
@@ -1114,6 +1417,10 @@ class StateLifecycle:
                 rows_at = self._rows_since_ckpt
                 span.update(wal_seq=wal_seq, rows=rows_at)
                 emb, lab, val, size = gallery.snapshot()
+                # Embedder version captured in the SAME critical section
+                # as the rows it stamps: a checkpoint header can never
+                # claim one version over another version's snapshot.
+                gver = self._gallery_version(gallery)
                 names_copy = [] if names is None else list(names)
                 # IVF sidecar payload captured in the SAME critical
                 # section: its assignments cover exactly the rows this
@@ -1132,6 +1439,7 @@ class StateLifecycle:
                 "dim": int(emb.shape[1]),
                 "subject_names": names_copy,
                 "wal_seq": wal_seq,
+                "embedder_version": gver,
             }
             fault = (self._faults.on_checkpoint()
                      if self._faults is not None else None)

@@ -246,6 +246,104 @@ class TheTrainer:
         gallery.add(emb, np.asarray(labels, np.int32))  # ocvf-lint: boundary=wal-before-mutate -- offline gallery BUILD from training data: the result is persisted wholesale via a checkpoint, not row-by-row enrollment; no WAL exists yet
         return gallery
 
+    # ---- embedder evolution (the live-rollout recipe) ----
+
+    def finetune_embedder(self, images: np.ndarray, labels: np.ndarray, *,
+                          steps: int = 100, identities_per_batch: int = 8,
+                          samples_per_identity: int = 4,
+                          learning_rate: float = 1e-4, margin: float = 0.5,
+                          scale: float = 32.0, seed: int = 0):
+        """Multibatch metric-learning fine-tune (arxiv 1605.07270) of the
+        trained CNN embedder on accumulated enrollments — the model half
+        of a live rollout (``runtime.rollout`` owns the serving half).
+
+        The multibatch recipe: every SGD batch samples ``k`` identities x
+        ``m`` crops each, so all ``(km)² - km`` ordered pairs inside the
+        batch contribute signal per step instead of the uniform sampler's
+        mostly-negative pairs — the paper's variance-reduction argument,
+        and the reason a few hundred steps over a small accumulated
+        enrollment set moves a frozen embedder at all. Training starts
+        FROM the serving model's params (a fine-tune, not a re-train) on
+        a COPY: ``self.model`` — the embedder still serving the fleet —
+        is never touched. Returns the fine-tuned ``CNNEmbedding``; hand
+        it to ``make_reembed_fn`` + a ``RolloutCoordinator`` to roll it
+        out, and roll BACK by pointing the same machinery at the old
+        feature."""
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from opencv_facerecognizer_tpu.models.embedder import (
+            make_train_step, normalize_faces,
+        )
+
+        if self.model is None or not isinstance(self.model.feature,
+                                                CNNEmbedding):
+            raise RuntimeError("finetune_embedder requires a trained cnn "
+                               "model (TheTrainer(model='cnn').train first)")
+        old = self.model.feature
+        x = np.asarray(normalize_faces(
+            np.asarray(images, np.float32), old.input_size))
+        y_raw = np.asarray(labels, np.int32)
+        classes, y = np.unique(y_raw, return_inverse=True)
+        y = y.astype(np.int32)
+        # Clone the architecture; seed params from the SERVING model (a
+        # deep copy — gradients must not alias the live embedder's trees).
+        new_feature = CNNEmbedding(
+            embed_dim=old.embed_dim, input_size=old.input_size,
+            stem_features=old.stem_features,
+            stage_features=old.stage_features,
+            stage_blocks=old.stage_blocks, block=old.block,
+            space_to_depth=old.space_to_depth, norm=old.norm,
+            train_steps=0, seed=old.seed, tta=old.tta)
+        params = jax.tree_util.tree_map(
+            lambda a: jnp.array(np.asarray(a)), dict(old._params))
+        num_classes = max(1, len(classes))
+        if params["head"].shape[0] != num_classes:
+            params = dict(params, head=jax.random.normal(
+                jax.random.PRNGKey(seed + 1),
+                (num_classes, old.embed_dim), dtype=jnp.float32))
+        optimizer = optax.adam(float(learning_rate))
+        opt_state = optimizer.init(params)
+        step = make_train_step(old.net, optimizer, float(margin),
+                               float(scale), augment=False)
+        by_class = [np.flatnonzero(y == c) for c in range(num_classes)]
+        k = min(int(identities_per_batch), num_classes)
+        m = max(1, int(samples_per_identity))
+        rng = np.random.default_rng(seed)
+        key = jax.random.PRNGKey(seed)
+        for i in range(int(steps)):
+            # One multibatch: k identities x m samples (with replacement
+            # inside an identity when it has fewer crops — small enrolled
+            # subjects still contribute full positive-pair counts).
+            ids = rng.choice(num_classes, size=k, replace=False)
+            idx = np.concatenate([
+                rng.choice(by_class[c], size=m,
+                           replace=len(by_class[c]) < m) for c in ids])
+            key, sub = jax.random.split(key)
+            params, opt_state, _loss = step(
+                params, opt_state, jnp.asarray(x[idx]), jnp.asarray(y[idx]),
+                sub, jnp.float32(min(1.0, i / max(1, int(0.1 * steps)))))
+        new_feature.load_params(params)
+        return new_feature
+
+    @staticmethod
+    def make_reembed_fn(feature, source_images: np.ndarray):
+        """The ``RolloutCoordinator.reembed_fn`` for a real fine-tuned
+        embedder: re-EXTRACTS each gallery row's stored source crop with
+        the new model (an embedding in one space cannot be mapped into
+        another without its source — production keeps the enrollment
+        crops exactly for this). ``source_images[i]`` must be row ``i``'s
+        source crop, in gallery row order (append-only, like the rows).
+        Deterministic over its inputs, as the stage's resume contract
+        requires."""
+        def reembed(rows: np.ndarray, start: int) -> np.ndarray:
+            end = start + int(np.asarray(rows).shape[0])
+            crops = np.asarray(source_images[start:end], np.float32)
+            return np.asarray(feature.extract(crops), np.float32)
+
+        return reembed
+
 
 def select_model(
     images: np.ndarray,

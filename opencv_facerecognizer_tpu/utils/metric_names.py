@@ -174,6 +174,46 @@ REPLICATION_ENROLL_REJECTED = "replication_enroll_rejected"
 REPLICATION_LAG_ROWS = "replication_lag_rows"
 REPLICATION_LAG_S = "replication_lag_s"
 
+# ---- embedder rollout (runtime.rollout + the version-fenced state) ---------
+#: rollout phase gauge: 0 idle, 1 staging, 2 parity, 3 ready, 4 cutover,
+#: 5 done (``runtime.rollout.PHASE_CODES``).
+ROLLOUT_PHASE = "rollout_phase"
+#: contiguous re-embedded rows durable in the stage file (the resume
+#: watermark) vs the gallery rows the rollout must cover.
+ROLLOUT_STAGED_ROWS = "rollout_staged_rows"
+ROLLOUT_TOTAL_ROWS = "rollout_total_rows"
+#: dual-score parity window: sliding top-1 agreement of old vs new
+#: embedder on live traffic, and the sample count behind it.
+ROLLOUT_PARITY_AGREEMENT = "rollout_parity_agreement"
+ROLLOUT_PARITY_SAMPLES = "rollout_parity_samples"
+ROLLOUT_STAGE_CHUNKS = "rollout_stage_chunks"
+ROLLOUT_STAGE_RESUMES = "rollout_stage_resumes"
+ROLLOUT_STAGE_ERRORS = "rollout_stage_errors"
+ROLLOUT_CUTOVERS = "rollout_cutovers"
+#: recovery found a fsynced cutover fence with no post-cutover checkpoint
+#: and completed the swap from the staged shard set.
+ROLLOUT_CUTOVERS_COMPLETED_RECOVERY = "rollout_cutovers_completed_recovery"
+ROLLOUT_CUTOVER_BLOCKED = "rollout_cutover_blocked"
+ROLLOUT_ROLLBACKS = "rollout_rollbacks"
+#: the serving embedder version gauge (stamped into checkpoints, WAL rows
+#: and published results; one served shard set holds exactly one).
+ROLLOUT_EMBEDDER_VERSION = "rollout_embedder_version"
+#: version-fence rejections: an enrollment whose embeddings carry another
+#: version than the serving gallery (failed closed, no seq burned).
+ROLLOUT_VERSION_MISMATCHES = "rollout_version_mismatches"
+#: rows a replay/tail consumer REFUSED to apply across the version fence
+#: (can only arise from damaged state — loud, never mixed in).
+ROLLOUT_VERSION_SKIPPED_ROWS = "rollout_version_skipped_rows"
+#: a read replica parked on a cutover fence, waiting for the new-version
+#: checkpoint to re-anchor on (gauge 1/0), and the re-anchors completed.
+ROLLOUT_REPLICA_AWAITING = "rollout_replica_awaiting"
+ROLLOUT_REPLICA_REANCHORS = "rollout_replica_reanchors"
+#: parity/live-traffic observation hook failures (publish path; counted,
+#: never propagated into the serving loop).
+ROLLOUT_OBSERVE_ERRORS = "rollout_observe_errors"
+#: cutover WAL fence records appended.
+WAL_CUTOVER_RECORDS = "wal_cutover_records"
+
 # ---- topic router (runtime.replication.TopicRouter) ------------------------
 ROUTER_ROUTED = "router_routed"
 #: per-reason rejection family: ``router_rejected_<reason>``
@@ -181,6 +221,9 @@ ROUTER_REJECTED_PREFIX = "router_rejected_"
 ROUTER_BUDGET_SPILLS = "router_budget_spills"
 ROUTER_FAILOVERS = "router_failovers"
 ROUTER_RECOVERIES = "router_recoveries"
+#: a replica cordoned (excluded from rendezvous) for a planned drain —
+#: the cutover re-anchor path; distinct from health failover.
+ROUTER_CUTOVER_DRAINS = "router_cutover_drains"
 ROUTER_HEALTH_PROBE_FAILURES = "router_health_probe_failures"
 ROUTER_REPLICAS = "router_replicas"
 ROUTER_HEALTHY_REPLICAS = "router_healthy_replicas"

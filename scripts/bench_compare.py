@@ -103,6 +103,20 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("replica_scaleout") or {})
         .get("scaling", {}).get("x2"),
         "ratio_min", 0.90, 0.0),
+    # Embedder rollout (ISSUE 11): the dual-score parity agreement on the
+    # smoke's identity queries (a candidate quietly degrading old-vs-new
+    # agreement is a rollout-gate regression) and the completed-frames
+    # ratio through the cutover + re-anchor window (the serving-never-
+    # blanks number — the router cordon + epoch-fenced swap must keep it
+    # near 1.0). Artifacts predating the rollout section ride the
+    # baseline-predates-metric skip.
+    "rollout_parity_agreement": (
+        lambda d: (d.get("rollout") or {}).get("parity_agreement"),
+        "ratio_min", 0.98, 0.0),
+    "rollout_cutover_completed_ratio": (
+        lambda d: (d.get("rollout") or {})
+        .get("cutover_window_completed_ratio"),
+        "ratio_min", 0.80, 0.0),
 }
 
 

@@ -1417,13 +1417,542 @@ def run_replication(seconds: float = 6.0, seed: int | None = None,
     return report
 
 
+def run_rollout(seconds: float = 6.0, seed: int | None = None,
+                state_dir: str | None = None) -> dict:
+    """Embedder-rollout scenario (ISSUE 11 acceptance): 1 writer + 2
+    WAL-tailing read replicas behind the topic router serve live traffic
+    while the writer rolls a NEW embedder out — staged background
+    re-embed, dual-score parity window, WAL cutover fence, atomic swap,
+    replica re-anchor — with deterministic kills at every rollout
+    boundary:
+
+    - **kill mid-re-embed** (torn stage append + full writer restart):
+      the restarted writer's coordinator must RESUME from the durable
+      watermark and the fleet stays on the old version, zero acked loss;
+    - **kill mid-cutover** (crash after the WAL fence record, before the
+      in-memory swap/checkpoint): the restarted writer's recovery must
+      COMPLETE the cutover from the staged shard set — the fleet lands on
+      the new version with every acked enrollment re-embedded, zero loss;
+    - **kill a reader mid-re-anchor** (stopped while parked on the
+      fence): its replacement resyncs straight onto the new-version
+      checkpoint (the late-start shape) and matches bit-for-bit.
+
+    Pass criteria (any miss -> ``ok: False``):
+
+    1. **zero acked loss** — after the dust settles, writer, surviving
+       reader and the replacement replica all hold exactly: every
+       pre-cutover acked enrollment RE-EMBEDDED into the new space, plus
+       every post-cutover acked enrollment, in order;
+    2. **no mixed-version scores** — every published result carries the
+       ``embedder_version`` its batch was scored against, and each
+       replica's stamp stream is a clean old->new monotonic step (never
+       interleaved, never any version outside {old, new});
+    3. **serving never blanks** — fleet-wide, the gap between consecutive
+       completed frames through the whole cutover window stays bounded,
+       and every surviving replica keeps completing frames after its
+       re-anchor (the router cordon drained it through the checkpoint
+       reload instead of letting its queue rot);
+    4. **fencing live** — an enrollment stamped with the OLD embedder
+       version after the cutover is refused closed
+       (``EmbedderVersionMismatchError``), and the offline verifier's
+       version walk passes over the final state dir (rc 0).
+    """
+    import random as random_mod
+    import threading
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        EmbedderVersionMismatchError, FakeConnector, FaultInjector,
+        ReadReplica, RecognizerService, ReplicaHandle, ResiliencePolicy,
+        RolloutCoordinator, StateLifecycle, TopicRouter, WriterLease,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, TrafficRecorder,
+    )
+    from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+    from opencv_facerecognizer_tpu.runtime.recognizer import RESULT_TOPIC
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        service_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak rollout seed={seed} seconds={seconds}",
+          file=sys.stderr)
+    rng = random_mod.Random(seed)
+    frame_rng = np.random.default_rng(seed)
+
+    temp_dir = state_dir is None
+    if temp_dir:
+        state_dir = tempfile.mkdtemp(prefix="ocvf_rollout_")
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 16, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.1)
+    mesh = make_mesh()
+    DIM = 8
+    frame_shape = (32, 32)
+    dispatch_s = 0.01
+    offered_hz = 50.0
+    topics = 12
+    OLD_V, NEW_V = 1, 2
+
+    # The two embedding spaces: old = the row itself; new = a fixed
+    # orthogonal rotation of it (seeded — deterministic across the
+    # scenario's restarts, as the stage-resume contract requires). The
+    # parity embedders map a synthetic "crop" (an identity's code folded
+    # to 2x4) into each space the same way.
+    Q, _ = np.linalg.qr(frame_rng.normal(size=(DIM, DIM)))
+    Q = Q.astype(np.float32)
+
+    def reembed(rows):
+        return np.asarray(rows, np.float32) @ Q
+
+    def old_embed(crops):
+        return np.asarray(crops, np.float32).reshape(len(crops), -1)[:, :DIM]
+
+    def new_embed(crops):
+        return old_embed(crops) @ Q
+
+    report = {"scenario": "rollout", "seed": seed, "seconds": seconds,
+              "state_dir": state_dir, "ok": False}
+    failures: list = []
+
+    #: acked enrollments: (version_at_ack, emb, labels, subject, label)
+    acked: list = []
+
+    def expected_rows(current_version):
+        """Every acked row in the CURRENT version's space: pre-cutover
+        rows re-embedded through Q, post-cutover rows as enrolled."""
+        if not acked:
+            return (np.zeros((0, DIM), np.float32),
+                    np.zeros((0,), np.int32))
+        embs, labs = [], []
+        for ver, emb, labels, _su, _la in acked:
+            norm = emb / np.maximum(
+                np.linalg.norm(emb, axis=-1, keepdims=True), 1e-12)
+            if current_version == NEW_V and ver == OLD_V:
+                norm = norm @ Q
+                norm = norm / np.maximum(
+                    np.linalg.norm(norm, axis=-1, keepdims=True), 1e-12)
+            embs.append(norm)
+            labs.append(labels)
+        return (np.concatenate(embs).astype(np.float32),
+                np.concatenate(labs).astype(np.int32))
+
+    def verify_gallery(gallery, current_version, where):
+        want_emb, want_lab = expected_rows(current_version)
+        got_emb, got_lab, _v, got_size = gallery.snapshot()
+        if got_size != len(want_lab):
+            failures.append(f"{where}: {got_size} rows, expected "
+                            f"{len(want_lab)} acked (seed={seed})")
+            return
+        if got_size and not np.array_equal(got_lab[:got_size], want_lab):
+            failures.append(f"{where}: labels differ")
+        elif got_size and not np.allclose(got_emb[:got_size], want_emb,
+                                          rtol=0, atol=1e-5):
+            failures.append(f"{where}: embeddings differ")
+
+    def make_service(gallery, metrics):
+        pipe = InstantPipeline(frame_shape, dispatch_s=dispatch_s,
+                               faces_per_frame=1)
+        pipe.gallery = gallery
+        return RecognizerService(
+            pipe, FakeConnector(), batch_size=8, frame_shape=frame_shape,
+            flush_timeout=0.02, inflight_depth=2, similarity_threshold=0.0,
+            metrics=metrics,
+            resilience=ResiliencePolicy(readback_deadline_s=2.0))
+
+    # ---- fleet: writer + 2 readers + router ----
+    injector = FaultInjector(seed=seed)
+    writer_metrics = Metrics()
+    lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+    writer_gallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh,
+                                    embedder_version=OLD_V)
+    writer_names: list = []
+    state = StateLifecycle(state_dir, metrics=writer_metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9,
+                           fault_injector=injector, tracer=tracer)
+    state.bind(writer_gallery, writer_names)
+    writer_box = {"svc": make_service(writer_gallery, writer_metrics)}
+
+    def enroll_burst(n):
+        """Synchronous acked enrollments in the CURRENT serving space
+        (after the cutover the 'new model' produces new-space vectors
+        directly). Deterministic — the kill schedule owns all timing."""
+        for _ in range(n):
+            rows = rng.randint(1, 2)
+            emb = frame_rng.normal(size=(rows, DIM)).astype(np.float32)
+            label = len(writer_names)
+            subject = f"subject_{len(acked)}"
+            labels = np.full(rows, label, np.int32)
+            version = int(writer_gallery.embedder_version)
+            writer_names.append(subject)
+            state.append_enrollment(
+                emb, labels, subject=subject, label=label,
+                embedder_version=version,
+                apply_fn=lambda e=emb, l=labels: writer_gallery.add(e, l))
+            acked.append((version, emb, labels, subject, label))
+
+    enroll_burst(4)
+
+    readers = []
+    for i in range(2):
+        rmetrics = Metrics()
+        rgallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh)
+        rnames: list = []
+        rep = ReadReplica(state_dir, rgallery, rnames, metrics=rmetrics,
+                          tracer=tracer, poll_interval_s=0.02,
+                          name=f"reader-{i}")
+        rep.poll(force=True)
+        readers.append({"replica": rep, "gallery": rgallery,
+                        "names": rnames, "metrics": rmetrics,
+                        "svc": None})
+        readers[i]["svc"] = RecognizerService(
+            InstantPipeline(frame_shape, dispatch_s=dispatch_s,
+                            faces_per_frame=1),
+            FakeConnector(), batch_size=8, frame_shape=frame_shape,
+            flush_timeout=0.02, inflight_depth=2,
+            similarity_threshold=0.0, metrics=rmetrics,
+            resilience=ResiliencePolicy(readback_deadline_s=2.0),
+            replica=rep)
+        readers[i]["svc"].pipeline.gallery = rgallery
+
+    router_metrics = Metrics()
+    handles = [ReplicaHandle(
+        "writer", writer_box["svc"].connector,
+        health_fn=lambda: service_health_probe(writer_box["svc"])(),
+        writer=True)]
+    for i, reader in enumerate(readers):
+        handles.append(ReplicaHandle(
+            f"reader-{i}", reader["svc"].connector,
+            health_fn=service_health_probe(reader["svc"])))
+    router = TopicRouter(handles, metrics=router_metrics, tracer=tracer,
+                         health_interval_s=0.05)
+    # Cordon choreography: each reader's re-anchor drains its topics to
+    # peers through the checkpoint reload (the never-blanks contract).
+    for i, reader in enumerate(readers):
+        reader["replica"].on_resync = router.cordon_hook(f"reader-{i}")
+    recorder = TrafficRecorder(router)
+    frame_msg = encode_frame(np.zeros(frame_shape, np.float32))
+
+    #: per-replica-name published (monotonic time, embedder_version)
+    #: stamps — the no-mixed-scores evidence.
+    stamps: dict = {"writer": [], "reader-0": [], "reader-1": []}
+    stamp_lock = threading.Lock()
+
+    def watch_stamps(name, connector):
+        def on_result(_t, message, _name=name):
+            ver = message.get("embedder_version")
+            if ver is not None:
+                with stamp_lock:
+                    stamps[_name].append((time.monotonic(), int(ver)))
+
+        connector.subscribe(RESULT_TOPIC, on_result)
+
+    watch_stamps("writer", writer_box["svc"].connector)
+    for i, reader in enumerate(readers):
+        watch_stamps(f"reader-{i}", reader["svc"].connector)
+
+    seq_box = {"seq": 0}
+
+    def pump(duration_s):
+        """Offer interactive frames across the topic set for a while —
+        traffic flows through EVERY phase, kills included."""
+        interval = 1.0 / offered_hz
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            seq = seq_box["seq"]
+            seq_box["seq"] = seq + 1
+            recorder.send_t[seq] = time.monotonic()
+            router.publish(f"camera/{seq % topics}",
+                           {**frame_msg, "priority": "interactive",
+                            "meta": {"seq": seq}})
+            time.sleep(interval)
+
+    def restart_writer(where):
+        """Full writer 'process' restart: stop, drop the lease, recover a
+        fresh gallery/lifecycle from disk, re-acquire, rewire the
+        router."""
+        nonlocal lease, state, writer_gallery, writer_names
+        writer_box["svc"].stop()
+        lease.release()
+        state.close()
+        new_gallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh)
+        new_names: list = []
+        lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+        state = StateLifecycle(state_dir, metrics=writer_metrics,
+                               checkpoint_wal_rows=1 << 30,
+                               checkpoint_every_s=1e9,
+                               fault_injector=injector, tracer=tracer)
+        recovery = state.recover(new_gallery, new_names)
+        writer_gallery = new_gallery
+        writer_names = new_names
+        new_svc = make_service(new_gallery, writer_metrics)
+        new_svc.start(warmup=False)
+        writer_box["svc"] = new_svc
+        router.replace_connector("writer", new_svc.connector)
+        watch_stamps("writer", new_svc.connector)
+        verify_gallery(new_gallery,
+                       int(recovery.get("embedder_version", OLD_V)),
+                       f"writer recovery ({where})")
+        return recovery
+
+    phase_t = {}
+    try:
+        writer_box["svc"].start(warmup=False)
+        for reader in readers:
+            reader["svc"].start(warmup=False)
+        router.start()
+
+        # ---- phase A: steady state on the old embedder ----
+        pump(max(0.5, seconds * 0.15))
+        enroll_burst(3)
+
+        # ---- phase B: staged re-embed, killed mid-chunk ----
+        coordinator = RolloutCoordinator(
+            state, writer_gallery, reembed, NEW_V,
+            old_embed_fn=old_embed, new_embed_fn=new_embed,
+            parity_min_samples=8, parity_threshold=0.95, chunk_rows=3,
+            metrics=writer_metrics, tracer=tracer, fault_injector=injector)
+        coordinator.run_stage(max_chunks=2)  # some durable progress first
+        if coordinator.stage.watermark <= 0:
+            failures.append("stage made no durable progress before the "
+                            "scripted kill")
+        injector.script("stage", "torn")
+        killed_mid_stage = False
+        try:
+            coordinator.run_stage(max_chunks=2)
+        except InjectedCrashError:
+            killed_mid_stage = True
+        if not killed_mid_stage:
+            failures.append("scripted stage kill never fired")
+        watermark_at_kill = coordinator.stage.watermark
+        report["watermark_at_stage_kill"] = watermark_at_kill
+        pump(max(0.3, seconds * 0.1))  # fleet serves on through the kill
+        restart_writer("after stage kill")
+        # The restarted writer resumes staging from the durable watermark.
+        coordinator = RolloutCoordinator(
+            state, writer_gallery, reembed, NEW_V,
+            old_embed_fn=old_embed, new_embed_fn=new_embed,
+            parity_min_samples=8, parity_threshold=0.95, chunk_rows=3,
+            metrics=writer_metrics, tracer=tracer, fault_injector=injector)
+        writer_box["svc"].rollout = coordinator  # live-parity publish hook
+        if not coordinator.stage.resumed \
+                or coordinator.stage.watermark < watermark_at_kill:
+            failures.append(
+                f"stage did not resume from the durable watermark "
+                f"(resumed={coordinator.stage.resumed}, watermark "
+                f"{coordinator.stage.watermark} < {watermark_at_kill})")
+        enroll_burst(2)  # rows landing BEHIND the stage: the delta path
+        coordinator.run_stage()
+        if not coordinator.caught_up:
+            failures.append("stage never caught up after resume")
+
+        # ---- phase C: dual-score parity window over live traffic ----
+        pump(max(0.3, seconds * 0.1))  # the publish hook samples crops
+        # Direct identity queries: noisy copies of enrolled rows folded
+        # into crop shape — the parity signal the gate decides on.
+        crops = []
+        for ver, emb, _labels, _su, _la in acked[:8]:
+            row = emb[0] / max(np.linalg.norm(emb[0]), 1e-12)
+            if ver != OLD_V:
+                continue  # queries arrive in the OLD space pre-cutover
+            crops.append(row.reshape(2, 4))
+        coordinator.score_parity(crops)
+        report["parity"] = coordinator.status()["parity"]
+        if not coordinator.parity_ok():
+            failures.append(f"parity gate never opened: "
+                            f"{report['parity']}")
+
+        # ---- phase D: cutover, killed after the WAL fence ----
+        phase_t["cutover_start"] = time.monotonic()
+        injector.script("cutover", "crash_after_record")
+        try:
+            coordinator.cutover()
+            failures.append("scripted cutover kill never fired")
+        except InjectedCrashError:
+            pass
+        pump(max(0.3, seconds * 0.1))  # readers park on the fence; serve on
+        awaiting = [bool(r["replica"].stats()["awaiting_cutover"])
+                    for r in readers]
+        report["readers_awaiting_at_fence"] = awaiting
+        if not any(awaiting):
+            failures.append("no reader parked on the cutover fence while "
+                            "the writer was down")
+        # Kill reader-1 mid-re-anchor: parked on the fence, dies before
+        # the new-version checkpoint ever lands.
+        readers[1]["svc"].stop()
+        recovery = restart_writer("after cutover kill")
+        if not recovery.get("completed_cutover"):
+            failures.append(f"recovery did not complete the fenced "
+                            f"cutover: {recovery}")
+        if int(recovery.get("embedder_version", 0)) != NEW_V:
+            failures.append(f"writer recovered at v"
+                            f"{recovery.get('embedder_version')}, not "
+                            f"v{NEW_V}")
+        # The post-cutover checkpoint (recover latched a forced one; take
+        # it synchronously so the reader re-anchor window is bounded).
+        if not state.checkpoint_now(wait=True):
+            failures.append("post-cutover checkpoint failed")
+        enroll_burst(3)  # the new model enrolls straight into v2
+
+        # ---- phase E: surviving reader re-anchors through the cordon ----
+        deadline = time.monotonic() + 15.0
+        while (readers[0]["replica"].embedder_version != NEW_V
+               and time.monotonic() < deadline):
+            pump(0.1)
+        phase_t["reanchor_end"] = time.monotonic()
+        if readers[0]["replica"].embedder_version != NEW_V:
+            failures.append("surviving reader never re-anchored onto the "
+                            "new-version checkpoint")
+        pump(max(0.3, seconds * 0.1))  # post-re-anchor serving
+        # Catch-up: the reader applies the post-cutover v2 enrollments.
+        target = state.wal_seq
+        deadline = time.monotonic() + 10.0
+        while (readers[0]["replica"].applied_seq < target
+               and time.monotonic() < deadline):
+            readers[0]["replica"].poll(force=True)
+            time.sleep(0.02)
+
+        # ---- phase F: live fence + replacement replica + verification --
+        try:
+            state.append_enrollment(
+                np.zeros((1, DIM), np.float32), np.zeros(1, np.int32),
+                embedder_version=OLD_V)
+            failures.append("old-version enrollment was NOT refused after "
+                            "the cutover (fence breach)")
+        except EmbedderVersionMismatchError:
+            report["stale_enroll_refused"] = True
+        replacement_gallery = ShardedGallery(capacity=1024, dim=DIM,
+                                             mesh=mesh)
+        replacement = ReadReplica(state_dir, replacement_gallery, [],
+                                  metrics=Metrics(), tracer=tracer,
+                                  poll_interval_s=0.0, name="replacement")
+        replacement.poll(force=True)
+        for svc in [writer_box["svc"], readers[0]["svc"]]:
+            svc.drain(timeout=15.0)
+        verify_gallery(writer_gallery, NEW_V, "writer (post-rollout)")
+        verify_gallery(readers[0]["gallery"], NEW_V, "surviving reader")
+        verify_gallery(replacement_gallery, NEW_V, "replacement replica")
+        if replacement.embedder_version != NEW_V:
+            failures.append("late-start replacement did not anchor at the "
+                            "new version")
+    finally:
+        router.stop()
+        for svc in [writer_box["svc"]] + [r["svc"] for r in readers]:
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                import traceback
+
+                traceback.print_exc()
+        lease.release()
+        state.close()
+
+    # ---- verdicts ----
+    with stamp_lock:
+        stamp_view = {k: list(v) for k, v in stamps.items()}
+    report["result_stamps"] = {
+        k: {"total": len(v),
+            "versions": sorted({ver for _t, ver in v})}
+        for k, v in stamp_view.items()}
+    for name, series in stamp_view.items():
+        versions = [ver for _t, ver in series]
+        if not versions:
+            failures.append(f"{name}: published no version-stamped results")
+            continue
+        if any(v not in (OLD_V, NEW_V) for v in versions):
+            failures.append(f"{name}: stamp outside {{v1, v2}}: "
+                            f"{sorted(set(versions))}")
+        if versions != sorted(versions):
+            # One clean old->new step per replica — an interleaved stream
+            # means a result was scored against one version while the
+            # stamp (or the gallery) said another.
+            failures.append(f"{name}: version stamps interleave "
+                            f"(mixed-version serving): {versions}")
+    # reader-1 died pre-cutover: it must never have stamped v2.
+    if any(ver == NEW_V for _t, ver in stamp_view["reader-1"]):
+        failures.append("the reader killed mid-re-anchor published a "
+                        "new-version result")
+    # Serving continuity through the cutover window: fleet-wide completed
+    # frames never gap beyond a bound, and the survivors kept completing
+    # AFTER their re-anchor.
+    window = (phase_t.get("cutover_start"), phase_t.get("reanchor_end"))
+    if None not in window:
+        done_ts = sorted(t for t in recorder.done_t.values()
+                         if window[0] - 0.5 <= t <= window[1] + 0.5)
+        report["cutover_window_completions"] = len(done_ts)
+        if len(done_ts) < 2:
+            failures.append("serving blanked through the cutover window "
+                            f"({len(done_ts)} completions)")
+        else:
+            max_gap = max(b - a for a, b in zip(done_ts, done_ts[1:]))
+            report["cutover_window_max_gap_s"] = round(max_gap, 3)
+            if max_gap > 2.0:
+                failures.append(f"completed-frames gap {max_gap:.2f}s "
+                                f"through the cutover (serving blanked)")
+        for name in ("writer", "reader-0"):
+            after = [1 for t, _v in stamp_view[name]
+                     if t > window[1]]
+            if not after:
+                failures.append(f"{name}: no completions after its "
+                                f"re-anchor (never drained back in)")
+    if not router_metrics.counter("router_cutover_drains"):
+        failures.append("router never cordoned a replica through its "
+                        "re-anchor (the drain choreography is unwired)")
+
+    # Offline verifier: the final state dir's version fences must parse
+    # clean (checkpoint header + WAL version walk).
+    import importlib.util as _ilu
+
+    spec = _ilu.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "verify_checkpoint.py"))
+    verify_mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(verify_mod)
+    vreport = verify_mod.verify_state_dir(state_dir)
+    report["verify"] = {"ok": vreport["ok"],
+                        "embedder_version": vreport.get("embedder_version"),
+                        "violations": (vreport.get("wal") or {}).get(
+                            "version_violations")}
+    if not vreport["ok"]:
+        failures.append(f"offline verifier failed on the final state dir: "
+                        f"{report['verify']}")
+    if vreport.get("embedder_version") != NEW_V:
+        failures.append(f"final checkpoint serves v"
+                        f"{vreport.get('embedder_version')}, not v{NEW_V}")
+
+    cutover_spans = [s for s in tracer.snapshot(topic="_lifecycle")
+                     if s.get("stage") in ("cutover", "rollout_phase")]
+    if not cutover_spans:
+        failures.append("no rollout lifecycle spans recorded")
+    _check_flight_dumps(trace_dir, failures, require=0)
+    tracer.dump("rollout_end", extra={"acked": len(acked)}, force=True)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    if temp_dir:
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    report["acked_enrollments"] = len(acked)
+    report["offered"] = seq_box["seq"]
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--seed", type=int, default=None,
                         help="replay a previous run exactly (logged on stderr)")
     parser.add_argument("--scenario", choices=["soak", "overload", "recovery",
-                                               "replication"],
+                                               "replication", "rollout"],
                         default="soak",
                         help="soak: randomized fault soak (default); "
                              "overload: 4x flood against the admission/"
@@ -1435,7 +1964,11 @@ def main(argv=None) -> int:
                              "topic router — kill a reader mid-traffic "
                              "and the writer mid-enrollment, assert "
                              "survivor p99, zero acked loss, split-brain "
-                             "fail-closed (run_replication)")
+                             "fail-closed (run_replication); rollout: "
+                             "live embedder rollout — kills mid-re-embed, "
+                             "mid-cutover, and a reader mid-re-anchor; "
+                             "assert zero acked loss, no mixed-version "
+                             "scores, serving continuity (run_rollout)")
     parser.add_argument("--journal", default=None,
                         help="overload scenario: write the dead-letter "
                              "journal here instead of a temp file")
@@ -1452,6 +1985,9 @@ def main(argv=None) -> int:
     elif args.scenario == "replication":
         report = run_replication(seconds=args.seconds, seed=args.seed,
                                  state_dir=args.state_dir)
+    elif args.scenario == "rollout":
+        report = run_rollout(seconds=args.seconds, seed=args.seed,
+                             state_dir=args.state_dir)
     else:
         report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))

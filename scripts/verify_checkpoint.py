@@ -66,10 +66,50 @@ def verify_state_dir(path: str) -> dict:
                                    for p, r in sweep["newer_version"]]
         if sweep["corrupt"]:
             report["ok"] = False
+        # Embedder-version header validation (rollout fencing): every
+        # verified checkpoint must carry a sane version field (absent =
+        # pre-rollout v1). A non-integer / non-positive field is a
+        # corrupt fence — replay would mis-anchor on it. The newest
+        # verified checkpoint's version is reported for the operator.
+        from opencv_facerecognizer_tpu.runtime.state_store import (
+            CheckpointCorruptError, CheckpointVersionError,
+            read_checkpoint_header, scan_checkpoint_files,
+        )
+
+        ckpt_embedder_version = None
+        for _seq, ckpt_path in scan_checkpoint_files(ckpt_dir):
+            if ckpt_path not in sweep["ok"]:
+                continue
+            try:
+                meta = read_checkpoint_header(ckpt_path).get("meta", {})
+                version = int(meta.get("embedder_version", 1))
+                if version < 1:
+                    raise ValueError(f"embedder_version {version} < 1")
+            except (OSError, CheckpointCorruptError,
+                    CheckpointVersionError, TypeError, ValueError) as exc:
+                report["ok"] = False
+                report.setdefault("version_errors", []).append(
+                    {"path": ckpt_path,
+                     "reason": f"bad embedder_version header: {exc}"})
+                continue
+            if ckpt_embedder_version is None:
+                ckpt_embedder_version = version  # newest verified wins
+        report["embedder_version"] = ckpt_embedder_version
 
     wal_path = os.path.join(path, "enroll.wal")
     if os.path.exists(wal_path):
         torn_lines = enroll_records = valid_records = 0
+        cutover_records = 0
+        version_violations = []
+        # Version walk (rollout fencing): rows carry the embedder version
+        # they were enrolled under; a ``cutover`` record is the only
+        # sanctioned way the stream switches versions. Rows spanning
+        # versions WITHOUT an intervening cutover mean the fence is
+        # damaged — a replica replaying this WAL could mix embedding
+        # spaces. Seeded from the first row: pre-cutover leftovers below
+        # a new checkpoint's anchor legitimately predate it, so the walk
+        # follows the stream's own fences, not the anchor.
+        cur_version = None
         with open(wal_path, "r", encoding="utf-8", errors="replace") as fh:
             lines = [l.rstrip("\n") for l in fh]
         for line in lines:
@@ -87,11 +127,45 @@ def verify_state_dir(path: str) -> dict:
                 # signature, skipped by replay. A warning, not a failure.
                 torn_lines += 1
                 continue
+            if record.get("kind") == "cutover":
+                cutover_records += 1
+                try:
+                    from_v = int(record["from_version"])
+                    to_v = int(record["to_version"])
+                except (KeyError, TypeError, ValueError):
+                    version_violations.append(
+                        {"seq": record.get("seq"),
+                         "reason": "cutover record with unreadable "
+                                   "from/to versions"})
+                    continue
+                if cur_version is not None and from_v != cur_version:
+                    version_violations.append(
+                        {"seq": record.get("seq"),
+                         "reason": f"cutover claims from_version {from_v} "
+                                   f"but the stream is at {cur_version}"})
+                cur_version = to_v
+                continue
             if record.get("kind") != "enroll":
                 continue
             enroll_records += 1
             if decode_enroll_record(record) is not None:
                 valid_records += 1
+            try:
+                row_version = int(record.get("embedder_version", 1))
+            except (TypeError, ValueError):
+                version_violations.append(
+                    {"seq": record.get("seq"),
+                     "reason": f"unreadable embedder_version "
+                               f"{record.get('embedder_version')!r}"})
+                continue
+            if cur_version is None:
+                cur_version = row_version
+            elif row_version != cur_version:
+                version_violations.append(
+                    {"seq": record.get("seq"),
+                     "reason": f"row at embedder v{row_version} follows "
+                               f"v{cur_version} rows with no intervening "
+                               f"cutover record (version fence breached)"})
         # A PARSEABLE enroll record failing crc/base64 was acknowledged
         # and is now unreadable — that is real loss of acked data.
         corrupt_records = enroll_records - valid_records
@@ -99,8 +173,15 @@ def verify_state_dir(path: str) -> dict:
                          "enroll_records": enroll_records,
                          "valid_records": valid_records,
                          "torn_lines": torn_lines,
-                         "corrupt_records": corrupt_records}
+                         "corrupt_records": corrupt_records,
+                         "cutover_records": cutover_records,
+                         "version_violations": version_violations}
         if corrupt_records:
+            report["ok"] = False
+        if version_violations:
+            # Rows spanning embedder versions without a cutover fence:
+            # replaying this WAL could serve a mixed-space gallery — the
+            # exact failure the rollout machinery exists to prevent.
             report["ok"] = False
     if (not report["checkpoints"] and not report["corrupt"]
             and not report["newer_version"] and report["wal"] is None):

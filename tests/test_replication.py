@@ -257,6 +257,102 @@ def test_replica_service_applies_while_serving(tmp_path, mesh):
     state.close()
 
 
+def test_replica_reanchors_onto_newer_embedder_version(tmp_path, mesh):
+    """Rollout re-anchor (ISSUE 11): a replica serving v1 sees the cutover
+    fence in the tail, PARKS (keeps serving pure v1, applies nothing),
+    and re-anchors through the resync path the moment the writer's
+    v2 checkpoint lands — with the cordon hook draining it around the
+    reload."""
+    rng = np.random.default_rng(5)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    metrics = Metrics()
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=metrics,
+                      poll_interval_s=0.0, name="r")
+    cordon_calls = []
+    rep.on_resync = cordon_calls.append
+    rep.poll(force=True)
+    assert rep.embedder_version == 1
+    # The writer cuts over (same rows re-stamped — fence mechanics only).
+    emb, lab, val, size = wg.snapshot()
+    state.perform_cutover(2, lambda: (emb, lab, val, size))
+    _enroll(state, wg, wnames, rng, 3)  # a v2 row behind the fence
+    rep.poll(force=True)
+    rep.poll(force=True)
+    # Parked: nothing applied across the fence, v1 stays served.
+    assert rep.stats()["awaiting_cutover"]["to_version"] == 2
+    assert rep.embedder_version == 1 and rg.size == 3
+    assert metrics.gauge("rollout_replica_awaiting") == 1
+    # The v2 checkpoint lands: re-anchor + catch up the v2 tail.
+    assert state.checkpoint_now(wait=True)
+    rep.poll(force=True)
+    assert rep.embedder_version == 2
+    assert metrics.counter("rollout_replica_reanchors") == 1
+    deadline = time.monotonic() + 5.0
+    while rep.applied_seq < state.wal_seq and time.monotonic() < deadline:
+        rep.poll(force=True)
+        time.sleep(0.01)
+    _assert_galleries_equal(wg, rg)
+    # The drain hook bracketed every resync (initial + re-anchor).
+    assert cordon_calls.count("begin") == cordon_calls.count("end") >= 2
+    state.close()
+
+
+def test_parked_replica_unparks_on_stacked_cutover(tmp_path, mesh):
+    """A replica parked awaiting v2 must NOT strand when cutovers stack
+    (the first post-cutover checkpoint never landed and a second rollout
+    cut over to v3 before any checkpoint): ANY checkpoint whose wal_seq
+    covers the fence carries a post-cutover version, so the unpark keys
+    on the sequence, not the exact awaited version."""
+    rng = np.random.default_rng(7)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=Metrics(),
+                      poll_interval_s=0.0, name="r")
+    rep.poll(force=True)
+    emb, lab, val, size = wg.snapshot()
+    state.perform_cutover(2, lambda: (emb, lab, val, size))
+    rep.poll(force=True)
+    rep.poll(force=True)
+    assert rep.stats()["awaiting_cutover"]["to_version"] == 2
+    # The v2 checkpoint never lands; a SECOND cutover (v3) does, and ITS
+    # checkpoint succeeds.
+    emb2, lab2, val2, size2 = wg.snapshot()
+    state.perform_cutover(3, lambda: (emb2, lab2, val2, size2))
+    assert state.checkpoint_now(wait=True)
+    rep.poll(force=True)
+    assert rep.embedder_version == 3
+    assert rep.stats()["awaiting_cutover"] is None
+    _assert_galleries_equal(wg, rg)
+    state.close()
+
+
+def test_late_start_replica_never_saw_old_version(tmp_path, mesh):
+    """A replica born AFTER the cutover anchors straight on the v2
+    checkpoint: no fence parking, no v1 residue — and the WAL's surviving
+    pre-cutover rows below the anchor are dedup'd, never applied."""
+    rng = np.random.default_rng(6)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i)
+    emb, lab, val, size = wg.snapshot()
+    state.perform_cutover(2, lambda: (emb, lab, val, size))
+    assert state.checkpoint_now(wait=True)
+    _enroll(state, wg, wnames, rng, 3)  # post-checkpoint v2 tail row
+    late_g = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    late = ReadReplica(str(tmp_path), late_g, [], metrics=Metrics(),
+                       poll_interval_s=0.0, name="late")
+    late.poll(force=True)
+    assert late.embedder_version == 2
+    assert late.stats()["awaiting_cutover"] is None
+    _assert_galleries_equal(wg, late_g)
+    state.close()
+
+
 # ---------- topic router ----------
 
 

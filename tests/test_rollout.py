@@ -1,0 +1,595 @@
+"""Embedder-rollout tests (``runtime.rollout`` + the version-fenced
+state machinery): crash-safe staged re-embed with durable resume, version
+fencing at every layer (gallery swap, WAL append, replay, replica tail,
+offline verifier), the dual-score parity gate, the WAL-fenced atomic
+cutover with recovery completion, rollback-as-the-same-mechanism, the
+router cordon drain, and the fast deterministic tier-1 variant of
+``scripts/chaos_soak.py --scenario rollout``."""
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import (
+    EmbeddingDimMismatchError,
+    ShardedGallery,
+    make_mesh,
+)
+from opencv_facerecognizer_tpu.runtime import (
+    EmbedderVersionMismatchError,
+    FakeConnector,
+    FaultInjector,
+    ReadReplica,
+    RecognizerService,
+    ReplicaHandle,
+    RolloutCoordinator,
+    RolloutGateError,
+    StateLifecycle,
+    TopicRouter,
+)
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
+from opencv_facerecognizer_tpu.runtime.rollout import (
+    ReEmbedStage,
+    RolloutStateError,
+    load_stage,
+    stage_path,
+)
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIM = 8
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def rotation():
+    rng = np.random.default_rng(42)
+    q, _ = np.linalg.qr(rng.normal(size=(DIM, DIM)))
+    return q.astype(np.float32)
+
+
+def _writer(tmp_path, mesh, **kw):
+    gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(str(tmp_path), metrics=kw.pop("metrics", Metrics()),
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9, **kw)
+    state.bind(gallery, names)
+    return state, gallery, names
+
+
+def _enroll(state, gallery, names, rng, i, n=1):
+    emb = rng.normal(size=(n, DIM)).astype(np.float32)
+    labels = np.full(n, i, np.int32)
+    names.append(f"s{i}")
+    state.append_enrollment(emb, labels, subject=f"s{i}", label=i,
+                            apply_fn=lambda e=emb, l=labels:
+                                gallery.add(e, l))
+    return emb
+
+
+def _norm(rows):
+    return rows / np.maximum(np.linalg.norm(rows, axis=-1, keepdims=True),
+                             1e-12)
+
+
+def _expected_new(embs, rotation):
+    want = _norm(np.concatenate(embs))
+    return _norm(want @ rotation)
+
+
+def _coordinator(state, gallery, rotation, to_version=2, **kw):
+    kw.setdefault("chunk_rows", 3)
+    kw.setdefault("metrics", Metrics())
+    return RolloutCoordinator(state, gallery,
+                              lambda rows: rows @ rotation, to_version, **kw)
+
+
+# ---------- staged re-embed: durability + resume ----------
+
+
+def test_stage_resume_after_torn_append(tmp_path):
+    injector = FaultInjector(seed=0)
+    stage = ReEmbedStage(str(tmp_path), 2, dim=DIM, metrics=Metrics(),
+                         fault_injector=injector)
+    rng = np.random.default_rng(0)
+    stage.stage_chunk(0, rng.normal(size=(3, DIM)).astype(np.float32),
+                      np.arange(3, dtype=np.int32))
+    stage.stage_chunk(3, rng.normal(size=(2, DIM)).astype(np.float32),
+                      np.arange(2, dtype=np.int32))
+    assert stage.watermark == 5
+    # Torn append: partial line lands, watermark must NOT advance.
+    injector.script("stage", "torn")
+    with pytest.raises(InjectedCrashError):
+        stage.stage_chunk(5, rng.normal(size=(2, DIM)).astype(np.float32),
+                          np.arange(2, dtype=np.int32))
+    # "Restart": a fresh stage over the same dir seals the torn tail and
+    # resumes exactly at the durable watermark.
+    resumed = ReEmbedStage(str(tmp_path), 2, dim=DIM, metrics=Metrics())
+    assert resumed.resumed
+    assert resumed.watermark == 5
+    emb, labels = resumed.arrays()
+    assert emb.shape == (5, DIM) and labels.shape == (5,)
+    # Re-staging the same chunk (deterministic re-embed) extends cleanly.
+    resumed.stage_chunk(5, np.ones((1, DIM), np.float32),
+                        np.zeros(1, np.int32))
+    assert resumed.watermark == 6
+
+
+def test_load_stage_fails_closed_on_gaps(tmp_path):
+    stage = ReEmbedStage(str(tmp_path), 2, dim=DIM)
+    stage.stage_chunk(0, np.ones((2, DIM), np.float32),
+                      np.zeros(2, np.int32))
+    # Promise more rows than the contiguous coverage: refuse.
+    with pytest.raises(RolloutStateError):
+        load_stage(str(tmp_path), 2, expect_rows=5, expect_dim=DIM)
+    emb, labels = load_stage(str(tmp_path), 2, expect_rows=2,
+                             expect_dim=DIM)
+    assert emb.shape == (2, DIM)
+    # Missing file entirely: refuse with the operator-facing error.
+    with pytest.raises(RolloutStateError):
+        load_stage(str(tmp_path / "nowhere"), 2, expect_rows=1,
+                   expect_dim=DIM)
+
+
+# ---------- version fencing ----------
+
+
+def test_swap_from_dim_mismatch_fails_closed(mesh):
+    serving = ShardedGallery(capacity=16, dim=DIM, mesh=mesh)
+    donor = ShardedGallery(capacity=16, dim=DIM * 2, mesh=mesh)
+    with pytest.raises(EmbeddingDimMismatchError, match="staged re-embed"):
+        serving.swap_from(donor)
+    # Still a ValueError subclass: pre-rollout callers keep working.
+    with pytest.raises(ValueError):
+        serving.swap_from(donor)
+
+
+def test_swap_from_adopts_donor_version(mesh):
+    serving = ShardedGallery(capacity=16, dim=DIM, mesh=mesh)
+    donor = ShardedGallery(capacity=16, dim=DIM, mesh=mesh,
+                           embedder_version=3)
+    donor.add(np.ones((2, DIM), np.float32), np.zeros(2, np.int32))
+    serving.swap_from(donor)
+    assert serving.embedder_version == 3
+
+
+def test_append_enrollment_version_fence(tmp_path, mesh):
+    metrics = Metrics()
+    state, gallery, names = _writer(tmp_path, mesh, metrics=metrics)
+    seq_before = state.wal_seq
+    with pytest.raises(EmbedderVersionMismatchError):
+        state.append_enrollment(np.ones((1, DIM), np.float32),
+                                np.zeros(1, np.int32), embedder_version=9)
+    # Failed closed BEFORE any sequence burned or record appended.
+    assert state.wal_seq == seq_before
+    assert metrics.counter("rollout_version_mismatches") == 1
+    assert list(state.wal.enrollments()) == []
+    # The matching version passes.
+    state.append_enrollment(np.ones((1, DIM), np.float32),
+                            np.zeros(1, np.int32), embedder_version=1,
+                            apply_fn=lambda: gallery.add(
+                                np.ones((1, DIM), np.float32),
+                                np.zeros(1, np.int32)))
+    records = list(state.wal.enrollments())
+    assert records[0]["embedder_version"] == 1
+    state.close()
+
+
+# ---------- cutover: atomic swap + crash-recovery completion ----------
+
+
+def test_cutover_swaps_and_checkpoint_carries_version(tmp_path, mesh,
+                                                      rotation):
+    rng = np.random.default_rng(1)
+    state, gallery, names = _writer(tmp_path, mesh)
+    embs = [_enroll(state, gallery, names, rng, i, n=2) for i in range(4)]
+    co = _coordinator(state, gallery, rotation)
+    co.run_stage()
+    assert co.caught_up
+    seq = co.cutover(force=True)  # no parity embedders wired: force
+    assert gallery.embedder_version == 2
+    got, lab, _v, size = gallery.snapshot()
+    assert np.allclose(got[:size], _expected_new(embs, rotation), atol=1e-5)
+    # The stage file is gone (the post-cutover checkpoint landed)...
+    assert not os.path.exists(stage_path(str(tmp_path), 2))
+    # ...and a fresh recovery lands straight on v2 off the checkpoint.
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names2 = []
+    report = StateLifecycle(str(tmp_path), metrics=Metrics()).recover(
+        g2, names2)
+    assert report["embedder_version"] == 2
+    assert report.get("completed_cutover") is None
+    assert g2.embedder_version == 2
+    got2, _l, _v2, size2 = g2.snapshot()
+    assert np.allclose(got2[:size2], _expected_new(embs, rotation),
+                       atol=1e-5)
+    assert names2 == names
+    assert seq == state.wal_seq  # the fence was the last record
+    state.close()
+
+
+def test_crash_after_fence_record_recovery_completes(tmp_path, mesh,
+                                                     rotation):
+    rng = np.random.default_rng(2)
+    injector = FaultInjector(seed=2)
+    metrics = Metrics()
+    state, gallery, names = _writer(tmp_path, mesh, metrics=metrics,
+                                    fault_injector=injector)
+    embs = [_enroll(state, gallery, names, rng, i) for i in range(3)]
+    assert state.checkpoint_now(wait=True)  # an old-version anchor
+    embs.append(_enroll(state, gallery, names, rng, 3))  # WAL-only row
+    co = _coordinator(state, gallery, rotation,
+                      fault_injector=injector)
+    co.run_stage()
+    injector.script("cutover", "crash_after_record")
+    with pytest.raises(InjectedCrashError):
+        co.cutover(force=True)
+    assert gallery.embedder_version == 1  # the dying process never swapped
+    # "Restart": recovery must complete the cutover from the stage.
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names2 = []
+    m2 = Metrics()
+    report = StateLifecycle(str(tmp_path), metrics=m2).recover(g2, names2)
+    assert report["completed_cutover"]["to_version"] == 2
+    assert report["embedder_version"] == 2
+    assert m2.counter("rollout_cutovers_completed_recovery") == 1
+    got, _l, _v, size = g2.snapshot()
+    assert np.allclose(got[:size], _expected_new(embs, rotation), atol=1e-5)
+    assert names2 == names
+    state.close()
+
+
+def test_crash_before_fence_record_stays_old_version(tmp_path, mesh,
+                                                     rotation):
+    rng = np.random.default_rng(3)
+    injector = FaultInjector(seed=3)
+    state, gallery, names = _writer(tmp_path, mesh,
+                                    fault_injector=injector)
+    embs = [_enroll(state, gallery, names, rng, i) for i in range(3)]
+    co = _coordinator(state, gallery, rotation, fault_injector=injector)
+    co.run_stage()
+    injector.script("cutover", "crash_before_record")
+    with pytest.raises(InjectedCrashError):
+        co.cutover(force=True)
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    report = StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, [])
+    # No fence record landed: the fleet stays on v1, zero loss.
+    assert report["embedder_version"] == 1
+    assert report.get("completed_cutover") is None
+    got, _l, _v, size = g2.snapshot()
+    assert np.allclose(got[:size], _norm(np.concatenate(embs)), atol=1e-6)
+    state.close()
+
+
+def test_recovery_fails_closed_on_damaged_stage(tmp_path, mesh, rotation):
+    rng = np.random.default_rng(4)
+    injector = FaultInjector(seed=4)
+    state, gallery, names = _writer(tmp_path, mesh,
+                                    fault_injector=injector)
+    for i in range(3):
+        _enroll(state, gallery, names, rng, i)
+    co = _coordinator(state, gallery, rotation, fault_injector=injector)
+    co.run_stage()
+    injector.script("cutover", "crash_after_record")
+    with pytest.raises(InjectedCrashError):
+        co.cutover(force=True)
+    # Media damage: the staged shard set vanishes after the fence fsynced.
+    os.remove(stage_path(str(tmp_path), 2))
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    with pytest.raises(RolloutStateError):
+        StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, [])
+    state.close()
+
+
+# ---------- the parity gate ----------
+
+
+def _crop_for(row):
+    return _norm(row[None])[0].reshape(2, 4)
+
+
+def test_parity_gate_blocks_disagreeing_embedder(tmp_path, mesh, rotation):
+    rng = np.random.default_rng(5)
+    state, gallery, names = _writer(tmp_path, mesh)
+    embs = [_enroll(state, gallery, names, rng, i, n=2) for i in range(4)]
+
+    def old_embed(crops):
+        return np.asarray(crops, np.float32).reshape(len(crops), -1)[:, :DIM]
+
+    # A BROKEN "new embedder": random vectors — identities scramble.
+    def broken_embed(crops):
+        return np.random.default_rng(99).normal(
+            size=(len(crops), DIM)).astype(np.float32)
+
+    metrics = Metrics()
+    co = RolloutCoordinator(state, gallery, lambda r: r @ rotation, 2,
+                            old_embed_fn=old_embed,
+                            new_embed_fn=broken_embed,
+                            parity_min_samples=4, parity_threshold=0.9,
+                            chunk_rows=8, metrics=metrics)
+    co.run_stage()
+    co.score_parity([_crop_for(e[0]) for e in embs])
+    assert not co.parity_ok()
+    with pytest.raises(RolloutGateError, match="parity gate"):
+        co.cutover()
+    assert metrics.counter("rollout_cutover_blocked") == 1
+    assert gallery.embedder_version == 1  # nothing moved
+    # The consistent pair clears the same gate.
+    co2 = RolloutCoordinator(state, gallery, lambda r: r @ rotation, 2,
+                             old_embed_fn=old_embed,
+                             new_embed_fn=lambda c: old_embed(c) @ rotation,
+                             parity_min_samples=4, parity_threshold=0.9,
+                             chunk_rows=8, metrics=Metrics())
+    co2.run_stage()
+    co2.score_parity([_crop_for(e[0]) for e in embs])
+    assert co2.parity_ok()
+    co2.cutover()
+    assert gallery.embedder_version == 2
+    state.close()
+
+
+def test_live_parity_rides_publish_path(tmp_path, mesh, rotation):
+    """The recognizer's publish hook samples detected face crops into the
+    rollout thread's queue — parity accumulates from live traffic."""
+    rng = np.random.default_rng(6)
+    state, gallery, names = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, gallery, names, rng, i)
+
+    def old_embed(crops):
+        flat = np.asarray(crops, np.float32).reshape(len(crops), -1)
+        return flat[:, :DIM]
+
+    co = RolloutCoordinator(state, gallery, lambda r: r @ rotation, 2,
+                            old_embed_fn=old_embed,
+                            new_embed_fn=lambda c: old_embed(c) @ rotation,
+                            parity_min_samples=1, chunk_rows=8,
+                            live_sample_interval_s=0.0, metrics=Metrics())
+    co.run_stage()
+    pipe = InstantPipeline((16, 16), faces_per_frame=1)
+    pipe.gallery = gallery
+    connector = FakeConnector()
+    service = RecognizerService(pipe, connector, batch_size=4,
+                                frame_shape=(16, 16), flush_timeout=0.02,
+                                metrics=Metrics())
+    service.rollout = co
+    co.start()
+    service.start(warmup=False)
+    try:
+        from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+
+        frame = np.zeros((16, 16), np.float32)
+        for i in range(8):
+            connector.inject(FRAME_TOPIC,
+                             {**encode_frame(frame), "meta": {"seq": i}})
+        deadline = time.monotonic() + 5.0
+        while co.parity.samples == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert co.parity.samples > 0
+    finally:
+        service.stop()
+        co.stop()
+    state.close()
+
+
+# ---------- rollback: the same mechanism, prior space ----------
+
+
+def test_rollback_restores_prior_space(tmp_path, mesh, rotation):
+    rng = np.random.default_rng(7)
+    state, gallery, names = _writer(tmp_path, mesh)
+    embs = [_enroll(state, gallery, names, rng, i) for i in range(3)]
+    co = _coordinator(state, gallery, rotation)
+    co.run_stage()
+    co.cutover(force=True)
+    assert gallery.embedder_version == 2
+    # Rollback = a NEW rollout whose reembed inverts the map, at the next
+    # monotonic version.
+    back = co.rollback(lambda rows: rows @ rotation.T)
+    assert back.to_version == 3
+    back.run_stage()
+    back.cutover(force=True)
+    assert gallery.embedder_version == 3
+    got, _l, _v, size = gallery.snapshot()
+    want = _norm(np.concatenate(embs))
+    assert np.allclose(got[:size], want, atol=1e-5)
+    state.close()
+
+
+# ---------- fleet: replica fence + router cordon ----------
+
+
+def test_replica_parks_on_fence_then_reanchors(tmp_path, mesh, rotation):
+    rng = np.random.default_rng(8)
+    state, wg, wnames = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    rmetrics = Metrics()
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=rmetrics,
+                      poll_interval_s=0.0, name="r")
+    rep.poll(force=True)
+    assert rep.embedder_version == 1
+    co = _coordinator(state, wg, rotation)
+    co.run_stage()
+    # Suppress the automatic post-cutover checkpoint so the fence window
+    # is observable: perform the locked swap directly.
+    state.perform_cutover(2, lambda: _build_arrays(wg))
+    out = rep.poll(force=True)
+    assert out.get("awaiting_version") == 2 or \
+        rep.stats()["awaiting_cutover"] is not None
+    assert rep.embedder_version == 1  # still serving pure old-version rows
+    assert rmetrics.gauge("rollout_replica_awaiting") == 1
+    # Enrollments landing at v2 while parked must NOT apply.
+    _enroll(state, wg, wnames, rng, 3)
+    rep.poll(force=True)
+    assert rep.gallery.size == 3
+    # The new-version checkpoint lands: the replica re-anchors and
+    # catches the v2 tail up.
+    assert state.checkpoint_now(wait=True)
+    rep.poll(force=True)
+    assert rep.embedder_version == 2
+    assert rmetrics.counter("rollout_replica_reanchors") == 1
+    assert rmetrics.gauge("rollout_replica_awaiting") == 0
+    deadline = time.monotonic() + 5.0
+    while rep.applied_seq < state.wal_seq and time.monotonic() < deadline:
+        rep.poll(force=True)
+        time.sleep(0.01)
+    _assert_equal_galleries(wg, rg)
+    state.close()
+
+
+def _build_arrays(gallery):
+    emb, lab, val, size = gallery.snapshot()
+    return emb, lab, val, size
+
+
+def _assert_equal_galleries(a, b):
+    ae, al, _av, asz = a.snapshot()
+    be, bl, _bv, bsz = b.snapshot()
+    assert asz == bsz
+    assert np.array_equal(al[:asz], bl[:bsz])
+    assert np.allclose(ae[:asz], be[:bsz], rtol=0, atol=1e-6)
+
+
+def test_router_cordon_drains_and_hands_back():
+    metrics = Metrics()
+    handles = [ReplicaHandle(f"replica-{i}", FakeConnector())
+               for i in range(2)]
+    router = TopicRouter(handles, metrics=metrics)
+    topics = [f"camera/{i}" for i in range(32)]
+    before = {t: router.route(t).name for t in topics}
+    router.set_cordon("replica-0", True)
+    during = {t: router.route(t).name for t in topics}
+    assert all(v == "replica-1" for v in during.values())
+    # Cordon is choreography, not an incident: counted as a drain, never
+    # a failover.
+    assert metrics.counter("router_cutover_drains") == 1
+    assert not metrics.counter("router_failovers")
+    router.set_cordon("replica-0", False)
+    after = {t: router.route(t).name for t in topics}
+    assert after == before  # exactly its own topics hand back
+    with pytest.raises(KeyError):
+        router.set_cordon("nope", True)
+    # The on_resync adapter wires begin/end to cordon/uncordon.
+    hook = router.cordon_hook("replica-1")
+    hook("begin")
+    assert handles[1].cordoned
+    hook("end")
+    assert not handles[1].cordoned
+
+
+# ---------- offline verifier: the version fence ----------
+
+
+def test_verify_checkpoint_version_fence(tmp_path, mesh, rotation):
+    rng = np.random.default_rng(9)
+    state, gallery, names = _writer(tmp_path, mesh)
+    for i in range(2):
+        _enroll(state, gallery, names, rng, i)
+    verify = _load_script("verify_checkpoint")
+    report = verify.verify_state_dir(str(tmp_path))
+    assert report["ok"]
+    assert report["wal"]["version_violations"] == []
+    # A legitimate cutover keeps the walk clean.
+    co = _coordinator(state, gallery, rotation)
+    co.run_stage()
+    state.perform_cutover(2, lambda: _build_arrays(gallery))
+    _enroll(state, gallery, names, rng, 2)  # a v2 row past the fence
+    report = verify.verify_state_dir(str(tmp_path))
+    assert report["ok"], report
+    assert report["wal"]["cutover_records"] == 1
+    # A row spanning versions WITHOUT a fence is the rc-2 breach.
+    state.wal.append_enroll(99, np.ones((1, DIM), np.float32),
+                            np.zeros(1, np.int32), embedder_version=7)
+    report = verify.verify_state_dir(str(tmp_path))
+    assert not report["ok"]
+    assert report["wal"]["version_violations"]
+    assert verify.main([str(tmp_path)]) == 2
+    state.close()
+
+
+def test_verify_checkpoint_bad_version_header(tmp_path, mesh):
+    rng = np.random.default_rng(10)
+    state, gallery, names = _writer(tmp_path, mesh)
+    _enroll(state, gallery, names, rng, 0)
+    assert state.checkpoint_now(wait=True)
+    verify = _load_script("verify_checkpoint")
+    report = verify.verify_state_dir(str(tmp_path))
+    assert report["ok"] and report["embedder_version"] == 1
+    state.close()
+
+
+# ---------- the trainer's multibatch fine-tune ----------
+
+
+def test_finetune_embedder_multibatch():
+    from opencv_facerecognizer_tpu.runtime.trainer import TheTrainer
+
+    rng = np.random.default_rng(11)
+    images = rng.uniform(0, 255, size=(24, 16, 16)).astype(np.float32)
+    labels = np.repeat(np.arange(4, dtype=np.int32), 6)
+    trainer = TheTrainer(model="cnn", kfold=0, image_size=(16, 16),
+                         embed_dim=8, train_steps=2,
+                         cnn_kwargs={"stem_features": 4,
+                                     "stage_features": (4,),
+                                     "stage_blocks": (1,),
+                                     "batch_size": 8})
+    trainer.train(images, labels, [f"s{i}" for i in range(4)],
+                  validate=False)
+    old_emb = np.asarray(trainer.model.feature.extract(images[:4]))
+    new_feature = trainer.finetune_embedder(
+        images, labels, steps=3, identities_per_batch=3,
+        samples_per_identity=2, learning_rate=1e-3, seed=1)
+    # The fine-tune returns a NEW feature; the serving model is untouched.
+    assert new_feature is not trainer.model.feature
+    assert np.allclose(
+        np.asarray(trainer.model.feature.extract(images[:4])), old_emb)
+    new_emb = np.asarray(new_feature.extract(images[:4]))
+    assert new_emb.shape == old_emb.shape
+    assert not np.allclose(new_emb, old_emb)  # it actually trained
+    # The source-store reembed_fn is index-aware and deterministic.
+    reembed = TheTrainer.make_reembed_fn(new_feature, images)
+    a = reembed(np.zeros((3, 8), np.float32), 2)
+    b = reembed(np.zeros((3, 8), np.float32), 2)
+    assert np.array_equal(a, b)
+    assert a.shape == (3, 8)
+
+
+# ---------- the fast deterministic tier-1 soak ----------
+
+
+def test_rollout_soak_fast_deterministic():
+    """Tier-1 variant of ``--scenario rollout``: kills mid-re-embed (with
+    durable-watermark resume), mid-cutover (recovery completes the fenced
+    swap), and a reader mid-re-anchor; zero acked loss on writer /
+    surviving reader / replacement, monotonic per-replica version stamps
+    (no mixed-version scores), serving continuity through the cutover
+    window, and a clean offline version-fence verification."""
+    chaos_soak = _load_script("chaos_soak")
+    report = chaos_soak.run_rollout(seconds=3.0, seed=7)
+    assert report["ok"], report["failures"]
+    assert report["stale_enroll_refused"]
+    assert report["verify"]["embedder_version"] == 2
+    assert report["cutover_window_max_gap_s"] < 2.0
+    for name, stamp in report["result_stamps"].items():
+        assert set(stamp["versions"]) <= {1, 2}, (name, stamp)

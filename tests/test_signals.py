@@ -885,7 +885,8 @@ def test_expo_concurrent_get_hammer_no_500s_counters_consistent():
 
 
 def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
-               offered=120, ratio=1.0, scaleout_x2=2.0):
+               offered=120, ratio=1.0, scaleout_x2=2.0, parity=1.0,
+               cutover_ratio=0.95):
     return {
         "modes": {"overlapped": {
             "e2e_p50_ms": e2e, "dropped_frames": dropped,
@@ -896,6 +897,8 @@ def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
              "interactive_completed": done}]},
         "tracing_overhead": {"p50_ratio": ratio},
         "replica_scaleout": {"scaling": {"x2": scaleout_x2}},
+        "rollout": {"parity_agreement": parity,
+                    "cutover_window_completed_ratio": cutover_ratio},
     }
 
 

@@ -36,6 +36,9 @@ ATTR_HINTS: Dict[str, str] = {
     "router": "TopicRouter",
     "tailer": "WALTailer",
     "lease": "WriterLease",
+    "rollout": "RolloutCoordinator",
+    "stage": "ReEmbedStage",
+    "parity": "DualScoreParity",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
