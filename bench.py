@@ -509,14 +509,41 @@ def main():
     # sync-poll mode, which would quantize these measurements) --
     for batch in BATCH_SWEEP:
         detail["sweep"][str(batch)] = {}
-        for dtype, tag, bytes_per in ((np.float32, "h2d_transfer", 4),
-                                      (np.uint8, "h2d_transfer_uint8", 1)):
+        # The pinned-ring arm (runtime.ingest.StagingRing): ONE
+        # pre-allocated recycled uint8 staging buffer per batch size,
+        # copied into and uploaded — the serving ingest path's exact
+        # staging discipline, timed next to the fresh-allocation arms so
+        # the old-vs-new p99 story (the --transfer-uint8 118 ms tail came
+        # from unpinned per-batch staging allocations) is a committed
+        # artifact, not a claim.
+        ring_stage = np.zeros((batch, height, width), np.uint8)
+        host_u8 = [np.clip(arr, 0, 255).astype(np.uint8)
+                   for arr in all_host[batch]]
+        for dtype, tag, bytes_per in (
+                (np.float32, "h2d_transfer", 4),
+                (np.uint8, "h2d_transfer_uint8", 1),
+                (np.uint8, "h2d_transfer_uint8_pinned", 1)):
+            pinned = tag.endswith("_pinned")
             h2d_lat = []
             for it in range(H2D_ITERS):
                 arr = all_host[batch][it % DISTINCT_INPUTS]
-                if dtype is np.uint8:
-                    arr = np.clip(arr, 0, 255).astype(np.uint8)
-                t0 = time.perf_counter()
+                if pinned:
+                    # Staging copy INSIDE the timed region: the recycled
+                    # ring buffer's point is that copy+upload from warm
+                    # reused pages has a stable tail, where the unpinned
+                    # arm's fresh per-batch allocation (made outside its
+                    # timed region here, but ON the hot path in the old
+                    # serving code) is what fed the 118 ms p99. The two
+                    # legacy arms keep their historical pure-put timing
+                    # for artifact comparability.
+                    src = host_u8[it % DISTINCT_INPUTS]
+                    t0 = time.perf_counter()
+                    np.copyto(ring_stage, src)
+                    arr = ring_stage
+                else:
+                    if dtype is np.uint8:
+                        arr = host_u8[it % DISTINCT_INPUTS].copy()
+                    t0 = time.perf_counter()
                 frames = jax.device_put(arr)
                 jax.block_until_ready(frames)
                 h2d_lat.append(time.perf_counter() - t0)

@@ -86,15 +86,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-poll-ms", type=float, default=50.0,
                    help="completion-wait tick: how often drain() and the "
                         "fallback path re-check for finished work")
+    # ---- ingest pipeline (runtime.ingest; README "Ingest pipeline") ----
+    p.add_argument("--ingest-mode", choices=["f32", "uint8", "jpeg"],
+                   default=None,
+                   help="ingest transfer mode. f32 (default): legacy "
+                        "float staging. uint8: frames stage and cross "
+                        "host->device as uint8 through the pre-allocated "
+                        "staging ring (4x less transfer volume; the cast/"
+                        "normalize fuses into the detect prologue on "
+                        "device). jpeg: uint8 plus compressed camera "
+                        "payloads ({'__jpeg__': base64}) decoded off the "
+                        "hot thread by the decode worker pool directly "
+                        "into the staging ring")
+    p.add_argument("--ingest-ring-depth", type=int, default=0,
+                   help="staging buffers pre-allocated per dispatch-"
+                        "bucket rung. 0 (default) = auto: sized to the "
+                        "in-flight window + 2 so the bounded ring never "
+                        "caps pipeline overlap (every overlapped batch "
+                        "holds a buffer, plus the one being assembled). "
+                        "Ring exhaustion backpressures through admission "
+                        "(reason=staging), never allocates")
+    p.add_argument("--ingest-decode-workers", type=int, default=2,
+                   help="decode worker threads for --ingest-mode jpeg "
+                        "(corrupt payloads dead-letter with reason "
+                        "decode_error; depth/latency on the metrics "
+                        "surface)")
     p.add_argument("--transfer-uint8", action="store_true",
-                   help="buffer and ship frames host->device as uint8 "
-                        "(4x less transfer volume; cast to f32 happens on "
-                        "device). Measured crossover (BENCH_DETAIL.json "
-                        "sweep): a clear win at batch >= 128; at batch "
-                        "<= 32 on a tunneled/high-latency link the extra "
-                        "transfer's per-request floor can cost more than "
-                        "the bytes save (batch-8 p99 measured ~109 ms vs "
-                        "f32's sub-ms) — pick by measurement on your link")
+                   help="DEPRECATED (one release): alias for "
+                        "--ingest-mode uint8. The old unpinned-staging "
+                        "uint8 path (batch-8 p99 measured ~109-118 ms "
+                        "under load) is gone — this flag now routes "
+                        "through the pre-allocated staging ring, which "
+                        "keeps the 4x byte win without the p99 pathology")
     p.add_argument("--similarity-threshold", type=float, default=0.3)
     p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
     p.add_argument("--gallery-dtype", choices=["bf16", "f32"], default="bf16",
@@ -446,10 +469,24 @@ def _load_stack(args):
             face_size=feature.input_size,
         )
     else:
+        from opencv_facerecognizer_tpu.runtime.ingest import (
+            resolve_ingest_mode,
+        )
+
+        import jax
+
+        # Buffer donation through the bucketed ladder: only when the
+        # ingest uploader feeds each dispatch a fresh device array AND
+        # the backend implements input donation (CPU ignores it with a
+        # warning per compiled step — noise, not a win).
+        donate = (resolve_ingest_mode(args.ingest_mode, args.transfer_uint8,
+                                      warn=False) != "f32"
+                  and jax.devices()[0].platform in ("tpu", "gpu"))
         pipeline = RecognitionPipeline(
             detector, feature.net, feature._params["net"], gallery,
             face_size=feature.input_size,
             fused_embedder=args.fused_embedder,
+            donate_frames=donate,
         )
     return pipeline, names
 
@@ -568,9 +605,18 @@ def main(argv=None) -> int:
         BrownoutPolicy, ResiliencePolicy, ServiceSupervisor,
         rebuild_pipeline_on_cpu,
     )
+    from opencv_facerecognizer_tpu.runtime.ingest import (
+        IngestConfig, resolve_ingest_mode,
+    )
     from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
     from opencv_facerecognizer_tpu.utils.metrics import Metrics
 
+    # The --transfer-uint8 deprecation warning fires HERE, once (the
+    # _load_stack probe resolves silently).
+    ingest_mode = resolve_ingest_mode(args.ingest_mode, args.transfer_uint8)
+    ingest_cfg = IngestConfig(mode=ingest_mode,
+                              ring_depth=args.ingest_ring_depth or None,
+                              decode_workers=args.ingest_decode_workers)
     pipeline, names = _load_stack(args)
     metrics_sink = open(args.metrics_jsonl, "a") if args.metrics_jsonl else None
     # The latency rolling horizon must cover the longest SLO evaluation
@@ -749,7 +795,9 @@ def main(argv=None) -> int:
         similarity_threshold=args.similarity_threshold,
         subject_names=names,
         metrics=metrics,
-        transfer_dtype=np.uint8 if args.transfer_uint8 else np.float32,
+        # The ingest config owns the transfer dtype now (uint8/jpeg stage
+        # as uint8 through the ring; f32 keeps the legacy dtype).
+        ingest=ingest_cfg,
         readback_worker=not args.no_readback_worker,
         readback_poll_s=args.readback_poll_ms / 1e3,
         drain_poll_s=args.drain_poll_ms / 1e3,

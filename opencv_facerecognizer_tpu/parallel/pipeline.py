@@ -79,6 +79,7 @@ class RecognitionPipeline:
         face_size: Tuple[int, int] = (112, 112),
         top_k: int = 1,
         fused_embedder: bool = False,
+        donate_frames: bool = False,
     ):
         self.detector = detector
         self.embed_net = embed_net
@@ -86,6 +87,15 @@ class RecognitionPipeline:
         self.gallery = gallery
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
+        # Donate the frames argument of the PACKED serving step through
+        # the whole bucketed ladder: the ingest uploader ships each batch
+        # as its own fresh device array (uint8, one device_put per
+        # dispatch attempt), so XLA may reuse that buffer's memory for
+        # outputs instead of allocating. Only flip this on backends that
+        # implement input donation (TPU/GPU — CPU ignores it with a
+        # warning) AND when every caller routes through the uploader:
+        # a donated array must never be re-fed after dispatch.
+        self.donate_frames = bool(donate_frames)
         # Opt-in pallas schedule for the embed stage (ops.pallas_sepblock;
         # same params/math, equivalence pinned in tests). Stays off by
         # default until scripts/bench_sepblock.py measures a win on chip —
@@ -271,7 +281,9 @@ class RecognitionPipeline:
                 return pack_result(step(det_p, emb_p, g_emb, g_valid,
                                         g_lab, fr, iv))
 
-            packed = self._packed_cache[key] = jax.jit(packed_step)  # ocvf-lint: boundary=jit-recompile-hazard -- packed-cache fill: warmup compiles every dispatch bucket, so serving only lands here on a genuinely new (shape, capacity, matcher) key
+            packed = self._packed_cache[key] = jax.jit(  # ocvf-lint: boundary=jit-recompile-hazard -- packed-cache fill: warmup compiles every dispatch bucket, so serving only lands here on a genuinely new (shape, capacity, matcher) key
+                packed_step,
+                donate_argnums=(5,) if self.donate_frames else ())
         return packed(
             self.detector.params,
             self.embed_params,
@@ -374,7 +386,9 @@ class RecognitionPipeline:
                 return pack_result(_step(det_p, emb_p, g_emb, g_valid,
                                          g_lab, fr, iv))
 
-            packed = jax.jit(packed_step)  # ocvf-lint: boundary=jit-recompile-hazard -- prewarm builder on the grow-worker thread: compiles the future tier so the serving thread never does
+            packed = jax.jit(  # ocvf-lint: boundary=jit-recompile-hazard -- prewarm builder on the grow-worker thread: compiles the future tier so the serving thread never does
+                packed_step,
+                donate_argnums=(5,) if self.donate_frames else ())
             packed(  # ocvf-lint: boundary=host-sync -- prewarm executes+blocks off the serving loop; install happens only after the compile landed
                 self.detector.params, self.embed_params,
                 scratch_emb, scratch_val, scratch_lab, frames, ivf_arg,

@@ -21,6 +21,13 @@ from opencv_facerecognizer_tpu.runtime.connector import (
 )
 from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
 from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
+from opencv_facerecognizer_tpu.runtime.ingest import (
+    DecodeWorkerPool,
+    IngestConfig,
+    IngestPipeline,
+    StagingRing,
+    resolve_ingest_mode,
+)
 from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
 from opencv_facerecognizer_tpu.runtime.recognizer import RecognizerService
 from opencv_facerecognizer_tpu.runtime.replication import (
@@ -65,6 +72,7 @@ __all__ = [
     "BrownoutPolicy",
     "CheckpointStore",
     "DeadLetterJournal",
+    "DecodeWorkerPool",
     "DualScoreParity",
     "EmbedderVersionMismatchError",
     "EnrollmentWAL",
@@ -72,6 +80,8 @@ __all__ = [
     "FakeConnector",
     "FaultInjector",
     "FrameBatcher",
+    "IngestConfig",
+    "IngestPipeline",
     "JSONLConnector",
     "MiddlewareConnector",
     "PRIORITY_BULK",
@@ -91,6 +101,8 @@ __all__ = [
     "SLO",
     "SLOMonitor",
     "ServiceSupervisor",
+    "StagingRing",
+    "resolve_ingest_mode",
     "default_objectives",
     "loop_liveness_objective",
     "replication_lag_objective",

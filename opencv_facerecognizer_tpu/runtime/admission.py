@@ -91,8 +91,10 @@ class AdmissionController:
     """Per-topic rate limits + a bounded intake, consulted per frame.
 
     ``admit(topic, priority)`` returns ``None`` to admit or a rejection
-    reason string (``"rate_limit"`` / ``"overload"``). The caller counts
-    and announces the rejection; this object only decides.
+    reason string (``"rate_limit"`` / ``"overload"`` / ``"staging"`` —
+    the last when a wired ingest staging ring has zero free buffers).
+    The caller counts and announces the rejection; this object only
+    decides.
 
     ``rate_limit_fps`` is a scalar (applied to every topic seen) or a
     ``{topic: fps}`` dict; ``0``/``None`` disables the rate limit for that
@@ -113,6 +115,12 @@ class AdmissionController:
         burst_seconds: float = 1.0,
         interactive_reserve: float = 0.25,
         inflight_fn: Optional[Callable[[], float]] = None,
+        # Ingest staging backpressure (runtime.ingest.StagingRing
+        # .free_slots): when wired and reading 0 free staging buffers,
+        # new frames are rejected with reason ``staging`` — the ring is
+        # bounded BY DESIGN (exhaustion must shed at the front door,
+        # never allocate), so this is the explicit form of that bound.
+        staging_free_fn: Optional[Callable[[], int]] = None,
     ):
         self.max_inflight_frames = (None if not max_inflight_frames
                                     else int(max_inflight_frames))
@@ -125,6 +133,7 @@ class AdmissionController:
         self.burst_seconds = float(burst_seconds)
         self.interactive_reserve = min(0.9, max(0.0, float(interactive_reserve)))
         self.inflight_fn = inflight_fn
+        self.staging_free_fn = staging_free_fn
         self._buckets: Dict[str, TokenBucket] = {}
         self._lock = threading.Lock()
         # Immutable after __init__: lets the per-frame admit path skip the
@@ -159,4 +168,6 @@ class AdmissionController:
                 bound = bound * (1.0 - self.interactive_reserve)
             if self.inflight_fn() >= bound:
                 return "overload"
+        if self.staging_free_fn is not None and self.staging_free_fn() <= 0:
+            return "staging"
         return None

@@ -60,6 +60,11 @@ from typing import Any, List, NamedTuple, Optional, Tuple
 import numpy as np
 from opencv_facerecognizer_tpu.utils import metric_names as mn
 
+#: in-loop marker that a staging-ring acquire already missed this pop
+#: attempt — later re-checks of the same episode go ``quiet`` so the
+#: exhaustion counter stays per-episode (see StagingRing.acquire).
+_EXHAUSTED = object()
+
 
 class Batch(NamedTuple):
     """One device-ready batch plus the provenance the latency decomposition
@@ -107,6 +112,13 @@ class FrameBatcher:
         # Staging buffers kept for reuse (recycle); ~inflight_depth + the
         # batch being formed is plenty.
         buffer_pool_size: int = 8,
+        # Ingest staging ring (runtime.ingest.StagingRing): when set, it
+        # REPLACES the ad-hoc buffer pool — batches assemble into
+        # pre-allocated per-rung buffers, recycle/forfeit route to the
+        # ring, and an exhausted ring makes the consumer WAIT (explicit
+        # backpressure) instead of allocating. Must match this batcher's
+        # frame_shape/dtype, and its largest rung must be batch_size.
+        staging_ring=None,
         # Freshness bound (seconds): a queued frame older than this is shed
         # (reason ``stale``) — preferentially at overflow-eviction time, and
         # always before it can consume a dispatch slot. None disables.
@@ -140,6 +152,21 @@ class FrameBatcher:
         self._service_time_ewma: Optional[float] = None
         self._pool_cap = int(buffer_pool_size)
         self._buffer_pool: List[np.ndarray] = []
+        self._ring = staging_ring
+        if self._ring is not None:
+            if (tuple(self._ring.frame_shape) != self.frame_shape
+                    or np.dtype(self._ring.dtype) != self.dtype):
+                raise ValueError(
+                    "staging_ring shape/dtype "
+                    f"({self._ring.frame_shape}, {self._ring.dtype}) does "
+                    f"not match batcher ({self.frame_shape}, {self.dtype})")
+            if max(self._ring.rungs) < self.batch_size:
+                raise ValueError(
+                    f"staging_ring's largest rung {max(self._ring.rungs)} "
+                    f"cannot stage a full batch of {self.batch_size}")
+            # Wake a consumer parked on ring exhaustion when a buffer
+            # returns (called by the ring OUTSIDE its own lock).
+            self._ring.add_notify(self._wake_consumer)
         self.stale_after_s = (None if stale_after_s is None
                               else float(stale_after_s))
         self._drop_log = drop_log
@@ -324,7 +351,11 @@ class FrameBatcher:
         """Return a batch's staging array for reuse once the consumer is
         completely done with it (readback finished, no views kept — crops
         must be copied out first). Wrong shape/dtype or a full pool just
-        drops it; never an error."""
+        drops it; never an error. With a staging ring installed the buffer
+        goes back to its rung's pre-allocated pool instead."""
+        if self._ring is not None:
+            self._ring.release(buf)
+            return
         if (not isinstance(buf, np.ndarray)
                 or buf.shape != (self.batch_size, *self.frame_shape)
                 or buf.dtype != self.dtype):
@@ -332,6 +363,20 @@ class FrameBatcher:
         with self._lock:
             if len(self._buffer_pool) < self._pool_cap:
                 self._buffer_pool.append(buf)
+
+    def forfeit(self, buf) -> None:
+        """Tell the staging ring one in-flight buffer will never come back
+        (dead-letter/crash paths: the backend's async H2D read of it may
+        still be pending, so it must not recirculate). No-op without a
+        ring — the legacy pool refills from completed batches anyway."""
+        if self._ring is not None:
+            self._ring.forfeit(buf)
+
+    def _wake_consumer(self) -> None:
+        """Ring release notification: a consumer parked on ring
+        exhaustion inside ``get_batch`` re-checks for a free buffer."""
+        with self._not_empty:
+            self._not_empty.notify_all()
 
     # ---- consumer side ----
 
@@ -364,6 +409,9 @@ class FrameBatcher:
         if buf is None:
             frames = np.zeros((self.batch_size, *self.frame_shape), dtype=self.dtype)
         else:
+            # A ring buffer may be RUNG-sized (the smallest dispatch
+            # bucket >= count) rather than batch_size — the consumer's
+            # bucket slicing handles either length.
             frames = buf
             frames[count:] = 0  # re-zero a reused buffer's padding lanes
         metas: List[Any] = [None] * self.batch_size
@@ -392,30 +440,54 @@ class FrameBatcher:
     def _pop_batch_locked(self, block: bool, stale: List[tuple]):
         """Caller holds the lock: the wait/flush decision + the pop.
         Returns ``(items, count, full, pooled_buf)`` or None (closed /
-        nothing flushable / idle tick)."""
+        nothing flushable / idle tick). With a staging ring, the buffer
+        is acquired BEFORE the pop — an exhausted ring keeps the frames
+        queued (backpressure: admission sheds new intake upstream) and
+        waits for a recycled buffer instead of ever allocating."""
+        buf = None
         while True:
             self._shed_stale(stale)
             n = len(self._frames)
             if n >= self.batch_size:
-                break
-            if n > 0:
+                pass  # full batch: flush now
+            elif n > 0:
                 deadline = self.current_flush_deadline()
                 age = time.monotonic() - self._frames[0][2]
-                if age >= deadline:
-                    break
-                if not block:
+                if age < deadline:
+                    if not block:
+                        return None
+                    self._not_empty.wait(timeout=deadline - age)
+                    continue
+            else:
+                if self._closed or not block:
                     return None
-                self._not_empty.wait(timeout=deadline - age)
+                self._not_empty.wait(timeout=self.flush_timeout)
+                if not self._frames:
+                    # Idle tick: give the caller a turn (the fallback
+                    # serving loop drains its in-flight queue on None).
+                    return None
                 continue
-            if self._closed:
+            count = min(len(self._frames), self.batch_size)
+            if self._ring is None:
+                break
+            # The one sanctioned FrameBatcher._lock -> StagingRing._lock
+            # nesting; the ring never calls back under its own lock.
+            # ``quiet`` after the first miss: one exhaustion EPISODE
+            # counts once, not once per 10 ms re-check below.
+            buf = self._ring.acquire(count, quiet=buf is _EXHAUSTED)
+            if buf is not None:
+                break
+            buf = _EXHAUSTED
+            if self._closed or not block:
+                # Shutdown with an exhausted ring: surrender the tick
+                # (same as legacy stop semantics — queued frames are the
+                # drain/stop caller's problem, never an allocation here).
                 return None
-            if not block:
-                return None
-            self._not_empty.wait(timeout=self.flush_timeout)
-            if not self._frames:
-                # Idle tick: give the caller a turn (the fallback
-                # serving loop drains its in-flight queue on None).
-                return None
+            # Exhausted: park until recycle()/release wakes us (the ring
+            # notifies this cv) or the timeout re-checks; the queued
+            # frames age meanwhile, which is exactly the backpressure
+            # signal admission + stale shedding act on.
+            self._not_empty.wait(timeout=min(self.flush_timeout, 0.01))
         count = min(len(self._frames), self.batch_size)
         full = count >= self.batch_size
         items = [self._frames.popleft() for _ in range(count)]
@@ -428,7 +500,8 @@ class FrameBatcher:
             self._batches_size += 1
         else:
             self._batches_deadline += 1
-        buf = self._buffer_pool.pop() if self._buffer_pool else None
+        if self._ring is None:
+            buf = self._buffer_pool.pop() if self._buffer_pool else None
         return items, count, full, buf
 
     @property

@@ -88,12 +88,21 @@ class InstantPipeline:
     def __init__(self, frame_shape: Tuple[int, int], top_k: int = 1,
                  max_faces: int = 2, compute_s: float = 0.0,
                  sync_poll_floor_s: float = 0.0, dispatch_s: float = 0.0,
-                 faces_per_frame: int = 0):
+                 faces_per_frame: int = 0,
+                 h2d_gb_s: Optional[float] = None):
         self.frame_shape = tuple(frame_shape)
         self.top_k = int(top_k)
         self.max_faces = int(max_faces)
         self.compute_s = float(compute_s)
         self.sync_poll_floor_s = float(sync_poll_floor_s)
+        #: simulated H2D bandwidth (GB/s): each dispatch additionally
+        #: sleeps frames.nbytes / bandwidth, making the fake backend
+        #: TRANSFER-bound the way BENCH_DETAIL says the real one is — a
+        #: uint8 batch (4x fewer bytes) then completes ~4x more frames
+        #: against the same wall, which is what the ingest smoke's
+        #: uplift arm measures. None = no transfer cost (the historical
+        #: behavior; dispatch_s alone is the wall).
+        self.h2d_gb_s = None if h2d_gb_s is None else float(h2d_gb_s)
         #: scripted detections: the first N face slots of every frame come
         #: back valid (fixed box, det_score 1, label 0, sim 1) instead of
         #: the default zero-face result — what the rollout parity hook and
@@ -115,32 +124,47 @@ class InstantPipeline:
         #: batch dimension of every dispatch, in order — lets tests assert
         #: the service's bucket ladder sliced partial batches as designed.
         self.batch_sizes_seen: list = []
-        #: batch shapes already "compiled" (first dispatch of a shape is a
-        #: cache miss, like the real packed-step cache) — drives the
-        #: ``last_dispatch_info`` provenance the recompile watchdog reads,
-        #: so the watchdog is testable without hardware. Tests clear this
-        #: to inject a post-warmup compile.
+        #: (batch, dtype) signatures already "compiled" (first dispatch of
+        #: a signature is a cache miss, like the real packed-step cache,
+        #: whose ``_step_key`` includes the input dtype — a uint8 ingest
+        #: dispatch against an f32-only prewarm MUST read as a recompile)
+        #: — drives the ``last_dispatch_info`` provenance the recompile
+        #: watchdog reads, so the watchdog is testable without hardware.
+        #: Tests clear this to inject a post-warmup compile.
         self.compiled_batch_sizes: set = set()
         self.last_dispatch_info: dict = {}
 
-    def prewarm_batch_shapes(self, ladder, frame_shape, dtype) -> None:
+    @staticmethod
+    def _sig(batch, dtype) -> tuple:
+        return (int(batch), str(np.dtype(dtype)))
+
+    def prewarm_batch_shapes(self, ladder, frame_shape,
+                             dtype=np.float32) -> None:
         """Mirror ``RecognitionPipeline.prewarm_batch_shapes``: mark every
-        ladder bucket compiled so post-warmup serving dispatches are cache
-        hits — the recompile watchdog's armed-and-silent baseline."""
+        (ladder bucket, transfer dtype) signature compiled so post-warmup
+        serving dispatches are cache hits — the recompile watchdog's
+        armed-and-silent baseline."""
         for bucket in ladder:
-            self.compiled_batch_sizes.add(int(bucket))
+            self.compiled_batch_sizes.add(self._sig(bucket, dtype))
 
     def recognize_batch_packed(self, frames) -> FakePacked:
         if self.fault_injector is not None:
             self.fault_injector.on_dispatch()
+        host = np.asarray(frames)
         if self.dispatch_s > 0.0:
             time.sleep(self.dispatch_s)  # capacity wall (see __init__)
+        if self.h2d_gb_s:
+            # Transfer wall: the scripted PCIe/tunnel cost of shipping
+            # this batch's actual bytes (so uint8 staging pays 1/4 the
+            # f32 price, like the real link).
+            time.sleep(host.nbytes / (self.h2d_gb_s * 1e9))
         self.dispatches += 1
-        b = int(np.asarray(frames).shape[0])
+        b = int(host.shape[0])
         self.batch_sizes_seen.append(b)
-        self.last_dispatch_info = {"cache_hit": b in self.compiled_batch_sizes,
+        sig = self._sig(b, host.dtype)
+        self.last_dispatch_info = {"cache_hit": sig in self.compiled_batch_sizes,
                                    "mode": "fake"}
-        self.compiled_batch_sizes.add(b)
+        self.compiled_batch_sizes.add(sig)
         # pack_result layout: boxes(4) | det_score | valid | labels(k) |
         # sims(k); valid=0 everywhere -> zero faces per frame (unless
         # faces_per_frame scripts some detections in).
@@ -156,6 +180,38 @@ class InstantPipeline:
                 packed[:, j, 6 + self.top_k] = 1.0  # top-1 similarity
         return FakePacked(packed, time.monotonic() + self.compute_s,
                           poll_cost_s=self.sync_poll_floor_s)
+
+
+def synthetic_jpeg_frames(n: int, frame_hw: Tuple[int, int] = (64, 64),
+                          seed: int = 0, quality: int = 85,
+                          faces_per_frame: int = 0):
+    """Seeded synthetic camera payloads as REAL JPEG bytes: ``n`` pairs of
+    ``(jpeg_bytes, source_frame)`` (uint8 grayscale). Deterministic per
+    seed — the same seed always produces byte-identical payloads, so the
+    ingest tests and the smoke bench replay exactly.
+
+    ``faces_per_frame`` stamps that many bright face-ish blobs (a light
+    square with darker eye dots) onto each frame at seeded positions —
+    the knob the face-density traffic mix (ROADMAP item #2's cascade
+    bench) reuses to script how much of a stream contains faces at all.
+    """
+    from opencv_facerecognizer_tpu.runtime.ingest import encode_jpeg
+
+    rng = np.random.default_rng(seed)
+    h, w = int(frame_hw[0]), int(frame_hw[1])
+    out = []
+    for _ in range(int(n)):
+        frame = rng.integers(20, 90, size=(h, w)).astype(np.uint8)
+        for _face in range(int(faces_per_frame)):
+            side = int(rng.integers(max(6, h // 8), max(8, h // 3)))
+            y0 = int(rng.integers(0, max(1, h - side)))
+            x0 = int(rng.integers(0, max(1, w - side)))
+            frame[y0:y0 + side, x0:x0 + side] = 200
+            ey = y0 + side // 3
+            for ex in (x0 + side // 4, x0 + 3 * side // 4):
+                frame[max(0, ey - 1):ey + 1, max(0, ex - 1):ex + 1] = 60
+        out.append((encode_jpeg(frame, quality=quality), frame))
+    return out
 
 
 def build_overload_stack(frame_shape=(32, 32), batch_size: int = 8,

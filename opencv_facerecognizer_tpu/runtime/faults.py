@@ -46,6 +46,13 @@ BOUNDARIES: Dict[str, tuple] = {
     "put": ("corrupt",),
     "dispatch": ("unavailable",),
     "readback": ("stuck", "slow"),
+    # Compressed-frame intake (runtime.ingest.DecodeWorkerPool): "slow" =
+    # a congested decoder (the worker sleeps slow_decode_s before
+    # decoding — the pool must absorb it off the hot thread); "corrupt" =
+    # the payload is replaced with bytes no JPEG decoder accepts, so the
+    # frame must dead-letter with reason decode_error and exact ledger
+    # settlement.
+    "decode": ("slow", "corrupt"),
     # Durability boundaries (state lifecycle layer — runtime.state_store):
     # "torn" = the process dies mid-write leaving a partial record/file on
     # disk; "crash" = it dies before the write becomes visible (before the
@@ -160,11 +167,15 @@ class FaultInjector:
     def __init__(self, seed: int = 0,
                  rates: Optional[Dict[str, Dict[str, float]]] = None,
                  slow_readback_s: float = 0.05,
-                 flood_factor: int = 8):
+                 flood_factor: int = 8,
+                 slow_decode_s: float = 0.05):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         #: injected transfer latency of a ``readback: slow`` fault.
         self.slow_readback_s = float(slow_readback_s)
+        #: injected decoder stall of a ``decode: slow`` fault (the worker
+        #: sleeps this long before decoding the payload).
+        self.slow_decode_s = float(slow_decode_s)
         #: amplification of a ``receive: flood`` fault — one delivery
         #: becomes this many (a runaway producer / retry storm in
         #: miniature; the admission layer must shed the excess with
@@ -264,6 +275,21 @@ class FaultInjector:
         if fault == "slow":
             return SlowReadback(device_array, self.slow_readback_s)
         return StuckReadback(device_array)
+
+    def on_decode(self, payload: bytes) -> bytes:
+        """Compressed-intake decode boundary (runs on a decode worker,
+        never the hot thread): ``slow`` sleeps out the injected decoder
+        stall then passes the payload through; ``corrupt`` returns a
+        truncated pseudo-JPEG no decoder accepts (SOI marker then
+        garbage), so the downstream decode raises exactly like real
+        corrupt camera bytes."""
+        fault = self._draw("decode")
+        if fault is None:
+            return payload
+        if fault == "slow":
+            time.sleep(self.slow_decode_s)
+            return payload
+        return b"\xff\xd8\xff" + b"\x00" * 5  # corrupt: truncated garbage
 
     def on_wal_append(self) -> Optional[str]:
         """Enrollment-WAL append boundary: returns the fault kind the

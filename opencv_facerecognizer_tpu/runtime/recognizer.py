@@ -112,6 +112,11 @@ from opencv_facerecognizer_tpu.runtime.connector import (
     MiddlewareConnector,
     decode_frame,
 )
+from opencv_facerecognizer_tpu.runtime.ingest import (
+    JPEG_KEY,
+    IngestConfig,
+    IngestPipeline,
+)
 from opencv_facerecognizer_tpu.runtime.resilience import (
     BrownoutPolicy,
     ResiliencePolicy,
@@ -287,6 +292,14 @@ class RecognizerService:
         # in the shared state dir owns the write path). None = this
         # process owns its own state (the pre-replication behavior).
         replica=None,
+        # Ingest subsystem config (runtime.ingest.IngestConfig): installs
+        # the pre-allocated staging ring in place of the ad-hoc buffer
+        # pool, picks the transfer dtype from its mode (overriding
+        # ``transfer_dtype``), routes dispatches through the explicit
+        # device uploader, and (jpeg mode) runs the off-thread decode
+        # worker pool for compressed camera payloads. None = the
+        # pre-ingest behavior, unchanged.
+        ingest: Optional[IngestConfig] = None,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -343,6 +356,25 @@ class RecognizerService:
         # the whole bucket ladder — before that, a jit-cache miss is the
         # expected cost of starting up, not a mid-serving compile.
         self._warmed = False
+        self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
+                                                        int(batch_size))
+        # Ingest subsystem (runtime.ingest): staging ring sized per
+        # dispatch-bucket rung + mode-derived transfer dtype + (jpeg)
+        # decode pool. Built BEFORE the batcher, which stages into it.
+        self.ingest = None
+        if ingest is not None:
+            self.ingest = IngestPipeline(
+                ingest, self._bucket_ladder, tuple(frame_shape),
+                metrics=self.metrics, tracer=tracer,
+                trace_topic=FRAME_TOPIC, fault_injector=fault_injector,
+                inflight_depth=int(inflight_depth))
+            transfer_dtype = self.ingest.transfer_dtype
+            if (self.admission is not None
+                    and self.admission.staging_free_fn is None):
+                # Ring exhaustion backpressures at the front door: a
+                # flood that outruns recycle is rejected explicitly
+                # (reason ``staging``), never absorbed by an allocation.
+                self.admission.staging_free_fn = self.ingest.staging.free_slots
         self.batcher = FrameBatcher(batch_size, frame_shape, flush_timeout,
                                     dtype=transfer_dtype,
                                     metrics=self.metrics,
@@ -351,10 +383,11 @@ class RecognizerService:
                                     stale_after_s=shed_stale_after_s,
                                     drop_log=self._journal_drop,
                                     tracer=tracer,
-                                    trace_topic=FRAME_TOPIC)
+                                    trace_topic=FRAME_TOPIC,
+                                    staging_ring=(self.ingest.staging
+                                                  if self.ingest is not None
+                                                  else None))
         self.inflight_depth = int(inflight_depth)
-        self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
-                                                        int(batch_size))
         self._inflight: deque = deque()
         # One condition variable guards the in-flight queue AND the
         # completion counter: the dispatch loop appends + waits for slots,
@@ -454,6 +487,8 @@ class RecognizerService:
     #: design — a rejected frame never entered.
     LEDGER_DROP_COUNTERS = (
         mn.FRAMES_MALFORMED,            # admitted, then failed to decode
+        mn.FRAMES_DROPPED_DECODE,       # compressed payload lost in the
+                                        # decode pool (corrupt / backlog)
         mn.BATCHER_DROPPED_MALFORMED,   # poisoned at the put boundary
         mn.BATCHER_DROPPED_OVERFLOW,    # priority-aware overflow eviction
         mn.BATCHER_DROPPED_STALE,       # outlived shed_stale_after_s queued
@@ -714,6 +749,23 @@ class RecognizerService:
                 tracer.emit(tid, "receive", topic=topic, t0=t_recv,
                             dur=time.monotonic() - t_recv,
                             verdict="admitted", priority=priority)
+            if JPEG_KEY in msg and (self.ingest is not None
+                                    and self.ingest.decoder is not None):
+                # Compressed intake: hand the ADMITTED payload to the
+                # decode pool — the connector thread never decodes. A
+                # full decode queue is an explicit ledger drop (the
+                # bounded-backlog mirror of the batcher's overflow).
+                if not self.ingest.submit_decode(msg, priority, tid):
+                    self.metrics.incr(mn.FRAMES_DROPPED_DECODE)
+                    self._trace_settle([tid], mn.FRAMES_DROPPED_DECODE,
+                                       "ingest.decode_backlog")
+                    self._journal_drop("decode_backlog", self._drop_entries(
+                        [msg.get("meta")], None, [tid],
+                        "ingest.decode_backlog", priority=priority))
+                continue
+            # A JPEG payload with no decode pool falls through: the pixel
+            # decode below fails and the frame counts malformed — the
+            # operator forgot --ingest-mode jpeg, loudly.
             try:
                 frame = decode_frame(msg) if "__frame__" in msg else np.asarray(
                     msg["frame"]
@@ -722,22 +774,57 @@ class RecognizerService:
                 self.metrics.incr(mn.FRAMES_MALFORMED)
                 self._trace_settle([tid], mn.FRAMES_MALFORMED, "decode")
                 continue
-            brownout_level = self._effective_brownout_level()
-            if self._brownout_sheds_intake(priority, brownout_level):
-                self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT)
-                self._trace_settle([tid], mn.FRAMES_DROPPED_BROWNOUT,
-                                   "intake.brownout")
-                # Journal the EFFECTIVE level (incl. the SLO critical
-                # boost) — it is what caused this drop; the raw controller
-                # level alone could read 0 here, hiding the cause.
-                self._journal_drop("brownout", self._drop_entries(
-                    [msg.get("meta")], None, [tid], "intake.brownout",
-                    priority=priority),
-                    level=brownout_level)
-                continue
-            if not self.batcher.put(frame, meta=msg.get("meta"),
-                                    priority=priority, trace_id=tid):
-                self.metrics.incr(mn.FRAMES_DROPPED)
+            self._intake_frame(frame, msg.get("meta"), priority, tid)
+
+    def _intake_frame(self, frame, meta, priority: int, tid: int) -> None:
+        """Post-decode intake shared by the connector handler and the
+        decode workers: brownout shed, then the batcher put. Runs on the
+        connector's dispatch thread or a decode worker — keep cheap."""
+        brownout_level = self._effective_brownout_level()
+        if self._brownout_sheds_intake(priority, brownout_level):
+            self.metrics.incr(mn.FRAMES_DROPPED_BROWNOUT)
+            self._trace_settle([tid], mn.FRAMES_DROPPED_BROWNOUT,
+                               "intake.brownout")
+            # Journal the EFFECTIVE level (incl. the SLO critical
+            # boost) — it is what caused this drop; the raw controller
+            # level alone could read 0 here, hiding the cause.
+            self._journal_drop("brownout", self._drop_entries(
+                [meta], None, [tid], "intake.brownout",
+                priority=priority),
+                level=brownout_level)
+            return
+        if not self.batcher.put(frame, meta=meta,
+                                priority=priority, trace_id=tid):
+            self.metrics.incr(mn.FRAMES_DROPPED)
+
+    def _intake_decoded(self, frame, message, priority: int,
+                        tid: int) -> None:
+        """Decode-pool success sink: the decoded pixel frame joins the
+        normal intake (shape validation in the batcher still guards it —
+        a camera sending the wrong resolution drops malformed, counted).
+        Contains its own failures: the intake path's settlement effects
+        (journal append, span emit) are non-raising by contract, so an
+        exception here almost surely PRECEDED settlement — settling the
+        frame as a decode drop is the right bias, and doing it HERE
+        (where the ledger semantics live) keeps the pool's backstop from
+        ever having to guess."""
+        try:
+            self._intake_frame(frame, message.get("meta"), priority, tid)
+        except Exception:  # noqa: BLE001 — an intake bug costs this frame's result, never a decode worker; the ledger settles it below
+            logging.getLogger(__name__).exception(
+                "decoded-frame intake failed; settling as decode drop")
+            self._decode_failed(message, priority, tid, "decode_error")
+
+    def _decode_failed(self, message, priority: int, tid: int,
+                       reason: str) -> None:
+        """Decode-pool failure sink: a corrupt/truncated compressed
+        payload dead-letters with exact ledger settlement — one counted
+        drop, one journal row, one terminal span."""
+        self.metrics.incr(mn.FRAMES_DROPPED_DECODE)
+        self._trace_settle([tid], mn.FRAMES_DROPPED_DECODE, "ingest.decode")
+        self._journal_drop(reason, self._drop_entries(
+            [message.get("meta")], None, [tid], "ingest.decode",
+            priority=priority))
 
     def _on_control(self, topic: str, message: Dict[str, Any]) -> None:
         cmd = message.get("cmd")
@@ -764,13 +851,16 @@ class RecognizerService:
             self.connector.publish(STATUS_TOPIC, {"status": "enrolling", "subject": name,
                                                   "count": count})
         elif cmd == "stats":
-            self.connector.publish(STATUS_TOPIC, {"status": "stats",
-                                                  **self.metrics.summary(),
-                                                  **self.batcher.stats,
-                                                  "degraded": self._degraded,
-                                                  "brownout_level": self._brownout_level,
-                                                  "ledger": self.ledger(),
-                                                  "gallery_size": self.pipeline.gallery.size})
+            status = {"status": "stats",
+                      **self.metrics.summary(),
+                      **self.batcher.stats,
+                      "degraded": self._degraded,
+                      "brownout_level": self._brownout_level,
+                      "ledger": self.ledger(),
+                      "gallery_size": self.pipeline.gallery.size}
+            if self.ingest is not None:
+                status["ingest"] = self.ingest.stats()
+            self.connector.publish(STATUS_TOPIC, status)
 
     # ---- lifecycle ----
 
@@ -789,6 +879,11 @@ class RecognizerService:
         self._running = True
         self._crashed = False
         self._loop_progress_t = None
+        if self.ingest is not None:
+            # Decode workers feed the same intake continuation the
+            # connector thread uses; failures settle through the ledger.
+            self.ingest.start(sink=self._intake_decoded,
+                              on_error=self._decode_failed)
         self.connector.start()
         if self._use_worker:
             self._blocker = _ReadbackBlocker()
@@ -838,10 +933,15 @@ class RecognizerService:
         deadline = time.monotonic() + timeout
         with self._inflight_cv:
             while time.monotonic() < deadline:
-                # delivered == completed covers popped-but-undispatched
-                # batches, the in-flight queue, AND publish-in-progress
-                # (completed is bumped only after _publish returns).
-                if (self.batcher.pending == 0
+                # Ingest idle FIRST: a decode worker counts busy until
+                # its sink (the batcher put) returns, so once idle reads
+                # True no frame can still be in transit toward the
+                # batcher checks below. delivered == completed covers
+                # popped-but-undispatched batches, the in-flight queue,
+                # AND publish-in-progress (completed is bumped only
+                # after _publish returns).
+                if ((self.ingest is None or self.ingest.idle())
+                        and self.batcher.pending == 0
                         and self.batcher.delivered_batches == self._completed_batches):
                     return True
                 self._inflight_cv.wait(timeout=self._drain_poll_s)
@@ -850,6 +950,8 @@ class RecognizerService:
     def stop(self) -> None:
         self._running = False
         self._flush_rejections(force=True)
+        if self.ingest is not None:
+            self.ingest.stop()
         self.batcher.close()
         with self._inflight_cv:
             self._inflight_cv.notify_all()
@@ -1037,6 +1139,12 @@ class RecognizerService:
             # view, not a copy, so steady state allocates nothing.
             bucket = self._pick_bucket(count)
             view = frames[:bucket] if bucket < len(frames) else frames
+            if batch_tid and self.ingest is not None:
+                # Ingest provenance: which staging rung carried the batch
+                # and which bucket it dispatches at (rung >= bucket; the
+                # ring hands the smallest rung that fits).
+                tracer.emit(batch_tid, "stage", topic=tracing.BATCH_TOPIC,
+                            rung=len(frames), bucket=bucket, frames=count)
             # Embedder-version stamp captured AT DISPATCH: the batch's
             # scores are computed against the gallery data this dispatch
             # reads, so its published results carry the version serving
@@ -1049,7 +1157,7 @@ class RecognizerService:
                                   "embedder_version", None)
             if gallery_ver is not None:
                 gallery_ver = int(gallery_ver)
-            packed = self._dispatch_with_retry(view)
+            packed = self._dispatch_with_retry(view, batch_tid)
             if packed is None:
                 # Retries exhausted or the error was permanent (poisoned
                 # batch): abandoned, not published — but still completed
@@ -1063,7 +1171,13 @@ class RecognizerService:
                     trace_ids[:count], "dispatch.abandoned"))
                 self._mark_completed()
                 accounted = True
-                self.batcher.recycle(frames)
+                if self.ingest is not None:
+                    # An attempt's explicit async upload may still hold a
+                    # pending read of this staging buffer — forfeit (the
+                    # ring heals) instead of recirculating it.
+                    self.batcher.forfeit(frames)
+                else:
+                    self.batcher.recycle(frames)
                 return
             # Host-side dispatch cost (H2D + trace-cache hit + async enqueue
             # — never device compute, which is async from here).
@@ -1082,11 +1196,14 @@ class RecognizerService:
                 # The popped batch dies with this crash; settle it so
                 # drain()'s delivered==completed stays solvable after the
                 # supervisor restarts the loop — and its frames land in
-                # the ledger's crash bucket, not in limbo.
+                # the ledger's crash bucket, not in limbo. The staging
+                # buffer is forfeited, not recycled: the crash may have
+                # left an async H2D read of it pending.
                 self.metrics.incr(mn.FRAMES_DROPPED_CRASHED, count)
                 self._trace_settle(trace_ids[:count],
                                    mn.FRAMES_DROPPED_CRASHED,
                                    "dispatch.crashed", batch=batch_tid)
+                self.batcher.forfeit(frames)
                 self._mark_completed()
             raise
         self.metrics.incr(mn.BATCHES_DISPATCHED)
@@ -1140,21 +1257,33 @@ class RecognizerService:
             self._completed_batches += n
             self._inflight_cv.notify_all()
 
-    def _dispatch_with_retry(self, frames) -> Optional[Any]:
+    def _dispatch_with_retry(self, frames, batch_tid: int = 0
+                             ) -> Optional[Any]:
         """One batch through the device, honoring the resilience policy:
         transient failures retry with exponential backoff (the readback
         worker keeps draining while we wait), permanent ones abandon
         immediately, and ``degraded_after`` consecutive failed attempts
         publish degraded mode. Returns the dispatched (async) output, or
-        None when the batch is abandoned (``batches_failed``)."""
+        None when the batch is abandoned (``batches_failed``). With the
+        ingest subsystem, every ATTEMPT re-uploads the host staging view
+        explicitly (uint8 across the wire, cast fused on device) — a
+        donated device buffer from a failed attempt is never re-fed."""
         policy = self.resilience
         attempt = 0
         while True:
             try:
+                send = frames
+                if self.ingest is not None:
+                    send, up_bytes, up_dur = self.ingest.upload(frames)
+                    if batch_tid:
+                        self.tracer.emit(batch_tid, "upload",
+                                         topic=tracing.BATCH_TOPIC,
+                                         dur=up_dur, bytes=up_bytes,
+                                         dtype=str(frames.dtype))
                 # Packed path: ONE output array -> one D2H readback per
                 # batch (a tunneled backend charges ~100 ms per blocking
                 # readback; five separate arrays measured 5x slower).
-                packed = self.pipeline.recognize_batch_packed(frames)
+                packed = self.pipeline.recognize_batch_packed(send)
                 packed.copy_to_host_async()
             except Exception as exc:  # noqa: BLE001 — classified below
                 self.metrics.incr(mn.DISPATCH_FAILURES)
@@ -1362,7 +1491,10 @@ class RecognizerService:
                 # round-trip never completed, so the backend's async H2D
                 # read of this exact host array may still be pending —
                 # reusing it would race the outage we just survived. The
-                # pool refills from completed batches.
+                # legacy pool refills from completed batches; a bounded
+                # staging ring is told explicitly (forfeit) so it may
+                # heal with one replacement allocation.
+                self.batcher.forfeit(frames)
                 self._dead_letter(count, metas, enqueue_ts, trace_ids,
                                   batch_tid)
                 continue
@@ -1422,8 +1554,9 @@ class RecognizerService:
                 if time.monotonic() >= deadline:
                     # No recycle: the incomplete round-trip may still hold
                     # an async read on this staging buffer (see the worker
-                    # path's dead-letter note).
+                    # path's dead-letter note). Forfeit so a ring heals.
                     self._pop_inflight_head()
+                    self.batcher.forfeit(frames)
                     self._dead_letter(count, metas, enqueue_ts, trace_ids,
                                       batch_tid)
                     continue
@@ -1436,8 +1569,9 @@ class RecognizerService:
                     ready = self._is_ready(packed)
                 if not ready:
                     self._pop_inflight_head()
+                    self.batcher.forfeit(frames)  # no recycle; ring heals
                     self._dead_letter(count, metas, enqueue_ts, trace_ids,
-                                      batch_tid)  # no recycle
+                                      batch_tid)
                     continue
             self._pop_inflight_head()
             self._complete_head(packed, frames, metas, count, enqueue_ts,
@@ -1473,7 +1607,8 @@ class RecognizerService:
             logging.getLogger(__name__).exception(
                 "readback materialize failed")
             self.metrics.incr(mn.READBACK_ERRORS)
-            # completed++, no recycle (see above)
+            # completed++, no recycle (see above); forfeit so a ring heals
+            self.batcher.forfeit(frames)
             self._dead_letter(count, metas, enqueue_ts, trace_ids, batch_tid)
             return
         ready_dur = time.perf_counter() - t_disp
@@ -1491,6 +1626,12 @@ class RecognizerService:
                           gallery_ver)
         except BaseException:
             self._mark_completed()
+            # The readback COMPLETED before publish, so the staging
+            # buffer is safe to recirculate — and with a bounded ring it
+            # MUST be: dropping it here would shrink the ring by one per
+            # publish crash with no heal credit, until admission sheds
+            # everything against a ring that can never refill.
+            self.batcher.recycle(frames)
             raise
         self._mark_completed()
         now = time.perf_counter()

@@ -223,6 +223,11 @@ def rebuild_pipeline_on_cpu(service) -> None:
     # chunk embedder would otherwise keep dispatching on the dead
     # accelerator (see RecognizerService._run_embed_chunk).
     service._embed_device = cpu_device
+    # And the ingest uploader: its explicit per-dispatch device_put would
+    # otherwise keep committing frames to the dead default device —
+    # every batch failing against the very fallback built to survive it.
+    if getattr(service, "ingest", None) is not None:
+        service.ingest.upload_device = cpu_device
 
 
 class ServiceSupervisor:

@@ -77,6 +77,37 @@ BATCHER_BATCHES_DEADLINE = "batcher_batches_deadline"
 BATCHER_BUFFER_REUSE = "batcher_buffer_reuse"
 BATCHER_FLUSH_DEADLINE_MS = "batcher_flush_deadline_ms"
 
+# ---- ingest pipeline (runtime.ingest) ---------------------------------------
+#: staging-ring buffer allocations: the per-rung preallocation at
+#: construction plus outage heals (a forfeited buffer replaced after a
+#: dead-letter). Steady-state serving must never move this counter — the
+#: zero-alloc assertion the ingest tests pin.
+INGEST_STAGING_ALLOCS = "ingest_staging_allocs"
+INGEST_STAGING_REUSE = "ingest_staging_reuse"
+#: an acquire found every fitting rung empty (ring exhausted): the batch
+#: stays queued and admission backpressure (reason ``staging``) sheds new
+#: intake — never an allocation.
+INGEST_STAGING_EXHAUSTED = "ingest_staging_exhausted"
+#: buffers the service told the ring it will never get back (dead-letter /
+#: crash paths keep the staging array out of circulation because the
+#: backend's async H2D read may still be pending).
+INGEST_STAGING_FORFEITS = "ingest_staging_forfeits"
+INGEST_STAGING_FREE = "ingest_staging_free"
+#: host-side device-upload enqueue time (seconds, observe) and the bytes
+#: shipped across H2D — bytes/frame in uint8 mode is the 4x story.
+INGEST_UPLOAD = "ingest_upload"
+INGEST_UPLOAD_BYTES = "ingest_upload_bytes"
+
+# ---- compressed-frame intake: decode worker pool (runtime.ingest) -----------
+DECODE_LATENCY = "decode_latency"
+DECODE_QUEUE_DEPTH = "decode_queue_depth"
+DECODE_FRAMES = "decode_frames"
+DECODE_ERRORS = "decode_errors"
+#: admission-ledger drop bucket: an ADMITTED compressed frame that never
+#: became a pixel frame (corrupt/truncated payload, or decode backlog
+#: overflow) — journaled with reason ``decode_error``/``decode_backlog``.
+FRAMES_DROPPED_DECODE = "frames_dropped_decode"
+
 # ---- connectors ------------------------------------------------------------
 CONNECTOR_MALFORMED_LINES = "connector_malformed_lines"
 CONNECTOR_PEER_DISCONNECTS = "connector_peer_disconnects"

@@ -117,6 +117,21 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("rollout") or {})
         .get("cutover_window_completed_ratio"),
         "ratio_min", 0.80, 0.0),
+    # Ingest pipeline (ISSUE 12): the staging-ring uint8 H2D tail at the
+    # b32 rung (the old --transfer-uint8 path's 118 ms p99 pathology must
+    # never creep back — ratio + absolute slack, same reasoning as the
+    # other microsecond-scale latency gates) and the end-to-end
+    # completed-frames uplift of uint8 mode over the f32 baseline against
+    # the transfer-bound fake backend. Artifacts predating the ingest
+    # section ride the baseline-predates-metric skip.
+    "ingest_h2d_p99_ms": (
+        lambda d: (d.get("ingest") or {})
+        .get("h2d", {}).get("32", {}).get("uint8_ring", {}).get("p99_ms"),
+        "ratio_max", 1.25, 0.5),
+    "ingest_completed_uplift": (
+        lambda d: (d.get("ingest") or {})
+        .get("uplift", {}).get("b32", {}).get("uplift"),
+        "ratio_min", 0.90, 0.0),
 }
 
 

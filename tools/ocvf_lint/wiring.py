@@ -39,6 +39,14 @@ ATTR_HINTS: Dict[str, str] = {
     "rollout": "RolloutCoordinator",
     "stage": "ReEmbedStage",
     "parity": "DualScoreParity",
+    # Ingest subsystem (PR 12): the service's ``self.ingest`` owns the
+    # staging ring + decode pool; ``staging``/``staging_ring`` reach the
+    # ring directly (the batcher holds it as ``_ring``), ``decoder`` is
+    # the off-thread decode worker pool.
+    "ingest": "IngestPipeline",
+    "staging": "StagingRing",
+    "staging_ring": "StagingRing",
+    "decoder": "DecodeWorkerPool",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -47,6 +55,7 @@ ATTR_HINTS: Dict[str, str] = {
 HOT_PATH_SUFFIXES: Tuple[str, ...] = (
     "runtime/recognizer.py",
     "runtime/batcher.py",
+    "runtime/ingest.py",
     "parallel/pipeline.py",
 )
 
