@@ -774,6 +774,78 @@ def main():
         "per_batch": per_batch,
     }
 
+    # -- pass 2c: cascade detect split (ISSUE 13) — stage-1-only vs the
+    # full detector at every sweep rung, with the SAME chained-diff
+    # instrument, so BENCH_DETAIL attribution covers the two-stage
+    # cascade: the per-rung ratio is the raw device-time budget an
+    # early-exited (face-free) frame saves, and the number the serving
+    # gate's operating-point math starts from.
+    from opencv_facerecognizer_tpu.models.cascade import (
+        FaceGate, frame_scores as cascade_frame_scores,
+    )
+
+    gate = FaceGate()
+    t0 = time.perf_counter()
+    gate.train(train_scenes, train_boxes, train_counts, steps=300,
+               batch_size=16)
+    _log(f"cascade gate warm-trained in {time.perf_counter() - t0:.1f}s")
+    gate_net, gate_params = gate.net, gate.params
+
+    def make_stage1_step():
+        def step(det_params, emb_params, gallery, labels, frames):
+            # Params ride as a jit closure constant: the stage-1 graph
+            # has no gallery/embedder inputs, but the shared chained
+            # instrument threads the standard signature through.
+            return jnp.sum(cascade_frame_scores(gate_net, gate_params,
+                                                frames))
+
+        return step
+
+    cascade_rows = {}
+    for batch in BATCH_SWEEP:
+        frames_stack = jnp.stack(all_dev[batch])
+        chained = make_chained_scalar(make_stage1_step())
+
+        def timed_chain(k):
+            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+            _ = np.asarray(acc)
+            t0 = time.perf_counter()
+            acc = chained(det_params, emb_params, g, lab, frames_stack, k)
+            _ = np.asarray(acc)
+            return time.perf_counter() - t0
+
+        t1s, t2s, k2_used, mean_s = measure_chained_retrying(timed_chain)
+        detect_ms = (per_batch.get(str(batch)) or {}).get(
+            "detect", {}).get("ms_per_batch")
+        if mean_s is None:
+            cascade_rows[str(batch)] = {
+                "invalid": "stage-1 chain delta never cleared MIN_DELTA_S",
+                "t_k1_samples_s": [round(t, 4) for t in t1s],
+                "t_k2_samples_s": [round(t, 4) for t in t2s],
+                "full_detect_ms_per_batch": detect_ms,
+            }
+            continue
+        stage1_ms = mean_s * 1e3
+        cascade_rows[str(batch)] = {
+            "stage1_ms_per_batch": round(stage1_ms, 4),
+            "k2_used": k2_used,
+            "full_detect_ms_per_batch": detect_ms,
+            "detect_over_stage1": (round(detect_ms / stage1_ms, 2)
+                                   if detect_ms and stage1_ms > 0 else None),
+        }
+        _log(f"[b{batch} cascade] stage-1 {stage1_ms:.4f} ms/batch vs "
+             f"full detect {detect_ms} ms/batch")
+    detail["cascade_detect"] = {
+        "note": ("stage-1 cascade (models.cascade.FaceGate, 4x avg-pool "
+                 "downsample + two conv blocks, per-tile logits -> max) "
+                 "vs the full detect stage (stage_attribution's ablated "
+                 "prefix) at every sweep rung, chained-diff timing. "
+                 "detect_over_stage1 is the device-time multiple a "
+                 "face-free frame's early exit saves on the detect "
+                 "budget."),
+        "per_batch": cascade_rows,
+    }
+
     # -- pass 3: large-gallery scaling — the fused pipeline at 262k and 1M
     # enrolled rows, pallas streaming matcher (the ShardedGallery auto
     # fast path above 64k) vs the XLA materialize+top_k formulation. The

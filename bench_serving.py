@@ -716,6 +716,267 @@ def run_ingest_smoke(rungs=(8, 32, 128), frame_hw=(64, 64), h2d_iters=160,
     }
 
 
+def run_cascade_smoke(densities=(0.0, 0.3, 0.7), seconds=1.5, batch_size=8,
+                      frame_hw=(32, 32), dispatch_s=0.001,
+                      dispatch_per_frame_s=0.002, cascade_score_s=0.001,
+                      overdrive=4.0, uplift_gate_d0=2.0,
+                      uplift_gate_d30=1.3, recall=True,
+                      recall_min=0.99, recall_train_scenes=128,
+                      recall_held_scenes=64, recall_gate_steps=400,
+                      recall_detector_steps=250, watchdog_seconds=0.6):
+    """The cascade early-exit gate (ISSUE 13): four deterministic arms.
+
+    **uplift** — completed-frames (completed + completed_empty: every
+    admitted frame still gets a result publish) at each face density,
+    cascade on vs off, against a per-frame capacity wall
+    (``InstantPipeline(dispatch_per_frame_s=...)``: the fake's dispatch
+    cost scales with the bucket it carries, the way BENCH_DETAIL says
+    detect does on the chip). The brightness-stub cascade is a
+    deterministic oracle on ``synthetic_frame_stream``'s stamped blobs,
+    so the measured uplift isolates the SERVING MECHANISM — early exit,
+    survivor compaction into the bucket ladder, completed_empty
+    settlement — from model quality. Gates: >= ``uplift_gate_d0``x at
+    0% density, >= ``uplift_gate_d30``x at 30%, exact ledger settlement
+    (in_system == 0 after drain) in every cell.
+
+    **recall** — the model-quality half: a real ``FaceGate`` + full
+    ``CNNFaceDetector`` trained on the shared synthetic scenes; stage-1
+    recall vs the detector's own verdicts on held-out scenes must be
+    >= ``recall_min`` at the default threshold (``evaluate_gate``: a
+    frame stage 2 cannot detect a face in is not a cascade loss).
+
+    **watchdog** — cascade on/off x ingest f32/uint8: every combination
+    prewarms both stages across the ladder at its staging dtype and must
+    serve with ZERO post-warmup recompiles.
+
+    **reject_all** — the ``cascade: reject-all`` chaos fault: a
+    pathological stage 1 (every frame scored face-free) must degrade to
+    zero matches with exact ``completed_empty`` settlement — no wedge,
+    no leaked frames, drain() still converges.
+    """
+    from opencv_facerecognizer_tpu.runtime.admission import (
+        AdmissionController,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, TrafficRecorder, synthetic_frame_stream,
+    )
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        RecognizerService,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    # One fixed offered load for every uplift cell: overdrive x the
+    # NO-CASCADE configuration's capacity wall, so on/off rows compare
+    # completions against the same pressure.
+    base_batch_s = dispatch_s + batch_size * dispatch_per_frame_s
+    capacity_fps = batch_size / base_batch_s
+    offered_hz = overdrive * capacity_fps
+
+    def _drive(density, cascade_on, run_seconds, ingest_mode=None,
+               faults=None, hz=None):
+        metrics = Metrics()
+        pipeline = InstantPipeline(
+            frame_hw, dispatch_s=dispatch_s,
+            dispatch_per_frame_s=dispatch_per_frame_s,
+            cascade_stub=cascade_on, cascade_score_s=cascade_score_s,
+            faces_per_frame=1)
+        kwargs = {}
+        if ingest_mode is not None:
+            from opencv_facerecognizer_tpu.runtime.ingest import IngestConfig
+
+            kwargs["ingest"] = IngestConfig(mode=ingest_mode)
+        connector = FakeConnector()
+        service = RecognizerService(
+            pipeline, connector, batch_size=batch_size,
+            frame_shape=frame_hw, flush_timeout=0.02, inflight_depth=4,
+            similarity_threshold=0.0, metrics=metrics,
+            fault_injector=faults,
+            admission=AdmissionController(
+                max_inflight_frames=4 * batch_size),
+            shed_stale_after_s=0.5,
+            bucket_sizes=(max(1, batch_size // 4),
+                          max(1, batch_size // 2), batch_size),
+            **kwargs)
+        # Warmup without real compiles: mark every (rung, staging dtype)
+        # signature — BOTH stages — compiled, then arm the watchdog (the
+        # same contract service.warmup() provides over a real pipeline).
+        pipeline.prewarm_batch_shapes(service._bucket_ladder, frame_hw,
+                                     service.batcher.dtype)
+        service._warmed = True
+        recorder = TrafficRecorder(connector)
+        service.start(warmup=False)
+        stream = synthetic_frame_stream(512, frame_hw, density, seed=5)
+        rate = offered_hz if hz is None else hz
+        try:
+            interval = 1.0 / rate
+            n = int(run_seconds * rate)
+            start = time.monotonic()
+            for i in range(n):
+                target = start + i * interval
+                now = time.monotonic()
+                if target > now:
+                    time.sleep(target - now)
+                frame, _k = stream[i % len(stream)]
+                recorder.offer(connector, {"frame": frame}, i,
+                               "interactive")
+            service.drain(timeout=30.0)
+        finally:
+            service.stop()
+        ledger = service.ledger()
+        c = metrics.counters()
+        return {
+            "offered": n,
+            "completed": int(ledger["completed"]),
+            "completed_empty": int(ledger["completed_empty"]),
+            "completed_total": int(ledger["completed"]
+                                   + ledger["completed_empty"]),
+            "cascade_batch_exits": int(c.get("cascade_batch_exits", 0.0)),
+            "recompiles_post_warmup": int(
+                c.get("recompiles_post_warmup", 0.0)),
+            "ledger_in_system_after_drain": ledger["in_system"],
+            "faces_found": int(c.get("faces_found", 0.0)),
+        }
+
+    uplift = {}
+    uplift_ok = True
+    ledger_ok = True
+    for density in densities:
+        off_row = _drive(density, cascade_on=False, run_seconds=seconds)
+        on_row = _drive(density, cascade_on=True, run_seconds=seconds)
+        ratio = (on_row["completed_total"] / off_row["completed_total"]
+                 if off_row["completed_total"] else None)
+        row = {
+            "offered_hz": round(offered_hz, 1),
+            "cascade_off": off_row,
+            "cascade_on": on_row,
+            # ``is not None``, not truthiness: a measured 0.0 uplift is a
+            # real (catastrophic) value the gates below must see, never a
+            # missing measurement.
+            "uplift": round(ratio, 3) if ratio is not None else None,
+        }
+        ledger_ok = (ledger_ok
+                     and off_row["ledger_in_system_after_drain"] == 0
+                     and on_row["ledger_in_system_after_drain"] == 0)
+        key = f"d{int(round(density * 100))}"
+        uplift[key] = row
+        print(json.dumps({"cascade_density": density,
+                          "uplift": row["uplift"]}), file=sys.stderr)
+    # Both gates FAIL CLOSED: a swept density whose uplift could not be
+    # measured (or measured 0.0) is a failure, never a skip. Only a
+    # density that was not swept at all (no row) bypasses its gate.
+    d0 = uplift.get("d0", {}).get("uplift")
+    d30_row = uplift.get("d30")
+    d30 = d30_row.get("uplift") if d30_row else None
+    uplift_ok = (d0 is not None and d0 >= uplift_gate_d0
+                 and (d30_row is None
+                      or (d30 is not None and d30 >= uplift_gate_d30))
+                 and ledger_ok)
+
+    # -- recall: the real two-stage pair on shared synthetic scenes --
+    if recall:
+        from opencv_facerecognizer_tpu.models.cascade import (
+            FaceGate, evaluate_gate,
+        )
+        from opencv_facerecognizer_tpu.models.detector import (
+            CNNFaceDetector,
+        )
+        from opencv_facerecognizer_tpu.utils.dataset import (
+            make_synthetic_scenes,
+        )
+
+        scenes, boxes, counts = make_synthetic_scenes(
+            recall_train_scenes, (96, 96), max_faces=2, seed=3)
+        detector = CNNFaceDetector(features=(8, 16, 32), head_features=32,
+                                   max_faces=4, score_threshold=0.25)
+        detector.train(scenes, boxes, counts,
+                       steps=recall_detector_steps, batch_size=16,
+                       learning_rate=2e-3)
+        gate = FaceGate()
+        gate.train(scenes, boxes, counts, steps=recall_gate_steps,
+                   batch_size=32)
+        held, _hb, held_counts = make_synthetic_scenes(
+            recall_held_scenes, (96, 96), max_faces=2, seed=99)
+        # gt_counts: recall is measured over stage-2-detectable FACE
+        # frames — a detector false positive on a background frame is
+        # not a face the cascade can lose (its suppression is reported
+        # as detector_fp_suppressed, a precision win).
+        recall_row = evaluate_gate(gate, detector, held,
+                                   gt_counts=held_counts)
+        recall_row["recall_ok"] = bool(
+            recall_row["stage1_recall"] >= recall_min)
+        print(json.dumps({"cascade_recall": recall_row}), file=sys.stderr)
+    else:
+        recall_row = {"skipped": "recall arm disabled for this run",
+                      "recall_ok": True}
+
+    # -- watchdog: cascade on/off x ingest modes, zero recompiles --
+    watchdog = {}
+    watchdog_ok = True
+    for ingest_mode in ("f32", "uint8"):
+        for cascade_on in (True, False):
+            key = f"{ingest_mode}_cascade_{'on' if cascade_on else 'off'}"
+            row = _drive(0.3, cascade_on, watchdog_seconds,
+                         ingest_mode=ingest_mode,
+                         hz=min(offered_hz, 2.0 * capacity_fps))
+            watchdog[key] = {
+                "recompiles_post_warmup": row["recompiles_post_warmup"],
+                "completed_total": row["completed_total"],
+                "ledger_in_system_after_drain":
+                    row["ledger_in_system_after_drain"],
+            }
+            watchdog_ok = (watchdog_ok
+                           and row["recompiles_post_warmup"] == 0
+                           and row["ledger_in_system_after_drain"] == 0)
+
+    # -- reject_all: the pathological stage 1, chaos-injected --
+    from opencv_facerecognizer_tpu.runtime.faults import FaultInjector
+
+    injector = FaultInjector(seed=7,
+                             rates={"cascade": {"reject_all": 1.0}})
+    reject_row = _drive(0.7, cascade_on=True, run_seconds=seconds,
+                        faults=injector, hz=capacity_fps)
+    reject_row["injected"] = injector.summary()
+    reject_ok = (reject_row["completed"] == 0
+                 and reject_row["faces_found"] == 0
+                 and reject_row["completed_empty"] > 0
+                 and reject_row["ledger_in_system_after_drain"] == 0)
+    reject_row["reject_all_ok"] = reject_ok
+    print(json.dumps({"cascade_reject_all": reject_row}), file=sys.stderr)
+
+    return {
+        "note": ("cascade early-exit gate: (1) uplift — completed frames "
+                 "(incl. completed_empty results) at 0/30/70% face "
+                 "density, cascade on vs off, against a per-frame "
+                 "dispatch wall; gates >= "
+                 f"{uplift_gate_d0}x at 0% and >= {uplift_gate_d30}x at "
+                 "30% with exact ledger settlement. (2) recall — a real "
+                 "FaceGate vs the full CNNFaceDetector's own verdicts on "
+                 f"held-out scenes: stage-1 recall >= {recall_min} at "
+                 "the default threshold. (3) watchdog — cascade on/off x "
+                 "ingest f32/uint8 all serve with zero post-warmup "
+                 "recompiles. (4) reject_all — the cascade:reject-all "
+                 "chaos fault degrades to zero matches with exact "
+                 "completed_empty settlement, no wedge."),
+        "config": {"densities": list(densities), "seconds": seconds,
+                   "batch_size": batch_size, "frame": list(frame_hw),
+                   "dispatch_s": dispatch_s,
+                   "dispatch_per_frame_s": dispatch_per_frame_s,
+                   "cascade_score_s": cascade_score_s,
+                   "capacity_fps": round(capacity_fps, 1),
+                   "offered_hz": round(offered_hz, 1),
+                   "overdrive": overdrive},
+        "uplift": uplift,
+        "uplift_ok": bool(uplift_ok),
+        "recall": recall_row,
+        "watchdog": watchdog,
+        "watchdog_ok": bool(watchdog_ok),
+        "reject_all": reject_row,
+        "cascade_ok": bool(uplift_ok and recall_row.get("recall_ok")
+                           and watchdog_ok and reject_ok),
+    }
+
+
 def run_overload_sweep(multipliers=(1.0, 2.0, 4.0), seconds=3.0,
                        batch_size=8, frame_hw=(32, 32), dispatch_s=0.04):
     """Offered-load ladder against a capacity-limited fake backend
@@ -1147,6 +1408,7 @@ def main(argv=None):
         artifact["tracing_overhead"] = run_tracing_overhead()
         artifact["replica_scaleout"] = run_replica_scaleout()
         artifact["rollout"] = run_rollout_smoke()
+        artifact["cascade"] = run_cascade_smoke()
         with open("BENCH_SERVING_smoke.json", "w") as fh:
             json.dump(artifact, fh, indent=2)
         print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
@@ -1186,16 +1448,29 @@ def main(argv=None):
                 "parity_agreement"),
             "rollout_cutover_completed_ratio": artifact["rollout"].get(
                 "cutover_window_completed_ratio"),
+            "cascade_uplift_density0": artifact["cascade"]["uplift"]
+            .get("d0", {}).get("uplift"),
+            "cascade_uplift_density30": artifact["cascade"]["uplift"]
+            .get("d30", {}).get("uplift"),
+            "cascade_stage1_recall": artifact["cascade"]["recall"]
+            .get("stage1_recall"),
+            "cascade_ok": artifact["cascade"]["cascade_ok"],
         }))
-        # All three gates fail closed (False on a failed measurement):
+        # All four gates fail closed (False on a failed measurement):
         # tracing overhead, the 2-replica >= 1.6x completed-frames
-        # scaling, AND the ingest gate (ring H2D p99 within 3x p50 at
+        # scaling, the ingest gate (ring H2D p99 within 3x p50 at
         # every rung, >= 1.15x uint8 completed-frames uplift at b32 with
         # >= 3.5x fewer bytes/frame, zero steady-state staging allocs,
-        # compressed intake completing every offered frame).
+        # compressed intake completing every offered frame), AND the
+        # cascade gate (>= 2x completed-frames uplift at 0% face
+        # density / >= 1.3x at 30%, stage-1 recall >= 0.99 at the
+        # default threshold, zero post-warmup recompiles across cascade
+        # on/off x ingest modes, exact completed_empty settlement under
+        # the reject-all chaos fault).
         return (0 if trace_cmp.get("within_gate")
                 and scaleout.get("scaling_2x_ok")
-                and ingest.get("ingest_ok") else 3)
+                and ingest.get("ingest_ok")
+                and artifact["cascade"].get("cascade_ok") else 3)
 
     import jax
 

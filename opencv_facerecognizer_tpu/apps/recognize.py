@@ -118,6 +118,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "under load) is gone — this flag now routes "
                         "through the pre-allocated staging ring, which "
                         "keeps the 4x byte win without the p99 pathology")
+    # ---- cascade early-exit detection (models.cascade; README) ----
+    p.add_argument("--cascade", metavar="PATH",
+                   help="stage-1 FaceGate checkpoint (models.cascade."
+                        "FaceGate.save): score every frame at reduced "
+                        "resolution first and dispatch only face-possible "
+                        "frames to the full detector; face-free frames "
+                        "settle as completed_empty with an empty result "
+                        "publish. Unset = single-stage serving")
+    p.add_argument("--cascade-threshold", type=float, default=None,
+                   metavar="P",
+                   help="stage-1 operating point: frames scoring below P "
+                        "exit early. Default: the checkpoint's own trained "
+                        "threshold. Brownout level >= 1 tightens it one "
+                        "notch (rejecting borderline frames) before "
+                        "shedding admitted intake")
+    p.add_argument("--no-cascade", action="store_true",
+                   help="escape hatch: serve single-stage even with a "
+                        "--cascade checkpoint loaded (e.g. to A/B the "
+                        "gate's recall in production)")
     p.add_argument("--similarity-threshold", type=float, default=0.3)
     p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
     p.add_argument("--gallery-dtype", choices=["bf16", "f32"], default="bf16",
@@ -387,6 +406,9 @@ def _load_stack(args):
         raise SystemExit("--match-mode ivf applies to --parallel fused only "
                          "(the two-stage path is single-device, like the "
                          "pallas streaming matcher)")
+    if args.cascade and args.parallel == "pp":
+        raise SystemExit("--cascade applies to --parallel fused only (the "
+                         "pipeline-parallel path carries no stage-1 gate)")
 
     serialization.register(CNNEmbedding)
     model = serialization.load_model(args.model)
@@ -394,6 +416,11 @@ def _load_stack(args):
     if not isinstance(feature, CNNEmbedding):
         raise SystemExit("--model must be a cnn checkpoint (ocvf-train --model cnn)")
     detector = CNNFaceDetector.load(args.detector)
+    face_gate = None
+    if args.cascade:
+        from opencv_facerecognizer_tpu.models.cascade import FaceGate
+
+        face_gate = FaceGate.load(args.cascade)
 
     images, labels, names = dataset_utils.read_images(
         args.gallery, image_size=feature.input_size
@@ -487,6 +514,7 @@ def _load_stack(args):
             face_size=feature.input_size,
             fused_embedder=args.fused_embedder,
             donate_frames=donate,
+            cascade=face_gate,
         )
     return pipeline, names
 
@@ -823,6 +851,8 @@ def main(argv=None) -> int:
         tracer=tracer,
         slo_monitor=slo_monitor,
         replica=replica,
+        cascade=not args.no_cascade,
+        cascade_threshold=args.cascade_threshold,
     )
     if slo_monitor is not None and replica is not None:
         # Stale-replica brownout: the lag gauge objective rides the same

@@ -80,6 +80,7 @@ class RecognitionPipeline:
         top_k: int = 1,
         fused_embedder: bool = False,
         donate_frames: bool = False,
+        cascade=None,
     ):
         self.detector = detector
         self.embed_net = embed_net
@@ -87,6 +88,13 @@ class RecognitionPipeline:
         self.gallery = gallery
         self.face_size = tuple(face_size)
         self.top_k = int(top_k)
+        # Stage-1 detection cascade (models.cascade.FaceGate): when set,
+        # the serving runtime scores every batch with ``cascade_scores``
+        # first and only survivors reach the fused detect->crop->embed->
+        # match step — rejected frames settle as ``completed_empty`` in
+        # the admission ledger (runtime/recognizer.py owns the decision;
+        # this object only holds the compiled per-rung stage-1 pass).
+        self.cascade = cascade
         # Donate the frames argument of the PACKED serving step through
         # the whole bucketed ladder: the ingest uploader ships each batch
         # as its own fresh device array (uint8, one device_put per
@@ -120,6 +128,10 @@ class RecognitionPipeline:
         # keyed by _step_key: (batch, h, w, dtype_str, capacity, pallas)
         self._step_cache: Dict[Tuple, Any] = {}
         self._packed_cache: Dict[Tuple, Any] = {}
+        # Stage-1 cascade executables, keyed (batch, h, w, dtype_str):
+        # gallery capacity never enters the stage-1 graph, so grows and
+        # quantizer churn leave these warm.
+        self._cascade_cache: Dict[Tuple, Any] = {}
         # Register with the gallery's async-grow machinery: when a grow is
         # imminent/in flight, the worker thread compiles THIS pipeline's
         # step for the target capacity before the swap is published, so
@@ -294,6 +306,37 @@ class RecognitionPipeline:
             ivf if ivf is not None else (),
         )
 
+    def cascade_scores(self, frames) -> jnp.ndarray:
+        """Compiled stage-1 pass: [B, H, W] frames (f32 or uint8) -> [B]
+        face-possible probabilities on device. Cache-keyed per
+        (shape, dtype) exactly like the serving steps, so every dispatch
+        rung the warmup prewarmed is a jit-cache hit — the recompile
+        watchdog reads ``last_cascade_info`` the way it reads
+        ``last_dispatch_info`` for stage 2. The caller (the serving
+        loop's cascade gate) materializes the tiny [B] result; that one
+        readback IS the early-exit decision point."""
+        from opencv_facerecognizer_tpu.models import cascade as cascade_mod
+
+        gate = self.cascade
+        if gate is None:
+            raise RuntimeError("cascade_scores called with no cascade gate")
+        frames = self._as_device_frames(frames)
+        key = (*frames.shape, str(frames.dtype))
+        fn = self._cascade_cache.get(key)
+        # Host-side provenance for the recompile watchdog (mirrors
+        # last_dispatch_info: plain attr store, informational only).
+        self.last_cascade_info = {"cache_hit": fn is not None}
+        if fn is None:
+            net = gate.net
+
+            def stage1(params, fr):
+                # uint8 ingest frames cast on device, like the fused step.
+                return cascade_mod.frame_scores(net, params,
+                                                fr.astype(jnp.float32))
+
+            fn = self._cascade_cache[key] = jax.jit(stage1)  # ocvf-lint: boundary=jit-recompile-hazard -- cache-keyed stage-1 builder: warmup compiles every (rung, ingest dtype) signature up front; serving lands here only on a genuinely new shape
+        return fn(gate.params, frames)
+
     def prewarm_batch_shapes(self, batch_sizes, frame_shape,
                              dtype=np.float32) -> int:
         """Compile the packed serving step for every dispatch-bucket size
@@ -310,6 +353,15 @@ class RecognitionPipeline:
             out = self.recognize_batch_packed(zeros)
             if hasattr(out, "block_until_ready"):
                 out.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup runs BEFORE serving starts; blocking here is the point (compiles must land before the first real frame)
+            if self.cascade is not None:
+                # BOTH cascade stages warm per rung (and per ingest
+                # dtype — the caller passes the batcher's staging dtype):
+                # a mid-serving stage-1 compile would trip the same
+                # recompile watchdog the ladder prewarm exists to keep
+                # green.
+                scores = self.cascade_scores(zeros)
+                if hasattr(scores, "block_until_ready"):
+                    scores.block_until_ready()  # ocvf-lint: boundary=host-sync -- warmup precedes serving; the stage-1 compile must land with the ladder's
             built += 1
         return built
 

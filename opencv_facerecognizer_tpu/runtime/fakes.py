@@ -89,12 +89,37 @@ class InstantPipeline:
                  max_faces: int = 2, compute_s: float = 0.0,
                  sync_poll_floor_s: float = 0.0, dispatch_s: float = 0.0,
                  faces_per_frame: int = 0,
-                 h2d_gb_s: Optional[float] = None):
+                 h2d_gb_s: Optional[float] = None,
+                 dispatch_per_frame_s: float = 0.0,
+                 cascade_stub: bool = False,
+                 cascade_score_s: float = 0.0):
         self.frame_shape = tuple(frame_shape)
         self.top_k = int(top_k)
         self.max_faces = int(max_faces)
         self.compute_s = float(compute_s)
         self.sync_poll_floor_s = float(sync_poll_floor_s)
+        #: host-side seconds charged PER FRAME inside each dispatch call,
+        #: on top of ``dispatch_s`` — models the per-frame device cost
+        #: BENCH_DETAIL attributes to detect (dominant at every bucket),
+        #: so the cascade's survivor compaction actually buys capacity
+        #: against this fake's wall the way it does on the chip: a
+        #: smaller dispatched bucket costs proportionally less.
+        self.dispatch_per_frame_s = float(dispatch_per_frame_s)
+        #: stage-1 cascade stand-in (the serving gate duck-types
+        #: ``pipeline.cascade`` + ``cascade_scores``): scores each frame
+        #: by peak brightness — the synthetic face blobs are stamped at
+        #: 200 on a <=90 background (``_stamp_faces``), so a brightness
+        #: threshold is a deterministic, training-free oracle for the
+        #: perf smokes. ``cascade_score_s`` is the scripted cost of one
+        #: stage-1 pass (charged per call, whole-batch).
+        self.cascade = "brightness-stub" if cascade_stub else None
+        self.cascade_score_s = float(cascade_score_s)
+        self.cascade_calls = 0
+        #: (batch, dtype) stage-1 signatures already "compiled" — the
+        #: cascade mirror of ``compiled_batch_sizes``, feeding
+        #: ``last_cascade_info`` for the recompile watchdog.
+        self.compiled_cascade_sigs: set = set()
+        self.last_cascade_info: dict = {}
         #: simulated H2D bandwidth (GB/s): each dispatch additionally
         #: sleeps frames.nbytes / bandwidth, making the fake backend
         #: TRANSFER-bound the way BENCH_DETAIL says the real one is — a
@@ -141,11 +166,30 @@ class InstantPipeline:
     def prewarm_batch_shapes(self, ladder, frame_shape,
                              dtype=np.float32) -> None:
         """Mirror ``RecognitionPipeline.prewarm_batch_shapes``: mark every
-        (ladder bucket, transfer dtype) signature compiled so post-warmup
-        serving dispatches are cache hits — the recompile watchdog's
-        armed-and-silent baseline."""
+        (ladder bucket, transfer dtype) signature compiled — BOTH stages
+        when the cascade stub is armed, like the real pipeline — so
+        post-warmup serving dispatches are cache hits: the recompile
+        watchdog's armed-and-silent baseline."""
         for bucket in ladder:
             self.compiled_batch_sizes.add(self._sig(bucket, dtype))
+            if self.cascade is not None:
+                self.compiled_cascade_sigs.add(self._sig(bucket, dtype))
+
+    def cascade_scores(self, frames) -> np.ndarray:
+        """Scripted stage-1 pass: [B, H, W] -> [B] scores (1.0 for frames
+        carrying a bright face blob, 0.0 otherwise — see ``cascade`` in
+        ``__init__``). Charges ``cascade_score_s`` per call and records
+        compile provenance like the packed path."""
+        host = np.asarray(frames)
+        if self.cascade_score_s > 0.0:
+            time.sleep(self.cascade_score_s)
+        self.cascade_calls += 1
+        sig = self._sig(host.shape[0], host.dtype)
+        self.last_cascade_info = {
+            "cache_hit": sig in self.compiled_cascade_sigs}
+        self.compiled_cascade_sigs.add(sig)
+        return (host.reshape(host.shape[0], -1).max(axis=1)
+                >= 150).astype(np.float32)
 
     def recognize_batch_packed(self, frames) -> FakePacked:
         if self.fault_injector is not None:
@@ -153,6 +197,10 @@ class InstantPipeline:
         host = np.asarray(frames)
         if self.dispatch_s > 0.0:
             time.sleep(self.dispatch_s)  # capacity wall (see __init__)
+        if self.dispatch_per_frame_s > 0.0:
+            # Per-frame device-cost wall: a compacted/bucketed batch pays
+            # for the frames it actually carries (see __init__).
+            time.sleep(host.shape[0] * self.dispatch_per_frame_s)
         if self.h2d_gb_s:
             # Transfer wall: the scripted PCIe/tunnel cost of shipping
             # this batch's actual bytes (so uint8 staging pays 1/4 the
@@ -182,6 +230,23 @@ class InstantPipeline:
                           poll_cost_s=self.sync_poll_floor_s)
 
 
+def _stamp_faces(rng, frame: np.ndarray, n_faces: int) -> None:
+    """Stamp ``n_faces`` bright face-ish blobs (a light square with
+    darker eye dots) onto ``frame`` in place at seeded positions. The
+    blob peak (200) sits far above the 20-90 background, so both the
+    ``InstantPipeline`` brightness-stub cascade and a trained
+    ``FaceGate`` separate stamped from face-free frames cleanly."""
+    h, w = frame.shape
+    for _face in range(int(n_faces)):
+        side = int(rng.integers(max(6, h // 8), max(8, h // 3)))
+        y0 = int(rng.integers(0, max(1, h - side)))
+        x0 = int(rng.integers(0, max(1, w - side)))
+        frame[y0:y0 + side, x0:x0 + side] = 200
+        ey = y0 + side // 3
+        for ex in (x0 + side // 4, x0 + 3 * side // 4):
+            frame[max(0, ey - 1):ey + 1, max(0, ex - 1):ex + 1] = 60
+
+
 def synthetic_jpeg_frames(n: int, frame_hw: Tuple[int, int] = (64, 64),
                           seed: int = 0, quality: int = 85,
                           faces_per_frame: int = 0):
@@ -190,10 +255,9 @@ def synthetic_jpeg_frames(n: int, frame_hw: Tuple[int, int] = (64, 64),
     seed — the same seed always produces byte-identical payloads, so the
     ingest tests and the smoke bench replay exactly.
 
-    ``faces_per_frame`` stamps that many bright face-ish blobs (a light
-    square with darker eye dots) onto each frame at seeded positions —
-    the knob the face-density traffic mix (ROADMAP item #2's cascade
-    bench) reuses to script how much of a stream contains faces at all.
+    ``faces_per_frame`` stamps that many bright face-ish blobs
+    (``_stamp_faces``) onto each frame at seeded positions — the knob the
+    face-density traffic mix (``synthetic_frame_stream``) composes with.
     """
     from opencv_facerecognizer_tpu.runtime.ingest import encode_jpeg
 
@@ -202,15 +266,44 @@ def synthetic_jpeg_frames(n: int, frame_hw: Tuple[int, int] = (64, 64),
     out = []
     for _ in range(int(n)):
         frame = rng.integers(20, 90, size=(h, w)).astype(np.uint8)
-        for _face in range(int(faces_per_frame)):
-            side = int(rng.integers(max(6, h // 8), max(8, h // 3)))
-            y0 = int(rng.integers(0, max(1, h - side)))
-            x0 = int(rng.integers(0, max(1, w - side)))
-            frame[y0:y0 + side, x0:x0 + side] = 200
-            ey = y0 + side // 3
-            for ex in (x0 + side // 4, x0 + 3 * side // 4):
-                frame[max(0, ey - 1):ey + 1, max(0, ex - 1):ex + 1] = 60
+        _stamp_faces(rng, frame, faces_per_frame)
         out.append((encode_jpeg(frame, quality=quality), frame))
+    return out
+
+
+def synthetic_frame_stream(n: int, frame_hw: Tuple[int, int] = (64, 64),
+                           face_density: float = 0.3, seed: int = 0,
+                           faces_per_frame: int = 1, jpeg: bool = False,
+                           quality: int = 85):
+    """Seeded face-density traffic mix (ISSUE 13; reusable by the video
+    workload of ROADMAP item #3): ``n`` frames of which EXACTLY
+    ``round(n * face_density)`` carry ``faces_per_frame`` stamped face
+    blobs, the rest pure background — the deterministic mixed stream the
+    cascade uplift bench sweeps density over. Which positions carry
+    faces is a seeded permutation, so the mix is interleaved, not a
+    prefix, and byte-identical per seed.
+
+    Returns ``[(frame, n_faces)]`` (uint8 grayscale), or with
+    ``jpeg=True`` ``[(jpeg_bytes, frame, n_faces)]`` — composing with
+    the PR 12 compressed-intake path the way ``synthetic_jpeg_frames``
+    payloads do."""
+    n = int(n)
+    rng = np.random.default_rng(seed)
+    h, w = int(frame_hw[0]), int(frame_hw[1])
+    n_faced = int(round(n * float(face_density)))
+    faced = np.zeros(n, dtype=bool)
+    faced[rng.permutation(n)[:n_faced]] = True
+    out = []
+    for i in range(n):
+        frame = rng.integers(20, 90, size=(h, w)).astype(np.uint8)
+        k = int(faces_per_frame) if faced[i] else 0
+        _stamp_faces(rng, frame, k)
+        if jpeg:
+            from opencv_facerecognizer_tpu.runtime.ingest import encode_jpeg
+
+            out.append((encode_jpeg(frame, quality=quality), frame, k))
+        else:
+            out.append((frame, k))
     return out
 
 

@@ -46,6 +46,13 @@ BOUNDARIES: Dict[str, tuple] = {
     "put": ("corrupt",),
     "dispatch": ("unavailable",),
     "readback": ("stuck", "slow"),
+    # Stage-1 cascade gate (runtime.recognizer._cascade_gate): a
+    # pathological first stage that scores EVERY frame face-free — the
+    # worst-case operating point (a corrupted gate checkpoint, a camera
+    # whose exposure collapsed). The service must degrade to publishing
+    # empty results with exact ``completed_empty`` ledger settlement —
+    # zero matches, zero wedges, zero leaked frames.
+    "cascade": ("reject_all",),
     # Compressed-frame intake (runtime.ingest.DecodeWorkerPool): "slow" =
     # a congested decoder (the worker sleeps slow_decode_s before
     # decoding — the pool must absorb it off the hot thread); "corrupt" =
@@ -275,6 +282,15 @@ class FaultInjector:
         if fault == "slow":
             return SlowReadback(device_array, self.slow_readback_s)
         return StuckReadback(device_array)
+
+    def on_cascade(self, keep: np.ndarray) -> np.ndarray:
+        """Stage-1 cascade boundary: ``reject_all`` replaces the gate's
+        keep mask with all-False — every frame in the batch scores
+        face-free, so the whole batch must exit early as
+        ``completed_empty`` with exact ledger settlement."""
+        if self._draw("cascade") is None:
+            return keep
+        return np.zeros_like(keep, dtype=bool)
 
     def on_decode(self, payload: bytes) -> bytes:
         """Compressed-intake decode boundary (runs on a decode worker,

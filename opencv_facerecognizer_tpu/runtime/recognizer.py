@@ -146,6 +146,16 @@ FALLBACK_DRAIN_POLL_S = 0.05
 #: real frame count, so light traffic pays small-batch compute without ever
 #: compiling a new shape mid-serving.
 DEFAULT_BUCKET_SIZES = (8, 32, 128)
+#: Default stage-1 cascade operating point (mirrors
+#: ``models.cascade.DEFAULT_THRESHOLD`` without importing flax here):
+#: frames scoring below it are face-free early exits (``completed_empty``),
+#: frames at/above it survive to the full detector.
+DEFAULT_CASCADE_THRESHOLD = 0.3
+#: How much ``--cascade-threshold`` tightens per brownout escalation: at
+#: effective brownout level >= 1 the gate raises its threshold one notch
+#: (rejecting MORE borderline frames — shedding device work) BEFORE the
+#: intake skip starts dropping admitted bulk frames outright.
+CASCADE_BROWNOUT_NOTCH = 0.15
 
 
 @dataclass
@@ -300,6 +310,20 @@ class RecognizerService:
         # worker pool for compressed camera payloads. None = the
         # pre-ingest behavior, unchanged.
         ingest: Optional[IngestConfig] = None,
+        # ---- cascade early-exit detection (ISSUE 13) ----
+        # Master switch for the two-stage gate (the --no-cascade escape
+        # hatch). Active only when the pipeline also carries a stage-1
+        # model (``pipeline.cascade`` + ``cascade_scores``); True with a
+        # cascade-less pipeline is the unchanged single-stage behavior.
+        cascade: bool = True,
+        # Stage-1 operating point: frames scoring below it settle as
+        # ``completed_empty`` without ever reaching the full detector.
+        # None adopts the gate's own trained threshold (or the default).
+        cascade_threshold: Optional[float] = None,
+        # Brownout integration: threshold tightening per escalation (the
+        # cheapest shed — reject borderline frames at stage 1 before the
+        # intake skip drops admitted frames outright). 0 disables.
+        cascade_brownout_notch: float = CASCADE_BROWNOUT_NOTCH,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -356,6 +380,22 @@ class RecognizerService:
         # the whole bucket ladder — before that, a jit-cache miss is the
         # expected cost of starting up, not a mid-serving compile.
         self._warmed = False
+        # Cascade early-exit gate (ISSUE 13): active iff enabled AND the
+        # pipeline carries a stage-1 model. The threshold resolves
+        # knob > gate's trained operating point > module default.
+        gate = getattr(pipeline, "cascade", None)
+        self._cascade_active = (bool(cascade) and gate is not None
+                                and hasattr(pipeline, "cascade_scores"))
+        if cascade_threshold is None:
+            cascade_threshold = getattr(gate, "threshold", None)
+        self.cascade_threshold = float(
+            DEFAULT_CASCADE_THRESHOLD if cascade_threshold is None
+            else cascade_threshold)
+        self.cascade_brownout_notch = float(cascade_brownout_notch)
+        # Cumulative scored/rejected counts behind the /prom rate gauges
+        # (serving-thread only — no lock needed).
+        self._cascade_scored = 0
+        self._cascade_rejected = 0
         self._bucket_ladder = self._build_bucket_ladder(bucket_sizes,
                                                         int(batch_size))
         # Ingest subsystem (runtime.ingest): staging ring sized per
@@ -501,21 +541,28 @@ class RecognizerService:
 
     def ledger(self) -> Dict[str, Any]:
         """One atomic admission-ledger snapshot: ``admitted``,
-        ``completed``, per-reason ``drops_by_reason`` and the ``in_system``
-        remainder (frames admitted but not yet finished — queued in the
-        batcher, riding an in-flight batch, or mid-publish). At quiescence
-        (after ``drain()``) ``in_system`` must be exactly 0 — chaos_soak
-        and the overload tests enforce it."""
+        ``completed``, ``completed_empty`` (cascade early exits — frames
+        published with an empty face list because stage 1 scored them
+        face-free; terminal completions, not drops), per-reason
+        ``drops_by_reason`` and the ``in_system`` remainder (frames
+        admitted but not yet finished — queued in the batcher, riding an
+        in-flight batch, or mid-publish). The invariant is
+        ``admitted == completed + completed_empty + Σ drops`` at
+        quiescence (after ``drain()``, ``in_system`` must be exactly 0) —
+        chaos_soak and the overload/cascade tests enforce it."""
         c = self.metrics.counters()
         drops = {name: c[name] for name in self.LEDGER_DROP_COUNTERS
                  if c.get(name)}
         admitted = c.get(mn.FRAMES_ADMITTED, 0.0)
         completed = c.get(mn.FRAMES_COMPLETED, 0.0)
+        completed_empty = c.get(mn.FRAMES_COMPLETED_EMPTY, 0.0)
         return {
             "admitted": admitted,
             "completed": completed,
+            "completed_empty": completed_empty,
             "drops_by_reason": drops,
-            "in_system": admitted - completed - sum(drops.values()),
+            "in_system": (admitted - completed - completed_empty
+                          - sum(drops.values())),
         }
 
     def frames_in_system(self) -> float:
@@ -527,7 +574,8 @@ class RecognizerService:
         quiescence."""
         return max(0.0, self.metrics.sum_counters(
             (mn.FRAMES_ADMITTED,),
-            (mn.FRAMES_COMPLETED,) + self.LEDGER_DROP_COUNTERS))
+            (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY)
+            + self.LEDGER_DROP_COUNTERS))
 
     def _journal_drop(self, reason: str, entries: List[Dict[str, Any]],
                       **extra) -> None:
@@ -696,6 +744,127 @@ class RecognizerService:
             return self._bucket_ladder[0]
         return None
 
+    # ---- cascade early-exit gate (ISSUE 13) ----
+
+    def _effective_cascade_threshold(self) -> float:
+        """The stage-1 operating threshold, tightened one notch while
+        brownout pressure is on (effective level >= 1, incl. the SLO
+        critical boost): rejecting borderline frames at stage 1 is the
+        cheapest possible shed — it saves whole stage-2 dispatches
+        BEFORE the intake skip starts dropping admitted frames
+        outright. The gauge on /prom always shows the EFFECTIVE value."""
+        thr = self.cascade_threshold
+        if (self.brownout_policy is not None and self.cascade_brownout_notch
+                and self._effective_brownout_level() >= 1):
+            thr = min(0.99, thr + self.cascade_brownout_notch)
+        return thr
+
+    def _cascade_keep_mask(self, frames, count: int,
+                           batch_tid: int) -> Optional[np.ndarray]:
+        """One stage-1 pass over the batch's dispatch rung: returns the
+        per-frame keep mask (True = face-possible, survives to the full
+        detector) for the first ``count`` frames, or None when stage 1
+        is unavailable this batch — a scoring error fails OPEN to the
+        full chain (the cascade may save device time, never cost
+        availability). The tiny [B]-float readback here IS the
+        early-exit decision point; its host wall (incl. that readback)
+        lands in the ``cascade_score`` window."""
+        thr = self._effective_cascade_threshold()
+        t0 = time.perf_counter()
+        bucket = self._pick_bucket(count)
+        view = frames[:bucket] if bucket < len(frames) else frames
+        try:
+            scores = np.asarray(self.pipeline.cascade_scores(view))  # ocvf-lint: boundary=host-sync -- the cascade's designed decision readback: a [B]-float materialize whose entire purpose is deciding whether the expensive stage-2 dispatch happens at all (ISSUE 13)
+        except Exception:  # noqa: BLE001 — fail open: stage 2 serves the batch
+            logging.getLogger(__name__).exception(
+                "cascade stage-1 scoring failed; serving the full batch")
+            self.metrics.incr(mn.CASCADE_ERRORS)
+            return None
+        dur = time.perf_counter() - t0
+        self.metrics.observe(mn.CASCADE_SCORE, dur)
+        info = getattr(self.pipeline, "last_cascade_info", None) or {}
+        if self._warmed and info.get("cache_hit") is False:
+            self._note_recompile(bucket, count, "cascade")
+        keep = np.asarray(scores)[:count] >= thr
+        if self._faults is not None:
+            # Chaos boundary: ``cascade: reject_all`` forces the
+            # pathological all-face-free verdict (runtime.faults).
+            keep = self._faults.on_cascade(keep)
+        rejected = count - int(keep.sum())
+        self._cascade_scored += count
+        self._cascade_rejected += rejected
+        self.metrics.incr(mn.CASCADE_FRAMES_SCORED, count)
+        reject_rate = self._cascade_rejected / max(1, self._cascade_scored)
+        self.metrics.set_gauge(mn.CASCADE_REJECT_RATE, reject_rate)
+        self.metrics.set_gauge(mn.CASCADE_PASS_RATE, 1.0 - reject_rate)
+        self.metrics.set_gauge(mn.CASCADE_THRESHOLD, thr)
+        if batch_tid:
+            self.tracer.emit(batch_tid, "cascade", topic=tracing.BATCH_TOPIC,
+                             dur=dur, frames=count, rejected=rejected,
+                             threshold=round(thr, 4))
+        return keep
+
+    def _complete_empty(self, rejected, batch_tid: int) -> None:
+        """Settle cascade-rejected frames as ``completed_empty``: each
+        publishes a result with an empty face list (producers get an
+        answer for every admitted frame — the uplift bench counts
+        completions through the same result stream) and lands in the
+        ledger's ``completed_empty`` bucket with a terminal settle span.
+        ``rejected`` rows are ``(meta, enqueue_ts, trace_id, priority)``.
+        A crash escaping mid-run settles the remainder as crashed,
+        exactly like ``_publish`` — no frame is ever left in limbo."""
+        published = 0
+        try:
+            for meta, _ts, _tid, _pri in rejected:
+                self.connector.publish(RESULT_TOPIC,
+                                       {"meta": meta, "faces": [],
+                                        "exit": "cascade"})
+                published += 1
+        finally:
+            self.metrics.incr(mn.FRAMES_COMPLETED_EMPTY, published)
+            self._trace_settle([r[2] for r in rejected[:published]],
+                               tracing.OUTCOME_COMPLETED_EMPTY,
+                               "cascade.reject", batch=batch_tid)
+            if published < len(rejected):
+                self.metrics.incr(mn.FRAMES_DROPPED_CRASHED,
+                                  len(rejected) - published)
+                self._trace_settle([r[2] for r in rejected[published:]],
+                                   mn.FRAMES_DROPPED_CRASHED,
+                                   "cascade.publish_crashed",
+                                   batch=batch_tid)
+            # Early exits are real end-to-end completions: their latency
+            # belongs in the SLO histograms like any published frame.
+            now_mono = time.monotonic()
+            for _meta, ts, _tid, pri in rejected[:published]:
+                if ts is not None:
+                    self._observe_e2e(ts, pri, now_mono)
+
+    def _observe_e2e(self, enqueue_ts: float, priority: int,
+                     now_mono: float) -> None:
+        """One frame's end-to-end latency (batcher enqueue -> result
+        publish) into the SLO histograms, split by priority class —
+        shared by the publish path and the cascade's empty completions so
+        the interactive objective sees every answered frame once."""
+        e2e = now_mono - enqueue_ts
+        self.metrics.observe(mn.E2E_LATENCY, e2e)
+        if priority <= PRIORITY_INTERACTIVE:
+            self.metrics.observe(mn.E2E_LATENCY_INTERACTIVE, e2e)
+
+    def _note_recompile(self, bucket: int, frames_n: int, mode) -> None:
+        """Recompile watchdog: a serving-path jit-cache miss AFTER
+        warmup compiled the whole ladder (both cascade stages included)
+        is a mid-serving XLA compile the prewarm design exists to
+        prevent (measured ~85 s stalls on the tunneled backend).
+        Counted, spanned, and reported as a warn-level SLO event so
+        /health shows it within one evaluation interval."""
+        self.metrics.incr(mn.RECOMPILES_POST_WARMUP)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "recompile",
+                             topic=tracing.LIFECYCLE_TOPIC, bucket=bucket,
+                             frames=frames_n, mode=mode)
+        if self.slo is not None:
+            self.slo.note_event("recompile_post_warmup")
+
     def _run_embed_chunk(self, params, crops):
         """One fixed-size enrolment embed, honoring ``_embed_device``
         (``jax.default_device`` participates in the jit cache key, so the
@@ -860,6 +1029,14 @@ class RecognizerService:
                       "gallery_size": self.pipeline.gallery.size}
             if self.ingest is not None:
                 status["ingest"] = self.ingest.stats()
+            if self._cascade_active:
+                status["cascade"] = {
+                    "threshold": self.cascade_threshold,
+                    "effective_threshold":
+                        self._effective_cascade_threshold(),
+                    "scored": self._cascade_scored,
+                    "rejected": self._cascade_rejected,
+                }
             self.connector.publish(STATUS_TOPIC, status)
 
     # ---- lifecycle ----
@@ -1134,6 +1311,60 @@ class RecognizerService:
             count = cap
         accounted = False
         try:
+            # Stage-1 cascade gate (ISSUE 13): score the whole batch at
+            # its ladder rung, settle face-free frames as
+            # ``completed_empty`` (published with an empty face list,
+            # never dispatched to detect->crop->embed->match), and
+            # compact survivors toward the staging buffer's front so the
+            # bucket slice below dispatches the smallest rung that fits
+            # what is left. Settlement ordering keeps the crash handler
+            # exact: ``count`` shrinks to the survivors BEFORE the
+            # rejected frames settle, so a crash anywhere after still
+            # settles every frame exactly once.
+            if count and self._cascade_active:
+                keep = self._cascade_keep_mask(frames, count, batch_tid)
+                if keep is not None and not keep.all():
+                    keep_idx = np.flatnonzero(keep)
+                    rejected = [(metas[i], batch.enqueue_ts[i],
+                                 trace_ids[i], batch.priorities[i])
+                                for i in np.flatnonzero(~keep)]
+                    kept = len(keep_idx)
+                    if kept:
+                        # Fancy-index gather copies survivors out before
+                        # the front rows are overwritten: safe in-place
+                        # compaction of the pooled staging buffer.
+                        frames[:kept] = frames[keep_idx]
+                    metas = ([metas[i] for i in keep_idx]
+                             + [None] * (len(metas) - kept))
+                    batch = batch._replace(
+                        metas=metas, count=kept,
+                        enqueue_ts=[batch.enqueue_ts[i] for i in keep_idx],
+                        trace_ids=[trace_ids[i] for i in keep_idx],
+                        priorities=[batch.priorities[i] for i in keep_idx])
+                    trace_ids = batch.trace_ids
+                    count = kept
+                    self._complete_empty(rejected, batch_tid)
+                    if not count:
+                        # Zero survivors: the whole batch exits at stage
+                        # 1 — no stage-2 dispatch at all, THE early-exit
+                        # win. The dispatch span records the exit stage
+                        # so PR 8 attribution stays honest.
+                        self.metrics.incr(mn.CASCADE_BATCH_EXITS)
+                        if batch_tid:
+                            tracer.emit(batch_tid, "dispatch",
+                                        topic=tracing.BATCH_TOPIC,
+                                        dur=time.perf_counter() - t0,
+                                        bucket=0, frames=0,
+                                        exit="cascade",
+                                        brownout=self._brownout_level)
+                        accounted = True
+                        self._mark_completed()
+                        # The stage-1 scores readback completed, which
+                        # fences the buffer's H2D read: safe to recycle.
+                        self.batcher.recycle(frames)
+                        self.batcher.report_service_time(
+                            time.perf_counter() - t0)
+                        return
             # Bucketed dispatch: slice the padded staging array down to the
             # smallest warmed ladder size that fits the real frames — a
             # view, not a copy, so steady state allocates nothing.
@@ -1214,27 +1445,19 @@ class RecognizerService:
         if batch_tid:
             # Bucketed-dispatch provenance: bucket size, jit-cache verdict
             # and exact-vs-ivf matcher mode (the pipeline records both on
-            # dispatch), plus the brownout level the batch served under.
+            # dispatch), plus the brownout level the batch served under
+            # and the cascade exit stage (``full`` = stage 2 ran; a batch
+            # that never got here carries ``exit="cascade"`` instead).
             tracer.emit(batch_tid, "dispatch", topic=tracing.BATCH_TOPIC,
                         dur=t_disp - t0, bucket=bucket, frames=count,
                         cache_hit=info.get("cache_hit"),
-                        mode=info.get("mode"),
+                        mode=info.get("mode"), exit="full",
                         brownout=self._brownout_level)
         if self._warmed and info.get("cache_hit") is False:
-            # Recompile watchdog: a serving dispatch missed the jit cache
-            # AFTER warmup compiled the whole bucket ladder — a mid-
-            # serving XLA compile (the silent perf killer the prewarm
-            # design exists to prevent; measured ~85 s stalls on the
-            # tunneled backend). Counted, spanned, and reported as a
-            # warn-level SLO event so /health shows it within one
-            # evaluation interval.
-            self.metrics.incr(mn.RECOMPILES_POST_WARMUP)
-            if tracer is not None:
-                tracer.emit(tracer.new_trace(), "recompile",
-                            topic=tracing.LIFECYCLE_TOPIC, bucket=bucket,
-                            frames=count, mode=info.get("mode"))
-            if self.slo is not None:
-                self.slo.note_event("recompile_post_warmup")
+            # Recompile watchdog (see _note_recompile): a serving
+            # dispatch missed the jit cache AFTER warmup compiled the
+            # whole bucket ladder.
+            self._note_recompile(bucket, count, info.get("mode"))
         if bucket < self.batcher.batch_size:
             self.metrics.incr(mn.BATCHES_BUCKETED)
         if self._use_worker:
@@ -1647,11 +1870,11 @@ class RecognizerService:
         if enqueue_ts:
             now_mono = time.monotonic()
             for i in range(min(count, len(enqueue_ts))):
-                e2e = now_mono - enqueue_ts[i]
-                self.metrics.observe(mn.E2E_LATENCY, e2e)
-                if (i < len(priorities)
-                        and priorities[i] <= PRIORITY_INTERACTIVE):
-                    self.metrics.observe(mn.E2E_LATENCY_INTERACTIVE, e2e)
+                self._observe_e2e(
+                    enqueue_ts[i],
+                    priorities[i] if i < len(priorities)
+                    else PRIORITY_INTERACTIVE + 1,
+                    now_mono)
         # Feed the continuous batcher's adaptive deadline with the
         # realized downstream time (pop -> published).
         self.batcher.report_service_time(now - t0)

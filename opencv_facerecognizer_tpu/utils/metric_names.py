@@ -56,6 +56,28 @@ READY_WAIT = "ready_wait"
 E2E_LATENCY = "e2e_latency"
 E2E_LATENCY_INTERACTIVE = "e2e_latency_interactive"
 
+# ---- cascade early-exit detection (models.cascade + the serving gate) ------
+#: terminal admission-ledger status for frames the stage-1 cascade scored
+#: face-free: published with an empty face list, never dispatched to the
+#: full detect->crop->embed->match step. NOT a drop — the ledger invariant
+#: is ``admitted == completed + completed_empty + Σ drops``.
+FRAMES_COMPLETED_EMPTY = "frames_completed_empty"
+#: frames the stage-1 pass scored (rejected + passed), and whole batches
+#: that exited at the cascade (zero survivors — no stage-2 dispatch).
+CASCADE_FRAMES_SCORED = "cascade_frames_scored"
+CASCADE_BATCH_EXITS = "cascade_batch_exits"
+#: a stage-1 scoring pass raised: the batch fails OPEN to the full
+#: detector (availability beats the early-exit win), counted loudly.
+CASCADE_ERRORS = "cascade_errors"
+#: host wall of one stage-1 pass incl. its tiny [B] readback (observe).
+CASCADE_SCORE = "cascade_score"
+#: first-class /prom gauges: cumulative reject/pass fractions of scored
+#: frames, and the EFFECTIVE operating threshold (incl. the brownout
+#: tightening notch) the last batch was gated at.
+CASCADE_REJECT_RATE = "cascade_reject_rate"
+CASCADE_PASS_RATE = "cascade_pass_rate"
+CASCADE_THRESHOLD = "cascade_threshold"
+
 # ---- admission / brownout (overload layer) --------------------------------
 #: per-reason rejection family: ``frames_rejected_<reason>``
 FRAMES_REJECTED_PREFIX = "frames_rejected_"

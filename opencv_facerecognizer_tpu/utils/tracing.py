@@ -74,6 +74,11 @@ SETTLE_STAGE = "settle"
 #: ``settle`` outcome of a frame that published a result; every other
 #: outcome is the admission-ledger drop-counter name it was counted under.
 OUTCOME_COMPLETED = "completed"
+#: ``settle`` outcome of a frame the stage-1 cascade rejected as
+#: face-free: published with an empty face list, never dispatched to the
+#: full detector — the ledger's ``completed_empty`` terminal status, a
+#: sibling of completed, not a drop.
+OUTCOME_COMPLETED_EMPTY = "completed_empty"
 
 _HASH_MULT = 2654435761  # Knuth multiplicative hash (mod 2^32)
 
@@ -330,6 +335,7 @@ def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     admitted verdict). With ``sample=1.0`` these must equal the service's
     ``ledger()`` exactly — the chaos soak's span-accounting check."""
     completed = 0
+    completed_empty = 0
     drops: Dict[str, int] = {}
     admitted_traces = set()
     for span in spans:
@@ -340,10 +346,14 @@ def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             outcome = span.get("outcome")
             if outcome == OUTCOME_COMPLETED:
                 completed += 1
+            elif outcome == OUTCOME_COMPLETED_EMPTY:
+                # Cascade early exits are terminal completions, not drops
+                # — mirrored as their own ledger bucket.
+                completed_empty += 1
             elif outcome:
                 drops[outcome] = drops.get(outcome, 0) + 1
     return {"traced": len(admitted_traces), "completed": completed,
-            "drops": drops}
+            "completed_empty": completed_empty, "drops": drops}
 
 
 def device_busy_fraction(batch_spans: Iterable[Dict[str, Any]],

@@ -132,6 +132,16 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("ingest") or {})
         .get("uplift", {}).get("b32", {}).get("uplift"),
         "ratio_min", 0.90, 0.0),
+    # Cascade early-exit detection (ISSUE 13): completed-frames uplift at
+    # 0% face density, cascade on vs off, against the per-frame dispatch
+    # wall — the headline early-exit win. A candidate may not quietly
+    # lose it (a gate that stops rejecting, a compaction that stops
+    # shrinking buckets). Artifacts predating the cascade section ride
+    # the baseline-predates-metric skip.
+    "cascade_uplift_density0": (
+        lambda d: (d.get("cascade") or {})
+        .get("uplift", {}).get("d0", {}).get("uplift"),
+        "ratio_min", 0.90, 0.0),
 }
 
 

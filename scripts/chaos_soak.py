@@ -100,6 +100,11 @@ def _check_span_accounting(dump_path: str, ring_size: int, ledger: dict,
         failures.append(
             f"{where}: {acct['completed']} completed settle spans != "
             f"ledger completed {ledger['completed']}")
+    if acct["completed_empty"] != int(ledger.get("completed_empty", 0)):
+        failures.append(
+            f"{where}: {acct['completed_empty']} completed_empty settle "
+            f"spans != ledger completed_empty "
+            f"{ledger.get('completed_empty', 0)}")
     want_drops = {k: int(v) for k, v in ledger["drops_by_reason"].items()}
     if acct["drops"] != want_drops:
         failures.append(f"{where}: settle-span drops {acct['drops']} != "

@@ -47,6 +47,9 @@ ATTR_HINTS: Dict[str, str] = {
     "staging": "StagingRing",
     "staging_ring": "StagingRing",
     "decoder": "DecodeWorkerPool",
+    # Cascade early-exit detection (ISSUE 13): the pipeline's
+    # ``self.cascade`` is the stage-1 face-proposal model.
+    "cascade": "FaceGate",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -57,6 +60,10 @@ HOT_PATH_SUFFIXES: Tuple[str, ...] = (
     "runtime/batcher.py",
     "runtime/ingest.py",
     "parallel/pipeline.py",
+    # The stage-1 cascade's forward runs per serving batch (ISSUE 13):
+    # a stray blocking sync in the model module would land on the
+    # dispatch path, so it is scanned like the rest of the hot loop.
+    "models/cascade.py",
 )
 
 #: Modules that OWN the epoch-pairing protocol (PR 6): only they may touch
@@ -107,6 +114,10 @@ WAL_EXEMPT_SUFFIXES: Tuple[str, ...] = (
 #: terminal attribute names of producer calls in the serving runtime.
 DEVICE_PRODUCER_ATTRS: FrozenSet[str] = frozenset({
     "recognize_batch", "recognize_batch_packed", "device_put",
+    # Stage-1 cascade pass: its result is a device array whose ONE
+    # sanctioned materialize is the serving gate's decision readback
+    # (annotated boundary in runtime/recognizer.py).
+    "cascade_scores", "score_batch",
 })
 
 #: Host-sync sinks that are flagged UNCONDITIONALLY in hot-path modules —
