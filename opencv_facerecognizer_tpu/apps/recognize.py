@@ -257,6 +257,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep-checkpoints", type=int, default=3,
                    help="checkpoint retention: newest N kept; older ones "
                         "(and quarantined corrupt files beyond N) pruned")
+    p.add_argument("--disk-low-watermark", type=float, default=256.0,
+                   metavar="MB",
+                   help="disk-pressure low watermark on the --state-dir "
+                        "volume (MB free; README 'Degraded-durability "
+                        "runbook'). Below it: one preemptive WAL "
+                        "compaction (forced checkpoint) + retention "
+                        "shrink per pressure episode, and the "
+                        "disk_free SLO burns >= 1 (warn). Below "
+                        "watermark/6: durability flips to degraded "
+                        "BEFORE ENOSPC ever lands (enrollments refused "
+                        "closed, serving continues). 0 disables the "
+                        "watermark (the WAL-failure trigger stays armed)")
+    p.add_argument("--durability-probe-s", type=float, default=5.0,
+                   help="degraded-durability recovery probe cadence: "
+                        "every N seconds the monitor durably writes + "
+                        "fsyncs + unlinks a tmp file in --state-dir; a "
+                        "success re-arms durability with a "
+                        "durability_restored announcement. Also the "
+                        "disk-watermark refresh interval")
     p.add_argument("--journal-fsync", choices=["never", "interval", "always"],
                    default="never",
                    help="fsync policy of the dead-letter journal: never "
@@ -772,6 +791,26 @@ def main(argv=None) -> int:
             # restarts into a serving gallery.
             state.checkpoint_now(wait=True)
 
+    durability = None
+    if state is not None:
+        # Degraded-durability state machine + disk-pressure watermarks
+        # (README "Degraded-durability runbook"): sustained WAL failure
+        # or a critical watermark refuses enrollments closed while
+        # serving continues; the probe re-arms automatically. Attaches
+        # itself to the lifecycle; the service wires its status channel.
+        from opencv_facerecognizer_tpu.runtime.resilience import (
+            DurabilityMonitor,
+        )
+
+        durability = DurabilityMonitor(
+            state, metrics=metrics, tracer=tracer,
+            probe_interval_s=args.durability_probe_s,
+            low_watermark_bytes=int(args.disk_low_watermark * (1 << 20)))
+        # Non-critical sinks shed (with exact per-sink counters) while
+        # degraded — the disk's last bytes belong to the WAL.
+        durability.attach_sinks(journal=journal, span_sink=span_journal,
+                                tracer=tracer)
+
     if (quantizer is not None and not quantizer.ready
             and pipeline.gallery._ivf_wanted()):
         # Sidecar missed (or no --state-dir): train the shortlist before
@@ -806,6 +845,19 @@ def main(argv=None) -> int:
             tracer=tracer,
             interval_s=args.slo_interval_s,
         )
+        if durability is not None and durability.low_watermark_bytes:
+            # Disk-pressure SLO: burn = watermark/free (warn at the
+            # watermark, critical at 1/6 of it — the same point the
+            # monitor pre-empts the degraded flip). Reads the monitor's
+            # cached statvfs sample, so /health and the watermark
+            # actions see one probe.
+            from opencv_facerecognizer_tpu.runtime.slo import (
+                disk_free_objective,
+            )
+
+            slo_monitor.add_objective(disk_free_objective(
+                durability.free_bytes, durability.low_watermark_bytes,
+                short_s=short_s, long_s=long_s))
 
     if args.source == "jsonl":
         connector = JSONLConnector(sys.stdin, sys.stdout, metrics=metrics)

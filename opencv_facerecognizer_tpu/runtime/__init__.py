@@ -47,6 +47,8 @@ from opencv_facerecognizer_tpu.runtime.rollout import (
 )
 from opencv_facerecognizer_tpu.runtime.resilience import (
     BrownoutPolicy,
+    DurabilityDegradedError,
+    DurabilityMonitor,
     ResiliencePolicy,
     ServiceSupervisor,
 )
@@ -54,6 +56,7 @@ from opencv_facerecognizer_tpu.runtime.slo import (
     SLO,
     SLOMonitor,
     default_objectives,
+    disk_free_objective,
     loop_liveness_objective,
     replication_lag_objective,
     rollout_parity_objective,
@@ -74,6 +77,8 @@ __all__ = [
     "DeadLetterJournal",
     "DecodeWorkerPool",
     "DualScoreParity",
+    "DurabilityDegradedError",
+    "DurabilityMonitor",
     "EmbedderVersionMismatchError",
     "EnrollmentWAL",
     "ExpoServer",
@@ -104,6 +109,7 @@ __all__ = [
     "StagingRing",
     "resolve_ingest_mode",
     "default_objectives",
+    "disk_free_objective",
     "loop_liveness_objective",
     "replication_lag_objective",
     "rollout_parity_objective",

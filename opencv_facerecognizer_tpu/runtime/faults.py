@@ -33,6 +33,7 @@ never require one to be installed.
 
 from __future__ import annotations
 
+import errno
 import random
 import time
 from collections import Counter, deque
@@ -80,7 +81,27 @@ BOUNDARIES: Dict[str, tuple] = {
     # from the staged shard set).
     "stage": ("torn", "crash"),
     "cutover": ("crash_before_record", "crash_after_record"),
+    # Storage-fault boundary (ISSUE 15) — the disk STAYS broken, unlike
+    # the wal/checkpoint kill-point faults above which simulate process
+    # death. One boundary covers every durable path (WAL append/fsync,
+    # checkpoint tmp+rename+directory fsync, dead-letter/span journals,
+    # rollout stage appends, replica tailer reads, flight dumps):
+    # "enospc" = the write raises OSError(ENOSPC) — a full disk;
+    # "eio" = the write raises OSError(EIO) — dying media;
+    # "slow_fsync" = the operation completes but only after
+    # ``slow_fsync_s`` (a congested/remounting device — callers must
+    # bound what serves behind it, not wedge);
+    # "read_error" = a READ crossing raises OSError(EIO) (tailer polls,
+    # checkpoint loads). Write crossings draw only the three write
+    # kinds and read crossings only "read_error", so one scripted queue
+    # can interleave both without a read consuming a write fault.
+    "storage": ("enospc", "eio", "slow_fsync", "read_error"),
 }
+
+#: storage-boundary fault kinds applicable per crossing direction (the
+#: filtered draw ``on_storage``/``on_storage_read`` use).
+STORAGE_WRITE_KINDS = ("enospc", "eio", "slow_fsync")
+STORAGE_READ_KINDS = ("read_error",)
 
 
 class InjectedCrashError(RuntimeError):
@@ -175,7 +196,8 @@ class FaultInjector:
                  rates: Optional[Dict[str, Dict[str, float]]] = None,
                  slow_readback_s: float = 0.05,
                  flood_factor: int = 8,
-                 slow_decode_s: float = 0.05):
+                 slow_decode_s: float = 0.05,
+                 slow_fsync_s: float = 0.05):
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
         #: injected transfer latency of a ``readback: slow`` fault.
@@ -183,6 +205,10 @@ class FaultInjector:
         #: injected decoder stall of a ``decode: slow`` fault (the worker
         #: sleeps this long before decoding the payload).
         self.slow_decode_s = float(slow_decode_s)
+        #: injected stall of a ``storage: slow_fsync`` fault — the durable
+        #: operation completes, but only after this long (the 2-second
+        #: fsync shape: a congested or error-retrying block device).
+        self.slow_fsync_s = float(slow_fsync_s)
         #: amplification of a ``receive: flood`` fault — one delivery
         #: becomes this many (a runaway producer / retry storm in
         #: miniature; the admission layer must shed the excess with
@@ -219,16 +245,28 @@ class FaultInjector:
 
     def _draw(self, boundary: str) -> Optional[str]:
         """Next fault to fire at this crossing, or None. Scripted faults
-        take priority (and are consumed even when a rate is also set)."""
+        take priority (and are consumed even when a rate is also set).
+        The unfiltered form: every kind the boundary knows is eligible
+        (``script`` already validated them), so this is exactly
+        ``_draw_filtered`` over the boundary's full kind tuple — one
+        implementation, never two to drift apart."""
+        return self._draw_filtered(boundary, BOUNDARIES[boundary])
+
+    def _draw_filtered(self, boundary: str, allowed: tuple) -> Optional[str]:
+        """Like ``_draw`` but the crossing accepts only ``allowed`` kinds:
+        a scripted fault at the queue head is consumed only when it
+        matches (a scripted ``read_error`` waits for the next READ
+        crossing instead of being burned by a write), and rate draws skip
+        non-matching kinds."""
         if not self.enabled:
             return None
         queue = self._scripted[boundary]
-        if queue:
+        fault = None
+        if queue and queue[0] in allowed:
             fault = queue.popleft()
-        else:
-            fault = None
+        elif not queue:
             for kind, rate in self.rates.get(boundary, {}).items():
-                if rate > 0 and self._rng.random() < rate:
+                if kind in allowed and rate > 0 and self._rng.random() < rate:
                     fault = kind
                     break
         if fault is not None:
@@ -335,6 +373,35 @@ class FaultInjector:
         returns which side of the fence record the simulated kill lands
         on, or None."""
         return self._draw("cutover")
+
+    def on_storage(self, op: str = "write") -> None:
+        """Durable-WRITE storage boundary (ISSUE 15): called by every
+        durable writer (WAL/journal appends, checkpoint installs, rollout
+        stage appends, flight dumps) immediately before the real syscall,
+        INSIDE the caller's existing OSError handling — the injected
+        errno therefore exercises the exact production error path.
+        ``enospc``/``eio`` raise the corresponding ``OSError``;
+        ``slow_fsync`` sleeps ``slow_fsync_s`` then lets the write
+        proceed (the disk is slow, not broken). ``op`` only labels the
+        raised error for forensics; the draw is op-agnostic."""
+        fault = self._draw_filtered("storage", STORAGE_WRITE_KINDS)
+        if fault is None:
+            return
+        if fault == "slow_fsync":
+            time.sleep(self.slow_fsync_s)
+            return
+        code = errno.ENOSPC if fault == "enospc" else errno.EIO
+        raise OSError(code, f"injected storage fault ({fault}) at {op}")
+
+    def on_storage_read(self, op: str = "read") -> None:
+        """Durable-READ storage boundary: replica tailer polls, checkpoint
+        recovery reads. ``read_error`` raises ``OSError(EIO)`` — a read
+        failure proves nothing about the bytes, and every consumer must
+        already treat it as transient (retry/fall back), never as
+        corruption."""
+        if self._draw_filtered("storage", STORAGE_READ_KINDS) is not None:
+            raise OSError(errno.EIO,
+                          f"injected storage fault (read_error) at {op}")
 
     def summary(self) -> Dict[str, int]:
         return dict(self.injected)

@@ -70,7 +70,9 @@ class RotatingJournal:
 
     def __init__(self, path: str, max_bytes: int = 4 << 20, backups: int = 2,
                  metrics=None, fsync: str = "never",
-                 fsync_interval_s: float = 1.0):
+                 fsync_interval_s: float = 1.0, fault_injector=None,
+                 error_counter: str = mn.JOURNAL_ERRORS,
+                 shed_counter: str = mn.JOURNAL_SHED):
         if fsync not in FSYNC_POLICIES:
             raise ValueError(f"fsync policy {fsync!r} not in {FSYNC_POLICIES}")
         self.path = str(path)
@@ -79,6 +81,23 @@ class RotatingJournal:
         self.metrics = metrics
         self.fsync = fsync
         self.fsync_interval_s = float(fsync_interval_s)
+        #: chaos hook (runtime.faults.FaultInjector): the ``storage``
+        #: boundary fires inside ``_append_locked``, before the real
+        #: write, so an injected ENOSPC/EIO lands on the exact OSError
+        #: path a full/broken disk produces. None in production.
+        self._faults = fault_injector
+        #: per-sink accounting names (ISSUE 15): the dead-letter journal
+        #: and the span-JSONL sink share this class but must not share a
+        #: counter — triage has to tell which sink is failing/shedding.
+        #: Both are registry constants chosen at construction.
+        self.error_counter = str(error_counter)
+        self.shed_counter = str(shed_counter)
+        #: degraded-durability shed hook: when set and truthy, NON-STRICT
+        #: appends are dropped before touching the disk (counted on
+        #: ``shed_counter``) — a dying disk's remaining bytes belong to
+        #: the WAL, not the flight recorders. Strict appends (the WAL
+        #: itself) never consult it.
+        self.shed_fn = None
         self._last_fsync_t = 0.0
         self._lock = threading.Lock()
         self._fh = None
@@ -100,13 +119,20 @@ class RotatingJournal:
         the dead-letter posture: a journal failure must never hurt
         serving) or re-raised (``strict`` — the WAL posture: a failed
         append must fail the acknowledgment that depends on it)."""
+        if not strict and self.shed_fn is not None and self.shed_fn():
+            # Degraded-durability shed (non-strict sinks only): no disk
+            # touched, exact per-sink accounting instead of one swallowed
+            # OSError per attempt against a disk already known broken.
+            if self.metrics is not None:
+                self.metrics.incr(self.shed_counter)  # ocvf-lint: disable=metrics-registry -- constructor-bound per-sink constant (JOURNAL_SHED / TRACE_SPANS_SHED), both registered
+            return False
         with self._lock:
             try:
                 self._append_locked(line)
             except OSError:
                 self._needs_seal = True  # partial bytes may have landed
                 if self.metrics is not None:
-                    self.metrics.incr(mn.JOURNAL_ERRORS)
+                    self.metrics.incr(self.error_counter)  # ocvf-lint: disable=metrics-registry -- constructor-bound per-sink constant (JOURNAL_ERRORS / TRACE_SPAN_ERRORS), both registered
                 if strict:
                     raise
                 return False
@@ -117,14 +143,49 @@ class RotatingJournal:
         pending seal (previous failed append) is prepended as a newline in
         the SAME write, so the torn bytes end up an isolated unparseable
         line instead of a prefix of this record."""
+        if self._faults is not None:
+            # Chaos storage boundary: fired BEFORE any byte so an injected
+            # ENOSPC/EIO takes the exact path a real full disk does (the
+            # caller's OSError handling + seal bookkeeping); slow_fsync
+            # stalls here, where a real slow device would.
+            self._faults.on_storage("journal_append")
         self._rotate_if_needed(len(line) + 2)
         if self._fh is None:
+            # First open of a PRE-EXISTING file: a previous process's
+            # ENOSPC/crash may have left a partial final line with no
+            # newline — detect it now and latch the seal, so the remnant
+            # is terminated in the same write as this record's prefix
+            # ("sealed at next open") instead of becoming its prefix.
+            self._latch_torn_tail_locked()
             self._fh = open(self.path, "a", encoding="utf-8")
         prefix = "\n" if self._needs_seal else ""
         self._fh.write(prefix + line + ("\n" if newline else ""))
         self._needs_seal = False  # the write (incl. the seal) landed
         self._fh.flush()
         self._fsync_locked()
+
+    def _latch_torn_tail_locked(self) -> None:
+        """Caller holds the lock, the write handle is not open yet. If the
+        file's last byte is not a newline (an ENOSPC/crash-torn append
+        from a previous process), set ``_needs_seal`` and count
+        ``journal_torn_tails`` — the torn remnant stays one isolated
+        unparseable line (skipped by ``records``; never replayed, never
+        double-counted) instead of gluing onto the next record."""
+        if self._needs_seal:
+            return  # an in-process failed append already latched it
+        try:
+            with open(self.path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() == 0:
+                    return
+                fh.seek(-1, os.SEEK_END)
+                torn = fh.read(1) != b"\n"
+        except OSError:
+            return  # no file yet (fresh journal): nothing to seal
+        if torn:
+            self._needs_seal = True
+            if self.metrics is not None:
+                self.metrics.incr(mn.JOURNAL_TORN_TAILS)
 
     def _fsync_locked(self) -> None:
         if self.fsync == "never" or self._fh is None:
@@ -147,7 +208,7 @@ class RotatingJournal:
                     self._last_fsync_t = time.monotonic()
                 except OSError:
                     if self.metrics is not None:
-                        self.metrics.incr(mn.JOURNAL_ERRORS)
+                        self.metrics.incr(self.error_counter)  # ocvf-lint: disable=metrics-registry -- constructor-bound per-sink constant, registered
 
     def _rotate_if_needed(self, incoming: int) -> None:
         """Caller holds the lock. Shift ``path -> path.1 -> path.2 ...``

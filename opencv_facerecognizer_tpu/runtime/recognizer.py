@@ -119,6 +119,7 @@ from opencv_facerecognizer_tpu.runtime.ingest import (
 )
 from opencv_facerecognizer_tpu.runtime.resilience import (
     BrownoutPolicy,
+    DurabilityDegradedError,
     ResiliencePolicy,
     is_transient_error,
 )
@@ -464,6 +465,12 @@ class RecognizerService:
             # checkpoint time (reload/CPU-fallback may swap it) and nudges
             # its thresholds through the commit hooks just registered.
             self.state.attach(self)
+            # Degraded-durability announcements (ISSUE 15) ride the same
+            # status channel as the dispatch-side degraded mode: wire the
+            # monitor's publish hook unless the app already did.
+            dur = getattr(self.state, "durability", None)
+            if dur is not None and dur.publish is None:
+                dur.publish = self._publish_status
 
         # Enrolment embeds ride a FIXED-size padded chunk: one compiled
         # shape, warmed at start(), so an enroll command never triggers a
@@ -1010,6 +1017,21 @@ class RecognizerService:
                                             "replica"})
             return
         if cmd == "enroll":
+            dur = getattr(self.state, "durability", None)
+            if dur is not None and dur.degraded:
+                # Refused CLOSED at the front door (ISSUE 15): while
+                # durability is degraded an accepted enroll command would
+                # collect crops only to fail its WAL append — the ack
+                # never lies, so the refusal is explicit and immediate.
+                self.metrics.incr(mn.ENROLLMENTS_REFUSED_DEGRADED)
+                self._publish_status({
+                    "status": "rejected",
+                    "reason": "durability_degraded",
+                    "detail": "enrollment refused: WAL durability is "
+                              "degraded on this writer (serving "
+                              "continues; re-arms automatically when "
+                              "the probe sees the disk recover)"})
+                return
             name = str(message.get("subject", f"subject_{len(self.subject_names)}"))
             count = int(message.get("count", 5))
             with self._enrol_lock:
@@ -1062,6 +1084,13 @@ class RecognizerService:
             self.ingest.start(sink=self._intake_decoded,
                               on_error=self._decode_failed)
         self.connector.start()
+        if self.state is not None:
+            # Background durability ticker: watermarks + recovery probe
+            # keep running even when the serving loop sits behind a slow
+            # fsync (exactly the moments the monitor exists for).
+            dur = getattr(self.state, "durability", None)
+            if dur is not None:
+                dur.start()
         if self._use_worker:
             self._blocker = _ReadbackBlocker()
             self._worker = threading.Thread(target=self._readback_thread,
@@ -1127,6 +1156,10 @@ class RecognizerService:
     def stop(self) -> None:
         self._running = False
         self._flush_rejections(force=True)
+        if self.state is not None:
+            dur = getattr(self.state, "durability", None)
+            if dur is not None:
+                dur.stop()
         if self.ingest is not None:
             self.ingest.stop()
         self.batcher.close()
@@ -1234,6 +1267,14 @@ class RecognizerService:
             # blocks on a checkpoint.
             if self.state is not None:
                 self.state.tick()
+                # Degraded-durability tick: interval-gated disk watermark
+                # refresh ONLY (probe=False by default — the recovery
+                # probe is a blocking fsync against a disk known broken,
+                # and it belongs to the monitor's background thread, not
+                # this loop). The non-due path is one clock read.
+                dur = getattr(self.state, "durability", None)
+                if dur is not None:
+                    dur.tick()
             # SLO tick: one clock read when not due; a full burn-rate
             # evaluation every interval_s (runtime.slo). Runs on batch
             # AND idle iterations so the health verdict keeps updating
@@ -2035,13 +2076,32 @@ class RecognizerService:
                 # Auto-grow saved the enrolment but forced a recompile-sized
                 # stall on the next match — surface it so operators pre-size.
                 self.metrics.incr(mn.GALLERY_GROWN, grown)
-        except Exception:
+        except Exception as exc:
             # Roll back a name we just reserved: the gallery has no rows
             # for it, so leaving it would skew label->name indices.
             with self._enrol_lock:
                 if (label == len(self.subject_names) - 1
                         and self.subject_names[label] == enrolment.subject_name):
                     self.subject_names.pop()
+            if isinstance(exc, (DurabilityDegradedError, OSError)):
+                # Storage-shaped refusal (ISSUE 15): the enrollment was
+                # refused closed — never acknowledged, nothing durable
+                # burned. Surface the explicit status (counting already
+                # happened at the layer that refused: the lifecycle's
+                # enrollments_refused_degraded / the WAL's
+                # wal_append_errors) instead of killing the enrolment
+                # thread with a silent traceback.
+                logging.getLogger(__name__).warning(
+                    "enrollment %r refused closed: %r",
+                    enrolment.subject_name, exc)
+                self._publish_status({
+                    "status": "enroll_failed",
+                    "subject": enrolment.subject_name,
+                    "reason": ("durability_degraded"
+                               if isinstance(exc, DurabilityDegradedError)
+                               else "wal_error"),
+                    "error": repr(exc)})
+                return
             raise
         self.metrics.incr(mn.SUBJECTS_ENROLLED)
         self.connector.publish(

@@ -188,9 +188,13 @@ class WALTailer:
     replica's serving-loop thread (or the verifier's main thread), so it
     needs no lock and never holds one across file I/O."""
 
-    def __init__(self, path: str, metrics=None):
+    def __init__(self, path: str, metrics=None, fault_injector=None):
         self.path = str(path)
         self.metrics = metrics
+        #: chaos hook: the ``storage`` boundary's read side (read_error)
+        #: fires at the top of every poll — an injected EIO lands on the
+        #: exact counted poll-error path a dying shared disk produces.
+        self._faults = fault_injector
         self._offset = 0
         self._inode: Optional[int] = None
         self.reopens = 0
@@ -212,6 +216,8 @@ class WALTailer:
         counted, exactly like replay."""
         info: Dict[str, Any] = {"reopened": False, "partial": False}
         try:
+            if self._faults is not None:
+                self._faults.on_storage_read("tailer_poll")
             fd = os.open(self.path, os.O_RDONLY)
         except FileNotFoundError:
             info["missing"] = True
@@ -341,7 +347,7 @@ class ReadReplica:
 
     def __init__(self, state_dir: str, gallery, subject_names: Optional[list] = None,
                  metrics=None, tracer=None, poll_interval_s: float = 0.05,
-                 name: str = "replica"):
+                 name: str = "replica", fault_injector=None):
         self.state_dir = str(state_dir)
         self.wal_path = os.path.join(self.state_dir, "enroll.wal")
         self.ckpt_dir = os.path.join(self.state_dir, "checkpoints")
@@ -351,7 +357,8 @@ class ReadReplica:
         self.tracer = tracer
         self.poll_interval_s = float(poll_interval_s)
         self.name = str(name)
-        self.tailer = WALTailer(self.wal_path, metrics=metrics)
+        self.tailer = WALTailer(self.wal_path, metrics=metrics,
+                                fault_injector=fault_injector)
         #: highest WAL seq applied to (or covered by the checkpoint under)
         #: the local gallery.
         self.applied_seq = 0

@@ -22,6 +22,22 @@ module extends that posture to the serving loop itself:
   last-known-good gallery snapshot, reusing the existing double-buffered
   ``reload_gallery`` swap. Restart count is bounded; giving up publishes a
   terminal status rather than flapping forever.
+- ``DurabilityMonitor`` — the degraded-DURABILITY state machine
+  (ISSUE 15): the backend-outage machinery above assumes the *disk* is
+  fine; this class owns the case where it is not (ENOSPC, EIO, a
+  2-second fsync).  Sustained WAL append failure (or a critical disk
+  watermark) flips the writer to ``durability_degraded``: enrollments
+  are refused closed with an explicit status (the ack never lies),
+  serving/read traffic continues, and non-critical sinks (dead-letter
+  journal, span JSONL, flight dumps) shed with exact per-sink
+  accounting. A background probe (tmp-file write + fsync in the state
+  dir) detects recovery and re-arms with a lifecycle span and a status
+  announcement — the same degrade/announce/recover shape as the
+  dispatch-side degraded mode. Disk-pressure watermarks ride the same
+  tick: below the low watermark the monitor preemptively compacts the
+  WAL (forced checkpoint) and shrinks checkpoint/flight/journal
+  retention; below ``watermark / critical_divisor`` it pre-empts the
+  degraded flip BEFORE ENOSPC ever lands.
 
 Every transition is counted in the service's ``Metrics`` (``dispatch_
 retries``, ``batches_dead_lettered``, ``degraded_transitions``,
@@ -32,6 +48,7 @@ retries``, ``batches_dead_lettered``, ``degraded_transitions``,
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass
@@ -152,6 +169,419 @@ class BrownoutPolicy:
     bulk_skip: int = 2
     #: EWMA smoothing for the queue-wait signal.
     ewma_alpha: float = 0.3
+
+
+class DurabilityDegradedError(RuntimeError):
+    """An enrollment was refused CLOSED because durability is degraded
+    (sustained WAL/storage failure or a critical disk watermark). The
+    caller must surface an explicit refusal status — never acknowledge,
+    never queue for later: the acknowledged == fsync-durable promise is
+    exactly what degraded mode exists to protect."""
+
+
+#: disk-pressure severity codes (the ``disk_pressure_state`` gauge).
+DISK_OK, DISK_WARN, DISK_CRITICAL = 0, 1, 2
+
+
+class DurabilityMonitor:
+    """Degraded-durability state machine + disk-pressure watermarks for
+    one writer's state dir (module docstring; README "Degraded-durability
+    runbook").
+
+    Construction attaches to the ``StateLifecycle``: ``state.durability``
+    becomes this monitor, so ``append_enrollment`` refuses closed while
+    degraded and feeds WAL append outcomes back in (from outside the
+    enroll lock — the flip publishes a status and emits a span, I/O that
+    must never run under durability locks).
+
+    Two independent triggers flip ``armed -> durability_degraded``:
+
+    - ``degraded_after`` CONSECUTIVE strict-WAL-append ``OSError``s
+      (ENOSPC/EIO — each one already refused its enrollment; the flip
+      stops new appends from even being attempted);
+    - the disk falling below ``low_watermark_bytes / critical_divisor``
+      free (the preemptive flip: refuse BEFORE ENOSPC tears a line).
+
+    While degraded: serving and read traffic continue untouched;
+    enrollments are refused closed (``enrollments_refused_degraded``,
+    status reason ``durability_degraded``); sinks wired via
+    ``attach_sinks`` shed with exact per-sink ``*_shed`` counters.
+
+    Recovery is PROBED, never assumed: every ``probe_interval_s`` the
+    monitor durably writes + fsyncs + unlinks a tmp file in the state
+    dir (through the same fault injector as every durable path, so chaos
+    controls it). A probe success while the disk is above the critical
+    watermark re-arms durability — lifecycle span, ``durability_rearms``,
+    and a ``durability_restored`` status announcement.
+
+    Disk pressure rides the same tick: a ``statvfs`` free-bytes gauge
+    (``disk_free_bytes``) and the ``disk_pressure_state`` 0/1/2 gauge.
+    Crossing into warn fires ONE preemptive WAL compaction (forced
+    checkpoint — its success truncates the WAL) and one retention shrink
+    (checkpoint keep / flight-dump keep / journal backups to their
+    floor) per pressure episode; recovery above the watermark restores
+    the original retention. The ``slo.disk_free_objective`` gauge SLO
+    reads the same free-bytes probe, so /health and /prom carry the
+    pressure verdict without a second statvfs.
+    """
+
+    PROBE_NAME = ".durability_probe"
+
+    def __init__(self, state, metrics=None, tracer=None,
+                 degraded_after: int = 3,
+                 probe_interval_s: float = 5.0,
+                 low_watermark_bytes: int = 0,
+                 critical_divisor: float = 6.0,
+                 publish: Optional[Callable[[dict], None]] = None,
+                 fault_injector=None,
+                 statvfs_fn=None):
+        self.state = state
+        self.metrics = metrics
+        self.tracer = tracer
+        self.degraded_after = max(1, int(degraded_after))
+        self.probe_interval_s = float(probe_interval_s)
+        self.low_watermark_bytes = max(0, int(low_watermark_bytes))
+        self.critical_divisor = max(1.0, float(critical_divisor))
+        #: status-announcement hook ({"status": ...} dicts). The service
+        #: wires its ``_publish_status`` here at construction; bare
+        #: lifecycles (chaos scenarios) may leave it None or capture it.
+        self.publish = publish
+        self._faults = fault_injector
+        self._statvfs = statvfs_fn if statvfs_fn is not None else os.statvfs
+        self._degraded = False
+        self._degraded_reason: Optional[str] = None
+        self._consecutive_wal_failures = 0
+        self._disk_state = DISK_OK
+        self._retention_shrunk = False
+        self._saved_retention: dict = {}
+        #: sinks registered by attach_sinks, kept for retention shrink.
+        self._journal = None
+        self._tracer_sink = None
+        self._lock = threading.Lock()
+        #: one tick cycle at a time (non-blocking claim, like the SLO
+        #: monitor's evaluation lock): the serving loop and the background
+        #: thread both tick, and the watermark transitions +
+        #: shrink/restore bookkeeping are check-then-act — two threads
+        #: crossing the warn watermark together would double-fire the
+        #: compaction and save the already-shrunk retention values as
+        #: "originals", pinning retention at the floor forever.
+        self._tick_lock = threading.Lock()
+        self._last_tick_t = 0.0
+        self._free_bytes: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if state is not None:
+            state.durability = self
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.DURABILITY_STATE, 0)
+
+    # ---- readers (any thread) ----
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    @property
+    def degraded_reason(self) -> Optional[str]:
+        return self._degraded_reason
+
+    @property
+    def disk_state(self) -> int:
+        return self._disk_state
+
+    def free_bytes(self) -> float:
+        """Last observed free bytes on the state volume (refreshing once
+        when never sampled) — the ``disk_free_objective`` probe, shared
+        with the gauge so /health and /prom agree without a second
+        statvfs per evaluation."""
+        if self._free_bytes is None:
+            self._sample_disk()
+        return float(self._free_bytes if self._free_bytes is not None
+                     else float("inf"))
+
+    def status(self) -> dict:
+        return {
+            "degraded": self._degraded,
+            "reason": self._degraded_reason,
+            "consecutive_wal_failures": self._consecutive_wal_failures,
+            "disk_state": self._disk_state,
+            "free_bytes": self._free_bytes,
+            "low_watermark_bytes": self.low_watermark_bytes,
+            "retention_shrunk": self._retention_shrunk,
+        }
+
+    # ---- sink wiring ----
+
+    def attach_sinks(self, journal=None, span_sink=None, tracer=None) -> None:
+        """Point the non-critical sinks' shed hooks at this monitor: while
+        degraded they drop writes with exact per-sink accounting instead
+        of one swallowed OSError per attempt. The WAL is deliberately NOT
+        sheddable — its failures are the signal."""
+        shed = lambda: self._degraded  # noqa: E731 — the one-line contract
+        if journal is not None:
+            journal.shed_fn = shed
+            self._journal = journal
+        if span_sink is not None:
+            span_sink.shed_fn = shed
+        if tracer is not None:
+            tracer.shed_fn = shed
+            self._tracer_sink = tracer
+
+    # ---- WAL outcome feed (called by StateLifecycle, outside its locks) --
+
+    def note_wal_failure(self, exc: BaseException) -> None:
+        """One strict WAL append failed with a storage-shaped error. At
+        ``degraded_after`` consecutive failures the writer flips."""
+        with self._lock:
+            self._consecutive_wal_failures += 1
+            should_flip = (not self._degraded
+                           and self._consecutive_wal_failures
+                           >= self.degraded_after)
+        if should_flip:
+            self._flip_degraded(
+                "wal_append_failures",
+                error=repr(exc),
+                consecutive=self._consecutive_wal_failures)
+
+    def note_wal_success(self) -> None:
+        with self._lock:
+            self._consecutive_wal_failures = 0
+
+    # ---- transitions ----
+
+    def _flip_degraded(self, reason: str, **detail) -> None:
+        with self._lock:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._degraded_reason = reason
+        if self.metrics is not None:
+            self.metrics.incr(mn.DURABILITY_DEGRADED_TRANSITIONS)
+            self.metrics.set_gauge(mn.DURABILITY_STATE, 1)
+        logging.getLogger(__name__).error(
+            "durability DEGRADED (%s): enrollments refused closed, "
+            "serving continues, recovery probe armed (%s)", reason, detail)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "durability",
+                             topic=_lifecycle_topic(),
+                             from_state="armed", to_state="degraded",
+                             reason=reason, **detail)
+        self._announce({"status": "durability_degraded", "reason": reason,
+                        **detail})
+
+    def _rearm(self) -> None:
+        with self._lock:
+            if not self._degraded:
+                return
+            self._degraded = False
+            reason = self._degraded_reason
+            self._degraded_reason = None
+            self._consecutive_wal_failures = 0
+        if self.metrics is not None:
+            self.metrics.incr(mn.DURABILITY_REARMS)
+            self.metrics.set_gauge(mn.DURABILITY_STATE, 0)
+        logging.getLogger(__name__).warning(
+            "durability RE-ARMED (probe write+fsync succeeded; was "
+            "degraded: %s) — enrollments accepted again", reason)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "durability",
+                             topic=_lifecycle_topic(),
+                             from_state="degraded", to_state="armed",
+                             was=reason)
+        self._announce({"status": "durability_restored", "was": reason})
+
+    def _announce(self, status: dict) -> None:
+        publish = self.publish
+        if publish is None:
+            return
+        try:
+            publish(status)
+        except Exception:  # noqa: BLE001 — a dead transport never blocks a flip
+            logging.getLogger(__name__).exception(
+                "durability status publish failed")
+
+    # ---- the recovery probe ----
+
+    def probe_now(self) -> bool:
+        """One durable tmp-file write + fsync + unlink in the state dir —
+        proof the volume accepts durable writes again. Routed through the
+        shared storage fault boundary so chaos owns the verdict. A
+        success while the disk sits above the critical watermark re-arms
+        degraded durability."""
+        if self.metrics is not None:
+            self.metrics.incr(mn.DURABILITY_PROBES)
+        path = os.path.join(getattr(self.state, "state_dir", "."),
+                            self.PROBE_NAME)
+        try:
+            if self._faults is not None:
+                self._faults.on_storage("durability_probe")
+            with open(path, "wb") as fh:  # ocvf-lint: disable=non-atomic-write -- the probe file IS the test: its only purpose is this write+fsync round trip, it is unlinked on the next line, and a torn remnant carries no state (readers never exist)
+                fh.write(b"probe\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.unlink(path)
+        except OSError:
+            if self.metrics is not None:
+                self.metrics.incr(mn.DURABILITY_PROBE_FAILURES)
+            return False
+        if self._degraded and self._disk_state < DISK_CRITICAL:
+            self._rearm()
+        return True
+
+    # ---- disk-pressure watermarks ----
+
+    def _sample_disk(self) -> None:
+        state_dir = getattr(self.state, "state_dir", None)
+        if state_dir is None:
+            return
+        try:
+            st = self._statvfs(state_dir)
+            self._free_bytes = float(st.f_bavail) * float(st.f_frsize)
+        except OSError:
+            return  # keep the last sample; the probe owns hard failures
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.DISK_FREE_BYTES, self._free_bytes)
+
+    def _check_watermarks(self) -> None:
+        if not self.low_watermark_bytes or self._free_bytes is None:
+            return
+        free = self._free_bytes
+        critical_at = self.low_watermark_bytes / self.critical_divisor
+        new_state = (DISK_CRITICAL if free < critical_at
+                     else DISK_WARN if free < self.low_watermark_bytes
+                     else DISK_OK)
+        prev = self._disk_state
+        self._disk_state = new_state
+        if self.metrics is not None:
+            self.metrics.set_gauge(mn.DISK_PRESSURE_STATE, new_state)
+        if new_state >= DISK_WARN and prev < DISK_WARN:
+            self._on_disk_warn(free)
+        if new_state >= DISK_CRITICAL and not self._degraded:
+            # Preempt ENOSPC: flip BEFORE a torn WAL line ever lands. The
+            # probe still owns recovery — and refuses to re-arm while the
+            # disk stays critical.
+            self._flip_degraded("disk_critical", free_bytes=int(free),
+                                low_watermark_bytes=self.low_watermark_bytes)
+        if new_state == DISK_OK and prev > DISK_OK:
+            self._restore_retention()
+
+    def _on_disk_warn(self, free: float) -> None:
+        """Entering warn: one preemptive WAL compaction (forced
+        checkpoint — success truncates the WAL below its sequence) and
+        one retention shrink per pressure episode."""
+        logging.getLogger(__name__).warning(
+            "disk pressure: %d bytes free < %d watermark — forcing a "
+            "checkpoint (WAL compaction) and shrinking retention",
+            int(free), self.low_watermark_bytes)
+        if self.state is not None:
+            try:
+                self.state.maybe_checkpoint(force=True)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.DISK_PRESSURE_COMPACTIONS)
+            except Exception:  # noqa: BLE001 — pressure relief is best-effort
+                logging.getLogger(__name__).exception(
+                    "disk-pressure checkpoint trigger failed")
+        self._shrink_retention()
+        self._announce({"status": "disk_pressure", "state": "warn",
+                        "free_bytes": int(free),
+                        "low_watermark_bytes": self.low_watermark_bytes})
+
+    def _shrink_retention(self) -> None:
+        if self._retention_shrunk:
+            return
+        self._retention_shrunk = True
+        store = getattr(self.state, "store", None)
+        if store is not None:
+            self._saved_retention["store_keep"] = store.keep
+            store.keep = 1
+        tracer = self._tracer_sink if self._tracer_sink is not None else self.tracer
+        if tracer is not None and hasattr(tracer, "keep_dumps"):
+            self._saved_retention["keep_dumps"] = tracer.keep_dumps
+            tracer.keep_dumps = 1
+        if self._journal is not None:
+            self._saved_retention["journal_backups"] = self._journal.backups
+            self._journal.backups = 0
+        if self.metrics is not None:
+            self.metrics.incr(mn.DISK_PRESSURE_RETENTION_SHRINKS)
+
+    def _restore_retention(self) -> None:
+        if not self._retention_shrunk:
+            return
+        self._retention_shrunk = False
+        store = getattr(self.state, "store", None)
+        if store is not None and "store_keep" in self._saved_retention:
+            store.keep = self._saved_retention["store_keep"]
+        tracer = self._tracer_sink if self._tracer_sink is not None else self.tracer
+        if tracer is not None and "keep_dumps" in self._saved_retention:
+            tracer.keep_dumps = self._saved_retention["keep_dumps"]
+        if self._journal is not None and "journal_backups" in self._saved_retention:
+            self._journal.backups = self._saved_retention["journal_backups"]
+        self._saved_retention.clear()
+
+    # ---- ticking ----
+
+    def tick(self, force: bool = False, probe: bool = False) -> None:
+        """Interval-gated cycle (the serving loop calls this beside
+        ``state.tick()``; the non-due path is one clock read): refresh the
+        disk gauges + watermark actions, and — only with ``probe`` and
+        while degraded — run the recovery probe. The serving loop always
+        calls with ``probe=False``: the probe is a blocking write+fsync
+        against a disk already known broken, and a hung device would
+        wedge the very serving this machine promises to keep running —
+        probing belongs exclusively to the background thread
+        (``start()``, which the service runs alongside the loop).
+        Concurrent tickers are serialized by a NON-BLOCKING claim — the
+        loser skips, nobody waits, and the watermark transitions fire
+        exactly once."""
+        now = time.monotonic()
+        if not force and now - self._last_tick_t < self.probe_interval_s:
+            return
+        if not self._tick_lock.acquire(blocking=False):
+            return  # another ticker owns this cycle
+        try:
+            self._last_tick_t = now
+            self._sample_disk()
+            self._check_watermarks()
+            should_probe = probe and self._degraded
+        finally:
+            self._tick_lock.release()
+        if should_probe:
+            # Outside the claim: the probe is file I/O (possibly a slow
+            # fsync) and must never hold the tick lock against the
+            # serving loop's cheap watermark refresh.
+            self.probe_now()
+
+    def start(self) -> None:
+        """Background ticker (daemon): keeps watermarks fresh and the
+        recovery probe running even when the serving loop is busy riding
+        out a slow_fsync. Idempotent."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="durability-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=max(0.05, self.probe_interval_s)):
+            try:
+                self.tick(force=True, probe=True)
+            except Exception:  # noqa: BLE001 — the monitor thread must live
+                logging.getLogger(__name__).exception(
+                    "durability monitor tick failed")
+
+
+def _lifecycle_topic() -> str:
+    from opencv_facerecognizer_tpu.utils.tracing import LIFECYCLE_TOPIC
+
+    return LIFECYCLE_TOPIC
 
 
 def rebuild_pipeline_on_cpu(service) -> None:

@@ -275,6 +275,13 @@ class ReEmbedStage:
     # ---- durable file plumbing ----
 
     def _append_line(self, text: str, newline: bool = True) -> None:
+        if self._faults is not None:
+            # Storage boundary (disk stays broken — distinct from the
+            # ``stage`` kill-point faults): an injected ENOSPC/EIO raises
+            # out of stage_chunk before the watermark advances, exactly
+            # like a real full disk; the rollout loop's existing
+            # stage-error handling owns it.
+            self._faults.on_storage("stage_append")
         with open(self.path, "a", encoding="utf-8") as fh:  # ocvf-lint: disable=non-atomic-write -- append-only progress journal (the WAL discipline): records are immutable once fsynced, torn tails are sealed at open and skipped by the crc'd reader; atomic-rewrite would destroy the resumability this file exists for
             fh.write(text + ("\n" if newline else ""))
             fh.flush()

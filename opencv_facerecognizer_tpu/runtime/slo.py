@@ -211,6 +211,35 @@ def replication_lag_objective(replica, rows_bound: float = 1024.0,
                bound=float(rows_bound), short_s=short_s, long_s=long_s)
 
 
+def disk_free_objective(free_bytes_fn: Callable[[], float],
+                        low_watermark_bytes: float,
+                        short_s: float = 60.0,
+                        long_s: float = 600.0) -> SLO:
+    """Gauge objective over the state volume's free bytes (ISSUE 15):
+    burn = ``low_watermark / free`` — exactly 1.0 (warn) at the low
+    watermark, 6.0 (critical) at one sixth of it, the same critical
+    point where ``DurabilityMonitor`` pre-empts the degraded flip before
+    ENOSPC ever lands. Takes any free-bytes callable — the stock wiring
+    passes ``DurabilityMonitor.free_bytes`` so /health and the
+    watermark actions read ONE statvfs sample, and this module
+    deliberately imports neither the monitor nor ``os.statvfs``. An
+    empty/failed probe reads burn 0 through the standard gauge-probe
+    contract (no data is not a breach)."""
+    watermark = float(low_watermark_bytes)
+    if not watermark > 0:
+        raise ValueError("disk_free_objective needs a positive low "
+                         "watermark (bytes)")
+
+    def value() -> float:
+        free = float(free_bytes_fn())
+        if not math.isfinite(free):
+            return 0.0  # no sample yet: no data is not a breach
+        return watermark / max(1.0, free)
+
+    return SLO(name="disk_free", kind="gauge", value_fn=value, bound=1.0,
+               short_s=short_s, long_s=long_s)
+
+
 def rollout_parity_objective(coordinator, min_agreement: float = 0.98,
                              short_s: float = 60.0,
                              long_s: float = 600.0) -> SLO:

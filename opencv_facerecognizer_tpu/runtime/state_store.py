@@ -231,10 +231,15 @@ class CheckpointStore:
     inspect them while the next scan skips the known-bad file cheaply.
     """
 
-    def __init__(self, directory: str, keep: int = 3, metrics=None):
+    def __init__(self, directory: str, keep: int = 3, metrics=None,
+                 fault_injector=None):
         self.directory = str(directory)
         self.keep = max(1, int(keep))
         self.metrics = metrics
+        #: chaos hook (runtime.faults): the ``storage`` boundary fires
+        #: before the tmp+rename install (writes) and before each scan
+        #: read (read_error) — one injector, every durable path.
+        self._faults = fault_injector
         self._lock = threading.Lock()
         os.makedirs(self.directory, exist_ok=True)
 
@@ -305,6 +310,13 @@ class CheckpointStore:
                     fh.flush()
                     os.fsync(fh.fileno())
                 raise InjectedCrashError("crash before checkpoint rename")
+            if self._faults is not None:
+                # Storage boundary (disk stays broken, unlike the
+                # process-death faults above): an injected ENOSPC/EIO
+                # raises out of save() onto checkpoint_now's existing
+                # counted-failure + backoff path; slow_fsync stalls the
+                # background checkpointer thread, never the serving loop.
+                self._faults.on_storage("checkpoint_write")
             atomic_write_bytes(path, blob)
             if self.metrics is not None:
                 self.metrics.incr(mn.CHECKPOINTS_WRITTEN)
@@ -313,15 +325,24 @@ class CheckpointStore:
 
     def _prune_locked(self) -> None:
         """Retention: drop installed checkpoints beyond ``keep`` (oldest
-        first), stale tmp files, and quarantined files beyond ``keep``."""
+        first), stale tmp files, and quarantined files beyond ``keep``.
+        Removal failures are counted (``checkpoint_gc_errors``), never
+        silent: a GC that stops GC-ing on a sick disk (EIO on unlink, an
+        immutable file) is exactly the kind of creeping disk growth the
+        pressure watermarks need to see coming."""
         for _seq, path in self.checkpoint_files()[self.keep:]:
             try:
                 os.remove(path)
             except OSError:
-                pass
+                logging.getLogger(__name__).warning(
+                    "checkpoint retention sweep could not remove %s", path)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.CHECKPOINT_GC_ERRORS)
         try:
             names = os.listdir(self.directory)
         except OSError:
+            if self.metrics is not None:
+                self.metrics.incr(mn.CHECKPOINT_GC_ERRORS)
             return
         # atomic_write_bytes stages as '<name>.tmp.<pid>' (pid-unique so
         # concurrent writers can't share a staging file); fault-injection
@@ -333,7 +354,10 @@ class CheckpointStore:
             try:
                 os.remove(os.path.join(self.directory, name))
             except OSError:
-                pass
+                logging.getLogger(__name__).warning(
+                    "checkpoint retention sweep could not remove %s", name)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.CHECKPOINT_GC_ERRORS)
 
     # ---- reading ----
 
@@ -349,6 +373,10 @@ class CheckpointStore:
         with self._lock:  # ocvf-lint: boundary-block=blocking-under-lock -- startup/supervisor recovery path: reads must see a settled file set, and nothing latency-sensitive contends here
             for _seq, path in self.checkpoint_files():
                 try:
+                    if self._faults is not None:
+                        # read_error chaos: lands on the exact transient-
+                        # read path below (raise, never quarantine).
+                        self._faults.on_storage_read("checkpoint_read")
                     with open(path, "rb") as fh:
                         blob = fh.read()
                 except OSError:
@@ -396,19 +424,30 @@ class CheckpointStore:
         """Offline integrity sweep (``scripts/verify_checkpoint.py``):
         validates every installed checkpoint without quarantining.
         Returns {"ok": [paths], "corrupt": [(path, reason)],
-        "newer_version": [(path, reason)]} — a newer-format file is
-        intact-but-unreadable-here, reported separately from damage."""
-        ok, corrupt, newer = [], [], []
+        "newer_version": [(path, reason)], "unreadable": [(path,
+        reason)]}. A newer-format file is intact-but-unreadable-here,
+        reported separately from damage — and an UNREADABLE file
+        (EACCES/EIO: the read itself failed) proves nothing about the
+        bytes, so it is "cannot verify", never "corrupt": a backup job
+        keying on the corrupt verdict must not condemn state a transient
+        read error merely hid."""
+        ok, corrupt, newer, unreadable = [], [], [], []
         for _seq, path in self.checkpoint_files():
             try:
                 with open(path, "rb") as fh:
-                    _decode_checkpoint(fh.read(), path)
+                    blob = fh.read()
+            except OSError as exc:
+                unreadable.append((path, str(exc)))
+                continue
+            try:
+                _decode_checkpoint(blob, path)
                 ok.append(path)
             except CheckpointVersionError as exc:
                 newer.append((path, str(exc)))
-            except (OSError, CheckpointCorruptError) as exc:
+            except CheckpointCorruptError as exc:
                 corrupt.append((path, str(exc)))
-        return {"ok": ok, "corrupt": corrupt, "newer_version": newer}
+        return {"ok": ok, "corrupt": corrupt, "newer_version": newer,
+                "unreadable": unreadable}
 
 
 def decode_enroll_record(record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
@@ -461,10 +500,14 @@ class EnrollmentWAL(RotatingJournal):
         # backup files can never exist — plumbing a backups knob through
         # would be dead machinery inviting someone to re-enable the
         # rotation this class deliberately forbids.
+        # fault_injector reaches the base class: the ``storage`` boundary
+        # fires inside every ``_append_locked`` (ENOSPC/EIO/slow_fsync on
+        # the real write path); this class's own ``wal`` boundary hooks
+        # stay the process-death simulation layer on top.
         super().__init__(path, max_bytes=max_bytes, backups=0,
                          metrics=metrics, fsync=fsync,
-                         fsync_interval_s=fsync_interval_s)
-        self._faults = fault_injector
+                         fsync_interval_s=fsync_interval_s,
+                         fault_injector=fault_injector)
         self._warned_over_bytes = False
         self._seal_torn_tail()
 
@@ -554,7 +597,16 @@ class EnrollmentWAL(RotatingJournal):
                 self._append_locked(line[:max(1, len(line) // 2)],
                                     newline=False)
             raise InjectedCrashError("torn WAL append")
-        self.append_line(line, strict=True)
+        try:
+            self.append_line(line, strict=True)
+        except OSError:
+            # Distinct from the shared journal_errors: a STRICT append
+            # failing is an enrollment refused (never acknowledged) — the
+            # exact signal the degraded-durability state machine counts
+            # toward its flip.
+            if self.metrics is not None:
+                self.metrics.incr(mn.WAL_APPEND_ERRORS)
+            raise
         if self.metrics is not None:
             self.metrics.incr(mn.WAL_APPENDS)
             self.metrics.incr(mn.WAL_ROWS_APPENDED, emb.shape[0])
@@ -714,8 +766,15 @@ class StateLifecycle:
         self.checkpoint_wal_rows = int(checkpoint_wal_rows)
         self.checkpoint_every_s = float(checkpoint_every_s)
         self._faults = fault_injector
+        #: optional runtime.resilience.DurabilityMonitor — the degraded-
+        #: durability state machine (ISSUE 15). While it reports degraded,
+        #: ``append_enrollment`` refuses CLOSED before burning a sequence
+        #: (the ack never lies); WAL append outcomes feed it from outside
+        #: the enroll lock. Attached by the monitor's constructor.
+        self.durability = None
         self.store = CheckpointStore(os.path.join(self.state_dir, "checkpoints"),
-                                     keep=keep_checkpoints, metrics=metrics)
+                                     keep=keep_checkpoints, metrics=metrics,
+                                     fault_injector=fault_injector)
         #: IVF quantizer sidecar (derived state, keyed by checkpoint
         #: wal_seq): written after each successful checkpoint when the
         #: attached gallery carries a ready quantizer; consulted by
@@ -1136,10 +1195,28 @@ class StateLifecycle:
         inside the enroll lock, BEFORE any sequence is burned — an
         enrollment embedded by the outgoing model can never land after
         the cutover swapped the space under it. The WAL record is always
-        stamped with the serving version it landed in."""
+        stamped with the serving version it landed in.
+
+        With a ``durability`` monitor attached and DEGRADED, the append
+        is refused closed up front (``DurabilityDegradedError``, counted
+        ``enrollments_refused_degraded``) — no sequence burned, no lock
+        held, no doomed write against a disk already known broken."""
+        dur = self.durability
+        if dur is not None and dur.degraded:
+            if self.metrics is not None:
+                self.metrics.incr(mn.ENROLLMENTS_REFUSED_DEGRADED)
+            from opencv_facerecognizer_tpu.runtime.resilience import (
+                DurabilityDegradedError,
+            )
+
+            raise DurabilityDegradedError(
+                "durability degraded: enrollment refused closed (WAL "
+                "appends are failing on this state dir; serving "
+                "continues, the recovery probe re-arms automatically)")
         n = int(np.asarray(labels).shape[0])
         t0 = time.monotonic()
         ok = False
+        wal_exc: Optional[OSError] = None
         try:
             with self._enroll_lock:
                 # Version fence, read under the SAME lock the cutover
@@ -1169,11 +1246,17 @@ class StateLifecycle:
                                            embedder_version=gver)
                 except InjectedCrashError:
                     raise  # simulated kill: no post-mortem writes
-                except BaseException:
+                except BaseException as exc:
                     # Best-effort tombstone for the possibly-landed record;
                     # if this fails too the residual risk is the documented
                     # at-least-once replay of an UNacknowledged record.
                     self.wal.append_abort(seq)
+                    if isinstance(exc, OSError):
+                        # Storage-shaped failure: feed the degraded-
+                        # durability machine AFTER the lock releases
+                        # (the flip publishes + spans — I/O that must
+                        # never run under the enroll lock).
+                        wal_exc = exc
                     raise
                 if apply_fn is not None:
                     try:
@@ -1197,6 +1280,16 @@ class StateLifecycle:
                 self.tracer.emit(self.tracer.new_trace(), "wal_append",
                                  topic=LIFECYCLE_TOPIC, t0=t0,
                                  dur=time.monotonic() - t0, rows=n, ok=ok)
+            if dur is not None:
+                # Outcome feed for the degraded-durability machine, also
+                # outside the enroll lock (the degraded flip publishes a
+                # status + emits a span). Only storage-shaped failures
+                # count toward the flip; a version-fence refusal or an
+                # apply_fn bug is not a disk symptom.
+                if wal_exc is not None:
+                    dur.note_wal_failure(wal_exc)
+                elif ok:
+                    dur.note_wal_success()
         if self.metrics is not None:
             self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         self.maybe_checkpoint()

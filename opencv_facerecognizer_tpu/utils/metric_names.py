@@ -141,6 +141,15 @@ CONNECTOR_STALLED_CLIENTS_DROPPED = "connector_stalled_clients_dropped"
 JOURNAL_ERRORS = "journal_errors"
 JOURNAL_RECORDS = "journal_records"
 JOURNAL_FRAMES = "journal_frames"
+#: a pre-existing journal file whose last line had no terminating newline
+#: (an ENOSPC/crash-torn append from a previous process): sealed at open
+#: so the remnant stays one isolated unparseable line — never the prefix
+#: of a new acknowledged record.
+JOURNAL_TORN_TAILS = "journal_torn_tails"
+#: records deliberately NOT written because durability is degraded (the
+#: non-critical-sink shed posture): exact accounting, not a silent
+#: best-effort swallow.
+JOURNAL_SHED = "journal_shed"
 
 # ---- durable state: checkpoints --------------------------------------------
 CHECKPOINTS_WRITTEN = "checkpoints_written"
@@ -150,6 +159,10 @@ CHECKPOINT_READ_ERRORS = "checkpoint_read_errors"
 CHECKPOINT_FAILURES = "checkpoint_failures"
 CHECKPOINTS_SKIPPED_INFLIGHT = "checkpoints_skipped_inflight"
 CHECKPOINTS_DEFERRED_PENDING = "checkpoints_deferred_pending"
+#: retention-sweep removals (stale tmp files, pruned checkpoints,
+#: quarantine excess) that failed with an OSError — previously a silent
+#: ``pass``; a GC that stops GC-ing on a sick disk must be visible.
+CHECKPOINT_GC_ERRORS = "checkpoint_gc_errors"
 
 # ---- durable state: enrollment WAL -----------------------------------------
 WAL_APPENDS = "wal_appends"
@@ -163,7 +176,41 @@ WAL_TAIL_REPLAYED_ROWS = "wal_tail_replayed_rows"
 WAL_TORN_TAILS_SEALED = "wal_torn_tails_sealed"
 WAL_OVER_BYTES = "wal_over_bytes"
 WAL_ROWS = "wal_rows"
+#: strict WAL appends that FAILED with an OSError (ENOSPC/EIO — the
+#: enrollment was refused, never acknowledged): the signal the
+#: degraded-durability state machine counts toward its flip.
+WAL_APPEND_ERRORS = "wal_append_errors"
 STATE_RECOVERIES = "state_recoveries"
+
+# ---- degraded-durability state machine (runtime.resilience, ISSUE 15) ------
+#: gauge: 0 = durability armed (WAL appends acknowledged durable),
+#: 1 = durability_degraded (sustained storage failure — enrollments are
+#: refused closed, serving/read traffic continues, non-critical sinks
+#: shed). Exported on /prom; /health carries the disk objective.
+DURABILITY_STATE = "durability_state"
+DURABILITY_DEGRADED_TRANSITIONS = "durability_degraded_transitions"
+#: degraded -> armed recoveries (the background probe's tmp write+fsync
+#: succeeded and re-armed acknowledged durability).
+DURABILITY_REARMS = "durability_rearms"
+DURABILITY_PROBES = "durability_probes"
+DURABILITY_PROBE_FAILURES = "durability_probe_failures"
+#: enroll commands / finished enrolments refused CLOSED while degraded
+#: (explicit ``durability_degraded`` status — the ack never lies).
+ENROLLMENTS_REFUSED_DEGRADED = "enrollments_refused_degraded"
+
+# ---- disk-pressure watermarks (runtime.resilience, ISSUE 15) ---------------
+#: statvfs free bytes on the state volume (gauge, refreshed by the
+#: durability monitor's tick) and the derived pressure state: 0 = ok,
+#: 1 = warn (below the low watermark — preemptive WAL compaction +
+#: retention shrink fired), 2 = critical (the degraded flip pre-empted
+#: ENOSPC).
+DISK_FREE_BYTES = "disk_free_bytes"
+DISK_PRESSURE_STATE = "disk_pressure_state"
+#: warn-watermark actions: forced checkpoint-compactions of the WAL, and
+#: retention shrinks (checkpoint keep / flight-dump keep / journal
+#: backups tightened to their floor).
+DISK_PRESSURE_COMPACTIONS = "disk_pressure_compactions"
+DISK_PRESSURE_RETENTION_SHRINKS = "disk_pressure_retention_shrinks"
 
 # ---- IVF coarse quantizer (parallel.quantizer / ops.ivf_match) -------------
 IVF_BUILDS = "ivf_builds"
@@ -180,6 +227,14 @@ IVF_SIDECAR_ERRORS = "ivf_sidecar_errors"
 # ---- tracing / flight recorder / exposition (utils.tracing, runtime.expo) --
 TRACE_DUMPS = "trace_dumps"
 TRACE_DUMP_ERRORS = "trace_dump_errors"
+#: flight dumps deliberately not written while durability is degraded
+#: (shed, exact accounting — the recorder must never contend with the
+#: WAL for a dying disk's last bytes).
+TRACE_DUMPS_SHED = "trace_dumps_shed"
+#: span-JSONL sink write failures / degraded-mode sheds — per-sink
+#: accounting, distinct from the dead-letter journal's ``journal_*``.
+TRACE_SPAN_ERRORS = "trace_span_errors"
+TRACE_SPANS_SHED = "trace_spans_shed"
 EXPO_REQUESTS = "expo_requests"
 EXPO_ERRORS = "expo_errors"
 #: derived stage-attribution gauge family:

@@ -90,7 +90,7 @@ class Tracer:
     def __init__(self, ring_size: int = 4096, sample: float = 1.0,
                  seed: int = 0, dump_dir: Optional[str] = None,
                  keep_dumps: int = 8, min_dump_interval_s: float = 1.0,
-                 span_sink=None, metrics=None):
+                 span_sink=None, metrics=None, fault_injector=None):
         self.ring_size = max(1, int(ring_size))
         self.sample = min(1.0, max(0.0, float(sample)))
         self.seed = int(seed)
@@ -104,6 +104,16 @@ class Tracer:
         #: optional shared Metrics surface for DUMP accounting only — span
         #: emission deliberately never touches the Metrics lock.
         self.metrics = metrics
+        #: chaos hook (runtime.faults): the ``storage`` boundary fires
+        #: inside ``dump`` before the atomic write, so an injected
+        #: ENOSPC/EIO exercises the exact counted never-raise path a full
+        #: disk does. None in production.
+        self.fault_injector = fault_injector
+        #: degraded-durability shed hook: while truthy, dumps are dropped
+        #: before touching the disk (counted ``trace_dumps_shed``) — the
+        #: flight recorder must never contend with the WAL for a dying
+        #: disk's last bytes. Wired by DurabilityMonitor.attach_sinks.
+        self.shed_fn = None
         # THREE id streams (next() on each is atomic in CPython):
         # - frame-trace ids (ODD): drawn in frame-arrival order ONLY, so
         #   the sampling verdict for "the Nth arriving frame" is a pure
@@ -265,8 +275,16 @@ class Tracer:
         (``force`` bypasses the limit — the end-of-run / SIGTERM dumps
         must always land). Retention keeps the newest ``keep_dumps``
         files. Never raises: a recorder failure is counted
-        (``trace_dump_errors``) — observability must not hurt serving."""
+        (``trace_dump_errors``) — observability must not hurt serving.
+        While the ``shed_fn`` hook reports degraded durability the dump
+        is SHED before any I/O (``trace_dumps_shed``, exact accounting;
+        ``force`` does not override — a forced dump against a disk known
+        broken is still a doomed write competing with the WAL)."""
         if self.dump_dir is None:
+            return None
+        if self.shed_fn is not None and self.shed_fn():
+            if self.metrics is not None:
+                self.metrics.incr(mn.TRACE_DUMPS_SHED)
             return None
         now = time.monotonic()
         with self._lock:
@@ -289,6 +307,8 @@ class Tracer:
             record["extra"] = extra
         path = os.path.join(self.dump_dir, f"flight-{seq:06d}-{reason}.json")
         try:
+            if self.fault_injector is not None:
+                self.fault_injector.on_storage("trace_dump")
             atomic_write_json(path, record)
         except (OSError, TypeError, ValueError):
             if self.metrics is not None:
@@ -316,16 +336,22 @@ class Tracer:
 
 
 def make_span_journal(path: str, max_bytes: int = 16 << 20,
-                      backups: int = 2, metrics=None):
+                      backups: int = 2, metrics=None, fault_injector=None):
     """A bounded rotating JSONL sink for ``Tracer(span_sink=...)`` — the
     dead-letter journal's ``RotatingJournal`` base reused for span export
-    (non-strict appends: a full disk costs spans, never serving).
+    (non-strict appends: a full disk costs spans, never serving; write
+    failures and degraded-mode sheds land on the sink's OWN counters,
+    ``trace_span_errors``/``trace_spans_shed``, so triage never confuses
+    a dying span sink with a dying dead-letter journal).
     Imported lazily so utils keeps no module-level dependency on the
     runtime package."""
     from opencv_facerecognizer_tpu.runtime.journal import RotatingJournal
 
     return RotatingJournal(path, max_bytes=max_bytes, backups=backups,
-                           metrics=metrics, fsync="never")
+                           metrics=metrics, fsync="never",
+                           fault_injector=fault_injector,
+                           error_counter=mn.TRACE_SPAN_ERRORS,
+                           shed_counter=mn.TRACE_SPANS_SHED)
 
 
 def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:

@@ -1951,13 +1951,432 @@ def run_rollout(seconds: float = 6.0, seed: int | None = None,
     return report
 
 
+def run_disk(seconds: float = 6.0, seed: int | None = None,
+             state_dir: str | None = None) -> dict:
+    """Storage-fault scenario (ISSUE 15 acceptance): the disk STAYS broken
+    — ENOSPC mid-enrollment, EIO mid-checkpoint, slow fsync under load,
+    disk-watermark pressure — and the writer must degrade, not die:
+
+    - sustained WAL ENOSPC flips ``durability_degraded``: every
+      enrollment is refused CLOSED (explicit status, zero acked loss),
+      serving traffic keeps completing, non-critical sinks (dead-letter
+      journal, span JSONL, flight dumps) shed with exact per-sink
+      counters;
+    - EIO on a checkpoint save counts ``checkpoint_failures`` and keeps
+      the previous checkpoint last-known-good;
+    - slow fsync slows acks but never lies (enrollments still durable);
+    - the watermark ladder (deterministic fake statvfs): warn fires one
+      preemptive WAL compaction + retention shrink, critical pre-empts
+      the degraded flip BEFORE ENOSPC and 503s ``/health``; recovery
+      restores retention, the probe re-arms, and a final restart
+      recovers EXACTLY the acknowledged history bit-equal with offline
+      verification rc 0.
+    """
+    import random as random_mod
+    import types
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        DurabilityDegradedError, DurabilityMonitor, ExpoServer,
+        FakeConnector, FaultInjector, RecognizerService, SLOMonitor,
+        StateLifecycle, disk_free_objective, graceful_shutdown,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+    from opencv_facerecognizer_tpu.runtime.journal import DeadLetterJournal
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        CONTROL_TOPIC, FRAME_TOPIC, RESULT_TOPIC, STATUS_TOPIC,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import (
+        Tracer, make_span_journal,
+    )
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak disk seed={seed} seconds={seconds}", file=sys.stderr)
+    frame_rng = np.random.default_rng(seed)
+
+    temp_dir = state_dir is None
+    if temp_dir:
+        state_dir = tempfile.mkdtemp(prefix="ocvf_disk_")
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    mesh = make_mesh()
+    DIM = 8
+    frame_shape = (16, 16)
+    #: deterministic pump size per phase, derived from the budget (not the
+    #: wall clock) so a replay with the printed seed is exact.
+    burst = max(12, min(48, int(seconds * 4)))
+    watermark = 64 << 20
+
+    report = {"scenario": "disk", "seed": seed, "seconds": seconds,
+              "state_dir": state_dir, "ok": False}
+    failures: list = []
+    acked: list = []  # (seq, emb, labels, subject, label) — fsync-acked only
+
+    metrics = Metrics(window_s=60.0, window_slices=20)
+    injector = FaultInjector(seed=seed, slow_fsync_s=0.02)
+    span_journal = make_span_journal(os.path.join(state_dir, "spans.jsonl"),
+                                    metrics=metrics, fault_injector=injector)
+    tracer = Tracer(ring_size=1 << 14, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, span_sink=span_journal,
+                    metrics=metrics, fault_injector=injector)
+    journal = DeadLetterJournal(os.path.join(state_dir, "dead_letter.jsonl"),
+                                metrics=metrics, fault_injector=injector)
+    gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names: list = []
+    state = StateLifecycle(state_dir, metrics=metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9,
+                           fault_injector=injector, tracer=tracer)
+    state.recover(gallery, names)
+
+    # Deterministic disk: the watermark ladder runs on a scripted statvfs
+    # (the real volume's free space must not decide a chaos verdict).
+    fake_disk = {"free": float(watermark * 10)}
+
+    def statvfs_fn(_path):
+        return types.SimpleNamespace(f_bavail=int(fake_disk["free"]),
+                                     f_frsize=1)
+
+    monitor = DurabilityMonitor(state, metrics=metrics, tracer=tracer,
+                                degraded_after=2, probe_interval_s=0.05,
+                                low_watermark_bytes=watermark,
+                                fault_injector=injector,
+                                statvfs_fn=statvfs_fn)
+    monitor.attach_sinks(journal=journal, span_sink=span_journal,
+                         tracer=tracer)
+    slo = SLOMonitor(metrics,
+                     [disk_free_objective(monitor.free_bytes, watermark,
+                                          short_s=0.2, long_s=0.4)],
+                     tracer=tracer, interval_s=0.05)
+
+    pipe = InstantPipeline(frame_shape, dispatch_s=0.002)
+    pipe.gallery = gallery
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipe, connector, batch_size=4, frame_shape=frame_shape,
+        flush_timeout=0.02, state_store=state, dead_letter_journal=journal,
+        tracer=tracer, slo_monitor=slo, metrics=metrics)
+    service.subject_names = names
+    service.start(warmup=False)
+    expo = ExpoServer(service, tracer=tracer, metrics=metrics, slo=slo,
+                      port=0)
+    expo.start()
+
+    frame = np.zeros(frame_shape, np.float32)
+
+    def pump(n: int, tag: str) -> None:
+        before = len(connector.messages(RESULT_TOPIC))
+        for i in range(n):
+            connector.inject(FRAME_TOPIC, {**encode_frame(frame),
+                                           "meta": {"seq": f"{tag}-{i}"}})
+        deadline = time.monotonic() + 30
+        while (len(connector.messages(RESULT_TOPIC)) < before + n
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        got = len(connector.messages(RESULT_TOPIC)) - before
+        if got != n:
+            failures.append(f"{tag}: serving stalled — {got}/{n} frames "
+                            f"published")
+
+    def enroll(tag: str):
+        """One write-ahead enrollment; returns the refusal exception or
+        None (acked — appended to the acknowledged history)."""
+        emb = frame_rng.normal(size=(2, DIM)).astype(np.float32)
+        label = len(names)
+        subject = f"{tag}_{len(acked)}"
+        labels = np.full(2, label, np.int32)
+        try:
+            seq = state.append_enrollment(
+                emb, labels, subject=subject, label=label,
+                apply_fn=lambda e=emb, l=labels: gallery.add(e, l))
+        except (DurabilityDegradedError, OSError) as exc:
+            return exc
+        names.append(subject)
+        acked.append((seq, emb, labels, subject, label))
+        return None
+
+    def statuses(kind: str) -> list:
+        return [s for s in connector.messages(STATUS_TOPIC)
+                if s.get("status") == kind]
+
+    def health_code() -> int:
+        try:
+            with urllib.request.urlopen(
+                    f"http://{expo.host}:{expo.port}/health",
+                    timeout=2.0) as resp:
+                return resp.status
+        except urllib.error.HTTPError as exc:
+            return exc.code
+        except OSError:
+            return -1
+
+    try:
+        # ---- phase A: clean baseline ----
+        pump(burst, "baseline")
+        for _ in range(3):
+            if enroll("baseline") is not None:
+                failures.append("baseline enrollment refused on a healthy "
+                                "disk")
+        if not state.checkpoint_now(wait=True):
+            failures.append("baseline checkpoint failed")
+
+        # ---- phase B: full disk mid-enrollment (sustained ENOSPC) ----
+        injector.rates["storage"] = {"enospc": 1.0}
+        refused_os = refused_closed = 0
+        for _ in range(6):
+            exc = enroll("fulldisk")
+            if exc is None:
+                failures.append("enrollment ACKED against a full disk — "
+                                "the ack lied")
+            elif isinstance(exc, DurabilityDegradedError):
+                refused_closed += 1
+            else:
+                refused_os += 1
+        report["enospc_refusals"] = {"oserror": refused_os,
+                                     "closed": refused_closed}
+        if refused_os != monitor.degraded_after:
+            failures.append(
+                f"expected exactly {monitor.degraded_after} OSError "
+                f"refusals before the flip, got {refused_os}")
+        if refused_closed != 6 - monitor.degraded_after:
+            failures.append(f"expected {6 - monitor.degraded_after} "
+                            f"refused-closed, got {refused_closed}")
+        if int(metrics.counter("wal_append_errors")) != refused_os:
+            failures.append(
+                f"wal_append_errors {metrics.counter('wal_append_errors')} "
+                f"!= {refused_os} failed appends (exact accounting)")
+        if not monitor.degraded:
+            failures.append("sustained ENOSPC never flipped "
+                            "durability_degraded")
+        if not statuses("durability_degraded"):
+            failures.append("no durability_degraded announcement")
+        # Serving continues straight through the storage outage.
+        pump(burst, "during_enospc")
+        # The enroll COMMAND is refused closed at the front door.
+        connector.inject(CONTROL_TOPIC, {"cmd": "enroll",
+                                         "subject": "must_refuse",
+                                         "count": 1})
+        time.sleep(0.2)
+        if not any(s.get("reason") == "durability_degraded"
+                   for s in statuses("rejected")):
+            failures.append("enroll command not refused with an explicit "
+                            "durability_degraded status")
+        # Non-critical sinks shed with exact per-sink accounting.
+        if tracer.dump("degraded_probe") is not None:
+            failures.append("flight dump landed while degraded (must shed)")
+        journal.append("disk_chaos", [])
+        for counter in ("trace_dumps_shed", "journal_shed",
+                        "trace_spans_shed"):
+            if metrics.counter(counter) < 1:  # ocvf-lint: disable=metrics-registry -- iterating three literal names from the registry (TRACE_DUMPS_SHED/JOURNAL_SHED/TRACE_SPANS_SHED), all registered
+                failures.append(f"{counter} never counted while degraded")
+        if int(metrics.counter("enrollments_refused_degraded")) < refused_closed + 1:
+            failures.append("enrollments_refused_degraded undercounts the "
+                            "closed refusals")
+
+        # ---- phase B': space returns — the probe re-arms ----
+        injector.rates["storage"] = {}
+        deadline = time.monotonic() + 10
+        while monitor.degraded and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if monitor.degraded:
+            failures.append("recovery probe never re-armed durability "
+                            "after the fault cleared")
+        if not statuses("durability_restored"):
+            failures.append("no durability_restored announcement")
+        if enroll("rearmed") is not None:
+            failures.append("enrollment refused after re-arm")
+
+        # ---- phase C: EIO mid-checkpoint ----
+        # The span sink is the only background storage writer; detach it
+        # for the scripted window so the one queued EIO deterministically
+        # lands on the checkpoint save.
+        saved_sink, tracer.span_sink = tracer.span_sink, None
+        before_fail = metrics.counter("checkpoint_failures")
+        injector.script("storage", "eio")
+        if state.checkpoint_now(wait=True):
+            failures.append("checkpoint save succeeded under injected EIO")
+        tracer.span_sink = saved_sink
+        if metrics.counter("checkpoint_failures") != before_fail + 1:
+            failures.append("EIO checkpoint not counted checkpoint_failures")
+        if state.store.load_latest() is None:
+            failures.append("previous checkpoint lost after the EIO save")
+
+        # ---- phase D: slow fsync under load ----
+        injector.rates["storage"] = {"slow_fsync": 1.0}
+        pump(burst, "slow_fsync")
+        if enroll("slowfsync") is not None:
+            failures.append("enrollment refused under slow_fsync (slow "
+                            "durable is still durable)")
+        if monitor.degraded:
+            failures.append("slow fsync flipped durability (latency is "
+                            "not loss)")
+        injector.rates["storage"] = {}
+
+        # ---- phase E: disk-pressure watermark ladder (scripted statvfs) --
+        # Ticks are claim-serialized against the monitor's background
+        # thread (a manual forced tick may lose the claim and skip), so
+        # every transition is awaited, never asserted off one tick —
+        # while the exactly-once counters stay exact BECAUSE of that
+        # serialization.
+        from opencv_facerecognizer_tpu.runtime.resilience import (
+            DISK_CRITICAL, DISK_OK, DISK_WARN,
+        )
+
+        def await_disk(predicate, what: str) -> None:
+            deadline = time.monotonic() + 10
+            while not predicate() and time.monotonic() < deadline:
+                monitor.tick(force=True)
+                time.sleep(0.01)
+            if not predicate():
+                failures.append(f"disk watermark ladder never reached "
+                                f"{what}")
+
+        await_disk(lambda: monitor.disk_state == DISK_OK, "baseline ok")
+        ckpts_before = metrics.counter("checkpoints_written")
+        fake_disk["free"] = watermark * 0.5  # below low watermark: warn
+        await_disk(lambda: monitor.disk_state == DISK_WARN, "warn")
+        if metrics.counter("disk_pressure_retention_shrinks") != 1:
+            failures.append("warn watermark did not shrink retention "
+                            "exactly once")
+        if metrics.counter("disk_pressure_compactions") != 1:
+            failures.append("warn watermark did not force one WAL "
+                            "compaction checkpoint")
+        if state.store.keep != 1:
+            failures.append("checkpoint retention not shrunk under disk "
+                            "pressure")
+        deadline = time.monotonic() + 10
+        while (metrics.counter("checkpoints_written") <= ckpts_before
+               and time.monotonic() < deadline):
+            time.sleep(0.02)  # the forced compaction checkpoint lands
+        if metrics.counter("checkpoints_written") <= ckpts_before:
+            failures.append("preemptive compaction checkpoint never landed")
+        fake_disk["free"] = watermark / 12.0  # below watermark/6: critical
+        await_disk(lambda: (monitor.disk_state == DISK_CRITICAL
+                            and monitor.degraded
+                            and monitor.degraded_reason == "disk_critical"),
+                   "critical degraded flip")
+        if not isinstance(enroll("critical"), DurabilityDegradedError):
+            failures.append("enrollment not refused closed at the critical "
+                            "watermark")
+        slo.evaluate()
+        critical_code = health_code()
+        if critical_code != 503:
+            failures.append(f"/health did not 503 at critical disk "
+                            f"pressure (got {critical_code})")
+        fake_disk["free"] = float(watermark * 10)  # space returns
+        deadline = time.monotonic() + 10
+        while monitor.degraded and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if monitor.degraded:
+            failures.append("durability never re-armed after disk pressure "
+                            "cleared")
+        if state.store.keep == 1:
+            failures.append("retention not restored after pressure cleared")
+        deadline = time.monotonic() + 10
+        while health_code() != 200 and time.monotonic() < deadline:
+            slo.evaluate()
+            time.sleep(0.05)
+        if health_code() != 200:
+            failures.append("/health never recovered after the pressure "
+                            "cleared")
+        if enroll("final") is not None:
+            failures.append("enrollment refused after full recovery")
+
+        # ---- settle + verify: zero acked loss, exact ledger ----
+        shutdown = graceful_shutdown(service, state=state, drain_timeout=30.0)
+        report["shutdown"] = {"drained": shutdown["drained"],
+                              "ledger": shutdown["ledger"]}
+        if not shutdown["drained"]:
+            failures.append("graceful drain timed out")
+        ledger = shutdown["ledger"]
+        if abs(ledger["in_system"]) > 1e-6:
+            failures.append(f"ledger unsettled at shutdown: {ledger}")
+        if ledger["drops_by_reason"]:
+            failures.append(f"clean traffic dropped frames: "
+                            f"{ledger['drops_by_reason']}")
+        g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+        names2: list = []
+        StateLifecycle(state_dir, metrics=Metrics()).recover(g2, names2)
+        want_emb = (np.concatenate([e for _s, e, _l, _su, _la in acked])
+                    if acked else np.zeros((0, DIM), np.float32))
+        want_emb = want_emb / np.maximum(
+            np.linalg.norm(want_emb, axis=-1, keepdims=True), 1e-12)
+        want_lab = (np.concatenate([l for _s, _e, l, _su, _la in acked])
+                    if acked else np.zeros((0,), np.int32))
+        got_emb, got_lab, _val, got_size = g2.snapshot()
+        if got_size != len(want_lab):
+            failures.append(f"recovered {got_size} rows, expected "
+                            f"{len(want_lab)} acked rows (zero-loss breach)")
+        elif got_size and (
+                not np.array_equal(got_lab[:got_size], want_lab)
+                or not np.allclose(got_emb[:got_size],
+                                   want_emb.astype(np.float32),
+                                   rtol=0, atol=1e-6)):
+            failures.append("recovered rows differ from the acknowledged "
+                            "history (bit-exactness breach)")
+        for i, (_seq, _e, _l, subject, label) in enumerate(acked):
+            if label >= len(names2) or names2[label] != subject:
+                failures.append(f"subject name {i} lost: "
+                                f"{names2[label] if label < len(names2) else None!r}"
+                                f" != {subject!r}")
+                break
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "verify_checkpoint",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "verify_checkpoint.py"))
+        verify_mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(verify_mod)
+        vreport = verify_mod.verify_state_dir(state_dir)
+        report["verify"] = {"ok": vreport["ok"],
+                            "checkpoints": len(vreport["checkpoints"])}
+        if not vreport["ok"]:
+            failures.append(f"offline verification failed: "
+                            f"{vreport.get('corrupt')}")
+        report["acked_enrollments"] = len(acked)
+        report["injected"] = injector.summary()
+        report["durability"] = monitor.status()
+        report["sink_accounting"] = {
+            k: int(metrics.counter(k))  # ocvf-lint: disable=metrics-registry -- report comprehension over literal registered names (the per-sink accounting the scenario asserts on)
+            for k in ("journal_shed", "trace_spans_shed", "trace_dumps_shed",
+                      "trace_span_errors", "journal_errors",
+                      "wal_append_errors", "checkpoint_failures",
+                      "enrollments_refused_degraded", "durability_rearms",
+                      "durability_degraded_transitions")}
+        _finish_observability(
+            tracer, trace_dir, "disk_done", ledger,
+            quiesced=shutdown["drained"] and abs(ledger["in_system"]) < 1e-6,
+            failures=failures, report=report)
+    finally:
+        try:
+            expo.stop()
+        except Exception:  # ocvf-lint: disable=swallowed-exception -- teardown-best-effort by design: a failed expo stop on the cleanup path must not mask the scenario's real verdict
+            pass
+        span_journal.close()
+        if temp_dir:
+            shutil.rmtree(state_dir, ignore_errors=True)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seconds", type=float, default=10.0)
     parser.add_argument("--seed", type=int, default=None,
                         help="replay a previous run exactly (logged on stderr)")
     parser.add_argument("--scenario", choices=["soak", "overload", "recovery",
-                                               "replication", "rollout"],
+                                               "replication", "rollout",
+                                               "disk"],
                         default="soak",
                         help="soak: randomized fault soak (default); "
                              "overload: 4x flood against the admission/"
@@ -1973,7 +2392,14 @@ def main(argv=None) -> int:
                              "live embedder rollout — kills mid-re-embed, "
                              "mid-cutover, and a reader mid-re-anchor; "
                              "assert zero acked loss, no mixed-version "
-                             "scores, serving continuity (run_rollout)")
+                             "scores, serving continuity (run_rollout); "
+                             "disk: the disk STAYS broken — ENOSPC "
+                             "mid-enrollment, EIO mid-checkpoint, slow "
+                             "fsync under load, watermark pressure; "
+                             "assert refused-closed enrollments, serving "
+                             "continuity, exact per-sink shed accounting, "
+                             "automatic re-arm, zero acked loss "
+                             "(run_disk)")
     parser.add_argument("--journal", default=None,
                         help="overload scenario: write the dead-letter "
                              "journal here instead of a temp file")
@@ -1993,6 +2419,9 @@ def main(argv=None) -> int:
     elif args.scenario == "rollout":
         report = run_rollout(seconds=args.seconds, seed=args.seed,
                              state_dir=args.state_dir)
+    elif args.scenario == "disk":
+        report = run_disk(seconds=args.seconds, seed=args.seed,
+                          state_dir=args.state_dir)
     else:
         report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))

@@ -17,8 +17,14 @@ Verifies durable state without loading any model code onto a device:
   ``CheckpointCorruptError`` on truncation/garbage).
 
 Exit status: 0 when everything verified, 2 when any corrupt file/record
-was found — wire it into CI after a backup job, or run it before trusting
-a state dir for recovery::
+was found, 3 for **cannot verify** — the bytes could not be READ
+(EACCES/EIO/a vanished file), which proves nothing about their
+integrity. The distinction matters operationally: rc 2 means restore
+from backup, rc 3 means fix the mount/permissions and re-run — reporting
+an unreadable checkpoint as corrupt could condemn perfectly good state
+(and real corruption alongside unreadable files still exits 2). Wire it
+into CI after a backup job, or run it before trusting a state dir for
+recovery::
 
     python scripts/verify_checkpoint.py /var/lib/ocvf/state
     python scripts/verify_checkpoint.py model.ckpt
@@ -54,7 +60,8 @@ def verify_state_dir(path: str) -> dict:
                         for n in os.listdir(path))
         ckpt_dir = path if has_ckpts else None
     report = {"path": path, "checkpoints": [], "corrupt": [],
-              "newer_version": [], "wal": None, "ok": True}
+              "newer_version": [], "unreadable": [], "wal": None,
+              "ok": True}
     if ckpt_dir is not None and os.path.isdir(ckpt_dir):
         sweep = CheckpointStore(ckpt_dir).verify()  # verify() never mutates
         report["checkpoints"] = sweep["ok"]
@@ -64,8 +71,16 @@ def verify_state_dir(path: str) -> dict:
         # (downgrade) — reported, but not a corruption failure.
         report["newer_version"] = [{"path": p, "reason": r}
                                    for p, r in sweep["newer_version"]]
+        # UNREADABLE (EACCES/EIO: the read failed) is "cannot verify",
+        # never "corrupt" — the bytes were not seen, so no verdict on
+        # them is honest. Fails the verification with its own rc (3).
+        report["unreadable"] = [{"path": p, "reason": r}
+                                for p, r in sweep.get("unreadable", ())]
         if sweep["corrupt"]:
             report["ok"] = False
+        if report["unreadable"]:
+            report["ok"] = False
+            report["cannot_verify"] = True
         # Embedder-version header validation (rollout fencing): every
         # verified checkpoint must carry a sane version field (absent =
         # pre-rollout v1). A non-integer / non-positive field is a
@@ -110,8 +125,17 @@ def verify_state_dir(path: str) -> dict:
         # a new checkpoint's anchor legitimately predate it, so the walk
         # follows the stream's own fences, not the anchor.
         cur_version = None
-        with open(wal_path, "r", encoding="utf-8", errors="replace") as fh:
-            lines = [l.rstrip("\n") for l in fh]
+        try:
+            with open(wal_path, "r", encoding="utf-8",
+                      errors="replace") as fh:
+                lines = [l.rstrip("\n") for l in fh]
+        except OSError as exc:
+            # The WAL exists but cannot be read: cannot verify (rc 3),
+            # not corruption — same contract as the checkpoint sweep.
+            report["wal"] = {"path": wal_path, "unreadable": str(exc)}
+            report["ok"] = False
+            report["cannot_verify"] = True
+            return report
         for line in lines:
             if not line.strip():
                 continue
@@ -289,8 +313,11 @@ def verify_model_file(path: str) -> dict:
         report["ok"] = False
         report["reason"] = f"unloadable: {exc}"
     except OSError as exc:
+        # Read failure: cannot verify (rc 3) — the bytes were never seen,
+        # so calling them corrupt would be a false condemnation.
         report["ok"] = False
         report["reason"] = f"unreadable: {exc}"
+        report["cannot_verify"] = True
     return report
 
 
@@ -326,7 +353,18 @@ def main(argv=None) -> int:
         report = {"path": args.path, "ok": False,
                   "reason": "path does not exist"}
     print(json.dumps(report, indent=2))
-    return 0 if report["ok"] else 2
+    if report["ok"]:
+        return 0
+    # rc 3 = "cannot verify": the ONLY failures were read errors
+    # (EACCES/EIO). Any actual corruption evidence alongside them keeps
+    # rc 2 — restore-from-backup beats fix-the-mount when both apply.
+    wal = report.get("wal") or {}
+    corruption = bool(report.get("corrupt") or report.get("version_errors")
+                      or wal.get("corrupt_records")
+                      or wal.get("version_violations"))
+    if report.get("cannot_verify") and not corruption:
+        return 3
+    return 2
 
 
 if __name__ == "__main__":

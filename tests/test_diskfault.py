@@ -1,0 +1,578 @@
+"""Storage-fault tolerance suite (ISSUE 15): the disk STAYS broken and
+the writer must degrade, not die.
+
+Covers the ``storage`` fault boundary (enospc/eio/slow_fsync/read_error,
+one injector threaded through every durable path), the degraded-
+durability state machine (refused-closed enrollments, per-sink shed
+accounting, probe re-arm), the disk-pressure watermark ladder, the
+journal torn-tail seal-at-open satellite, the checkpoint-GC error
+counter, the offline verifier's unreadable-vs-corrupt rc split, tracing
+sinks under injected write failure, and the fast deterministic tier-1
+variant of ``chaos_soak.py --scenario disk``.
+"""
+
+import errno
+import importlib.util
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.runtime import (
+    DurabilityDegradedError,
+    DurabilityMonitor,
+    FaultInjector,
+    StateLifecycle,
+    WALTailer,
+    disk_free_objective,
+)
+from opencv_facerecognizer_tpu.runtime.journal import (
+    DeadLetterJournal,
+    RotatingJournal,
+)
+from opencv_facerecognizer_tpu.runtime.resilience import (
+    DISK_CRITICAL,
+    DISK_OK,
+    DISK_WARN,
+)
+from opencv_facerecognizer_tpu.runtime.state_store import CheckpointStore
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+from opencv_facerecognizer_tpu.utils.tracing import Tracer, make_span_journal
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "chaos_soak_disk", os.path.join(REPO_ROOT, "scripts", "chaos_soak.py"))
+chaos_soak = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos_soak)
+
+_vspec = importlib.util.spec_from_file_location(
+    "verify_checkpoint_disk",
+    os.path.join(REPO_ROOT, "scripts", "verify_checkpoint.py"))
+verify_checkpoint = importlib.util.module_from_spec(_vspec)
+_vspec.loader.exec_module(verify_checkpoint)
+
+DIM = 8
+
+
+def _lifecycle(tmp_path, metrics=None, injector=None, tracer=None):
+    mesh = make_mesh()
+    gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(str(tmp_path), metrics=metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9,
+                           fault_injector=injector, tracer=tracer)
+    state.recover(gallery, names)
+    return state, gallery, names
+
+
+def _enroll(state, gallery, names, rng, subject):
+    emb = rng.normal(size=(2, DIM)).astype(np.float32)
+    label = len(names)
+    labels = np.full(2, label, np.int32)
+    seq = state.append_enrollment(
+        emb, labels, subject=subject, label=label,
+        apply_fn=lambda: gallery.add(emb, labels))
+    names.append(subject)
+    return seq, emb, labels
+
+
+# ---------------- the storage fault boundary ----------------
+
+
+def test_storage_boundary_write_faults_raise_the_right_errno():
+    inj = FaultInjector(seed=0)
+    inj.script("storage", "enospc", "eio")
+    with pytest.raises(OSError) as exc:
+        inj.on_storage("unit")
+    assert exc.value.errno == errno.ENOSPC
+    with pytest.raises(OSError) as exc:
+        inj.on_storage("unit")
+    assert exc.value.errno == errno.EIO
+    inj.on_storage("unit")  # queue drained: passthrough
+    assert inj.summary() == {"storage:enospc": 1, "storage:eio": 1}
+
+
+def test_storage_boundary_filters_read_vs_write_kinds():
+    """A scripted read_error waits for a READ crossing instead of being
+    burned by a write, and vice versa — one queue, two directions."""
+    inj = FaultInjector(seed=0)
+    inj.script("storage", "read_error")
+    inj.on_storage("write-crossing")  # must NOT consume the read fault
+    with pytest.raises(OSError):
+        inj.on_storage_read("read-crossing")
+    inj.script("storage", "enospc")
+    inj.on_storage_read("read-crossing")  # must NOT consume the write fault
+    with pytest.raises(OSError):
+        inj.on_storage("write-crossing")
+
+
+def test_storage_slow_fsync_stalls_but_succeeds(tmp_path):
+    import time as _time
+
+    inj = FaultInjector(seed=0, slow_fsync_s=0.05)
+    inj.script("storage", "slow_fsync")
+    t0 = _time.monotonic()
+    inj.on_storage("unit")
+    assert _time.monotonic() - t0 >= 0.04  # stalled, not raised
+
+
+def test_storage_rates_validate_at_construction():
+    with pytest.raises(ValueError):
+        FaultInjector(rates={"storage": {"bogus": 0.5}})
+
+
+# ---------------- WAL under ENOSPC: the degraded flip ----------------
+
+
+def test_sustained_wal_enospc_flips_degraded_and_probe_rearms(tmp_path):
+    rng = np.random.default_rng(7)
+    metrics = Metrics()
+    inj = FaultInjector(seed=7)
+    state, gallery, names = _lifecycle(tmp_path, metrics=metrics,
+                                       injector=inj)
+    statuses = []
+    mon = DurabilityMonitor(state, metrics=metrics, degraded_after=2,
+                            probe_interval_s=0.01, fault_injector=inj,
+                            publish=statuses.append)
+    assert state.durability is mon
+    _enroll(state, gallery, names, rng, "clean")
+    acked_rows = int(gallery.size)
+
+    inj.rates["storage"] = {"enospc": 1.0}
+    refusals = []
+    for i in range(5):
+        with pytest.raises((OSError, DurabilityDegradedError)) as exc:
+            _enroll(state, gallery, names, rng, f"doomed_{i}")
+        refusals.append(exc.value)
+    # Exactly degraded_after OSErrors before the flip; refused closed after.
+    assert sum(isinstance(e, OSError)
+               and not isinstance(e, DurabilityDegradedError)
+               for e in refusals) == 2
+    assert sum(isinstance(e, DurabilityDegradedError)
+               for e in refusals) == 3
+    assert mon.degraded and mon.degraded_reason == "wal_append_failures"
+    assert metrics.counter(mn.WAL_APPEND_ERRORS) == 2
+    assert metrics.counter(mn.ENROLLMENTS_REFUSED_DEGRADED) == 3
+    assert metrics.counter(mn.DURABILITY_DEGRADED_TRANSITIONS) == 1
+    assert [s["status"] for s in statuses] == ["durability_degraded"]
+    # Nothing refused ever touched the gallery — the ack never lies.
+    assert int(gallery.size) == acked_rows
+
+    # Probe fails while the fault persists; re-arms the moment it clears.
+    assert not mon.probe_now()
+    assert metrics.counter(mn.DURABILITY_PROBE_FAILURES) == 1
+    inj.rates["storage"] = {}
+    assert mon.probe_now()
+    assert not mon.degraded
+    assert metrics.counter(mn.DURABILITY_REARMS) == 1
+    assert statuses[-1]["status"] == "durability_restored"
+    seq, emb, labels = _enroll(state, gallery, names, rng, "after")
+
+    # Zero acked loss across a restart: only the acked rows come back.
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=make_mesh())
+    names2 = []
+    StateLifecycle(str(tmp_path), metrics=Metrics()).recover(g2, names2)
+    assert int(g2.size) == acked_rows + 2
+    assert names2 == names
+
+
+def test_serving_tick_never_probes(tmp_path):
+    """The serving loop's tick (probe=False) must never run the recovery
+    probe: a blocking fsync against a disk known broken would wedge the
+    very serving degraded mode exists to protect. Probing is the
+    background thread's job (tick(probe=True))."""
+    metrics = Metrics()
+    state = types.SimpleNamespace(state_dir=str(tmp_path), durability=None)
+    mon = DurabilityMonitor(state, metrics=metrics, degraded_after=1,
+                            probe_interval_s=0.0)
+    mon.note_wal_failure(OSError(errno.ENOSPC, "boom"))
+    assert mon.degraded
+    mon.tick(force=True)  # the serving-loop form
+    assert metrics.counter(mn.DURABILITY_PROBES) == 0
+    assert mon.degraded
+    mon.tick(force=True, probe=True)  # the background-thread form
+    assert metrics.counter(mn.DURABILITY_PROBES) == 1
+    assert not mon.degraded
+
+
+def test_wal_success_resets_the_failure_streak(tmp_path):
+    rng = np.random.default_rng(3)
+    inj = FaultInjector(seed=3)
+    metrics = Metrics()
+    state, gallery, names = _lifecycle(tmp_path, metrics=metrics,
+                                       injector=inj)
+    mon = DurabilityMonitor(state, metrics=metrics, degraded_after=2,
+                            fault_injector=inj)
+    # fail, succeed, fail: never two CONSECUTIVE failures -> never flips.
+    # (each failed append also burns one scripted fault on its abort
+    # tombstone, so queue two per failure)
+    for i in range(2):
+        inj.script("storage", "eio", "eio")
+        with pytest.raises(OSError):
+            _enroll(state, gallery, names, rng, f"fail_{i}")
+        _enroll(state, gallery, names, rng, f"ok_{i}")
+    assert not mon.degraded
+    assert metrics.counter(mn.WAL_APPEND_ERRORS) == 2
+
+
+# ---------------- disk-pressure watermarks ----------------
+
+
+def _fake_statvfs(holder):
+    def fn(_path):
+        return types.SimpleNamespace(f_bavail=int(holder["free"]),
+                                     f_frsize=1)
+
+    return fn
+
+
+def test_watermark_ladder_warn_critical_and_recovery(tmp_path):
+    rng = np.random.default_rng(5)
+    metrics = Metrics()
+    state, gallery, names = _lifecycle(tmp_path, metrics=metrics)
+    _enroll(state, gallery, names, rng, "seed")
+    watermark = 1 << 20
+    disk = {"free": float(watermark * 4)}
+    mon = DurabilityMonitor(state, metrics=metrics, degraded_after=2,
+                            probe_interval_s=0.01,
+                            low_watermark_bytes=watermark,
+                            statvfs_fn=_fake_statvfs(disk))
+    tracer = Tracer(dump_dir=str(tmp_path / "flight"), metrics=metrics)
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"), metrics=metrics)
+    mon.attach_sinks(journal=journal, tracer=tracer)
+    keep_before = state.store.keep
+    dumps_before = tracer.keep_dumps
+
+    # No background thread in this test: manual ticks always win the
+    # claim, so single-tick assertions are exact here. probe=True takes
+    # the background thread's role (the serving loop never probes).
+    mon.tick(force=True)
+    assert mon.disk_state == DISK_OK
+    assert mon.free_bytes() == watermark * 4  # the gauge's shared sample
+
+    # Warn: ONE preemptive compaction + ONE retention shrink per episode.
+    disk["free"] = watermark * 0.5
+    mon.tick(force=True)
+    mon.tick(force=True)  # second tick inside the episode: no double fire
+    assert mon.disk_state == DISK_WARN
+    assert metrics.counter(mn.DISK_PRESSURE_COMPACTIONS) == 1
+    assert metrics.counter(mn.DISK_PRESSURE_RETENTION_SHRINKS) == 1
+    assert state.store.keep == 1
+    assert tracer.keep_dumps == 1
+    assert journal.backups == 0
+    assert not mon.degraded  # warn is pressure relief, not refusal
+
+    # Critical pre-empts the degraded flip BEFORE any ENOSPC lands.
+    disk["free"] = watermark / 12.0
+    mon.tick(force=True)
+    assert mon.disk_state == DISK_CRITICAL
+    assert mon.degraded and mon.degraded_reason == "disk_critical"
+    with pytest.raises(DurabilityDegradedError):
+        _enroll(state, gallery, names, rng, "refused")
+    # The probe REFUSES to re-arm while the disk stays critical.
+    assert mon.probe_now()
+    assert mon.degraded
+
+    # Space returns: retention restored, probe re-arms, enrolls flow.
+    disk["free"] = float(watermark * 4)
+    mon.tick(force=True, probe=True)
+    assert mon.disk_state == DISK_OK
+    assert state.store.keep == keep_before
+    assert tracer.keep_dumps == dumps_before
+    assert not mon.degraded
+    _enroll(state, gallery, names, rng, "recovered")
+
+
+def test_disk_free_objective_burn_semantics():
+    holder = {"free": 6e6}
+    obj = disk_free_objective(lambda: holder["free"], 1e6)
+    assert obj.value_fn() == pytest.approx(1 / 6)
+    holder["free"] = 1e6  # exactly the watermark: burn 1.0 (warn)
+    assert obj.value_fn() == pytest.approx(1.0)
+    holder["free"] = 1e6 / 6  # a sixth of it: burn 6.0 (critical)
+    assert obj.value_fn() == pytest.approx(6.0)
+    holder["free"] = float("inf")  # no sample yet: no data is not a breach
+    assert obj.value_fn() == 0.0
+    with pytest.raises(ValueError):
+        disk_free_objective(lambda: 1.0, 0)
+
+
+# ---------------- satellite: journal torn-tail seal-at-open ----------------
+
+
+def test_journal_enospc_torn_line_sealed_at_next_open(tmp_path):
+    """An ENOSPC-torn line is sealed at next open, never replayed, never
+    double-counted — and the record that follows parses cleanly."""
+    path = str(tmp_path / "dl.jsonl")
+    j1 = DeadLetterJournal(path, metrics=Metrics())
+    j1.append("first", [{"meta": 1}])
+    j1.close()
+    # A partial record with no newline: exactly what ENOSPC leaves.
+    with open(path, "a") as fh:
+        fh.write('{"ts": 1, "reason": "torn_by_enosp')
+    metrics = Metrics()
+    j2 = DeadLetterJournal(path, metrics=metrics)
+    j2.append("second", [{"meta": 2}])
+    j2.close()
+    assert metrics.counter(mn.JOURNAL_TORN_TAILS) == 1
+    reasons = [r["reason"] for r in DeadLetterJournal(path).records()]
+    assert reasons == ["first", "second"]  # torn remnant skipped exactly
+    with open(path) as fh:
+        lines = [l for l in fh.read().split("\n") if l]
+    assert len(lines) == 3  # first + isolated torn line + second
+    json.loads(lines[0]), json.loads(lines[2])
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(lines[1])
+
+
+def test_journal_injected_enospc_partial_append_never_corrupts(tmp_path):
+    """In-process ENOSPC on an append: the NEXT successful record must not
+    glue onto whatever partial bytes landed."""
+    inj = FaultInjector(seed=0)
+    metrics = Metrics()
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"), metrics=metrics,
+                                fault_injector=inj)
+    journal.append("before", [])
+    inj.script("storage", "enospc")
+    journal.append("lost", [])  # swallowed (non-strict), counted
+    assert metrics.counter(mn.JOURNAL_ERRORS) == 1
+    journal.append("after", [])
+    journal.close()
+    reasons = [r["reason"] for r in journal.records()]
+    assert reasons == ["before", "after"]
+
+
+def test_journal_sheds_with_exact_count_while_degraded(tmp_path):
+    metrics = Metrics()
+    journal = DeadLetterJournal(str(tmp_path / "dl.jsonl"), metrics=metrics)
+    degraded = {"on": True}
+    journal.shed_fn = lambda: degraded["on"]
+    for _ in range(3):
+        journal.append("shed_me", [])
+    assert metrics.counter(mn.JOURNAL_SHED) == 3
+    assert metrics.counter(mn.JOURNAL_RECORDS) == 0
+    assert not os.path.exists(journal.path)  # no disk touched
+    degraded["on"] = False
+    journal.append("kept", [])
+    journal.close()
+    assert [r["reason"] for r in journal.records()] == ["kept"]
+
+
+def test_wal_strict_appends_never_shed(tmp_path):
+    """The WAL is the signal, not a sheddable sink: strict appends ignore
+    shed_fn by contract."""
+    j = RotatingJournal(str(tmp_path / "j.jsonl"), metrics=Metrics())
+    j.shed_fn = lambda: True
+    assert j.append_line('{"k": 1}', strict=True)
+    j.close()
+    assert [r["k"] for r in j.records()] == [1]
+
+
+# ---------------- satellite: checkpoint-GC error accounting ----------------
+
+
+def test_checkpoint_gc_errors_counted_not_swallowed(tmp_path, monkeypatch):
+    metrics = Metrics()
+    store = CheckpointStore(str(tmp_path), keep=1, metrics=metrics)
+    store.save(b"one", {"n": 1})
+    real_remove = os.remove
+
+    def failing_remove(path):
+        if path.endswith(".ckpt"):
+            raise OSError(errno.EIO, "injected unlink failure")
+        real_remove(path)
+
+    monkeypatch.setattr(os, "remove", failing_remove)
+    store.save(b"two", {"n": 2})  # retention tries to prune ckpt 1
+    assert metrics.counter(mn.CHECKPOINT_GC_ERRORS) >= 1
+    monkeypatch.undo()
+    assert len(store.checkpoint_files()) == 2  # the prune really failed
+
+
+# ---------------- satellite: verifier unreadable vs corrupt ----------------
+
+
+def _make_state_with_checkpoint(tmp_path, rng):
+    state, gallery, names = _lifecycle(tmp_path)
+    _enroll(state, gallery, names, rng, "subject")
+    assert state.checkpoint_now(wait=True)
+    return state
+
+
+def test_verifier_unreadable_is_cannot_verify_rc3(tmp_path):
+    rng = np.random.default_rng(11)
+    _make_state_with_checkpoint(tmp_path, rng)
+    ckpt_dir = tmp_path / "checkpoints"
+    # A directory named like a checkpoint: open() raises IsADirectoryError
+    # (an OSError) — unreadable, and provably NOT corrupt.
+    os.mkdir(str(ckpt_dir / "ckpt-00000099.ckpt"))
+    report = verify_checkpoint.verify_state_dir(str(tmp_path))
+    assert not report["ok"]
+    assert report["cannot_verify"]
+    assert len(report["unreadable"]) == 1
+    assert report["corrupt"] == []  # never misreported as corrupt
+    rc = verify_checkpoint.main([str(tmp_path)])
+    assert rc == 3
+
+
+def test_verifier_corruption_beats_cannot_verify_rc2(tmp_path):
+    rng = np.random.default_rng(12)
+    state = _make_state_with_checkpoint(tmp_path, rng)
+    ckpt_dir = tmp_path / "checkpoints"
+    os.mkdir(str(ckpt_dir / "ckpt-00000099.ckpt"))  # unreadable
+    newest = next(p for _s, p in state.store.checkpoint_files()
+                  if os.path.isfile(p))
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as fh:  # real damage alongside
+        fh.write(blob[: len(blob) // 2])
+    rc = verify_checkpoint.main([str(tmp_path)])
+    assert rc == 2  # restore-from-backup beats fix-the-mount
+
+
+def test_verifier_clean_state_still_rc0(tmp_path):
+    rng = np.random.default_rng(13)
+    _make_state_with_checkpoint(tmp_path, rng)
+    assert verify_checkpoint.main([str(tmp_path)]) == 0
+
+
+def test_store_verify_separates_unreadable(tmp_path):
+    store = CheckpointStore(str(tmp_path), keep=3)
+    store.save(b"payload", {"n": 1})
+    os.mkdir(str(tmp_path / "ckpt-00000099.ckpt"))
+    sweep = store.verify()
+    assert len(sweep["ok"]) == 1
+    assert len(sweep["unreadable"]) == 1
+    assert sweep["corrupt"] == []
+
+
+# ---------------- satellite: tracing sinks under write failure ----------------
+
+
+def test_flight_dump_write_failure_counts_and_never_raises(tmp_path):
+    metrics = Metrics()
+    inj = FaultInjector(seed=0)
+    tracer = Tracer(dump_dir=str(tmp_path / "flight"), metrics=metrics,
+                    fault_injector=inj)
+    tracer.emit(tracer.new_trace(), "unit")
+    inj.script("storage", "eio")
+    assert tracer.dump("broken", force=True) is None  # shed, not raised
+    assert metrics.counter(mn.TRACE_DUMP_ERRORS) == 1
+    assert tracer.dump("works", force=True) is not None
+    assert metrics.counter(mn.TRACE_DUMPS) == 1
+
+
+def test_span_sink_write_failure_counts_per_sink(tmp_path):
+    metrics = Metrics()
+    inj = FaultInjector(seed=0)
+    sink = make_span_journal(str(tmp_path / "spans.jsonl"), metrics=metrics,
+                             fault_injector=inj)
+    tracer = Tracer(span_sink=sink, metrics=metrics)
+    inj.script("storage", "enospc")
+    tracer.emit(tracer.new_trace(), "doomed")  # must NOT raise
+    assert metrics.counter(mn.TRACE_SPAN_ERRORS) == 1
+    assert metrics.counter(mn.JOURNAL_ERRORS) == 0  # per-sink, not shared
+    tracer.emit(tracer.new_trace(), "fine")
+    sink.close()
+    assert sum(1 for _ in sink.records()) == 1
+
+
+def test_dump_and_span_shed_while_degraded(tmp_path):
+    metrics = Metrics()
+    sink = make_span_journal(str(tmp_path / "spans.jsonl"), metrics=metrics)
+    tracer = Tracer(dump_dir=str(tmp_path / "flight"), span_sink=sink,
+                    metrics=metrics)
+    state = types.SimpleNamespace(state_dir=str(tmp_path), durability=None)
+    mon = DurabilityMonitor(state, metrics=metrics, degraded_after=1)
+    mon.attach_sinks(span_sink=sink, tracer=tracer)
+    mon.note_wal_failure(OSError(errno.ENOSPC, "boom"))
+    assert mon.degraded
+    tracer.emit(tracer.new_trace(), "shed_me")
+    assert tracer.dump("shed_me", force=True) is None
+    assert metrics.counter(mn.TRACE_SPANS_SHED) == 1
+    assert metrics.counter(mn.TRACE_DUMPS_SHED) == 1
+    assert mon.probe_now()  # tmp-dir probe write succeeds -> re-arm
+    tracer.emit(tracer.new_trace(), "kept")
+    assert tracer.dump("kept", force=True) is not None
+
+
+# ---------------- tailer reads + rollout stage writes ----------------
+
+
+def test_tailer_read_error_is_counted_poll_error(tmp_path):
+    wal = tmp_path / "enroll.wal"
+    wal.write_text('{"kind": "enroll", "seq": 1}\n')
+    metrics = Metrics()
+    inj = FaultInjector(seed=0)
+    tailer = WALTailer(str(wal), metrics=metrics, fault_injector=inj)
+    inj.script("storage", "read_error")
+    records, info = tailer.poll()
+    assert records == [] and info.get("error")
+    assert metrics.counter(mn.REPLICATION_POLL_ERRORS) == 1
+    records, _info = tailer.poll()  # transient: the next poll recovers
+    assert len(records) == 1
+
+
+def test_rollout_stage_append_enospc_never_advances_watermark(tmp_path):
+    from opencv_facerecognizer_tpu.runtime.rollout import ReEmbedStage
+
+    inj = FaultInjector(seed=0)
+    stage = ReEmbedStage(str(tmp_path), to_version=2, dim=DIM,
+                         metrics=Metrics(), fault_injector=inj)
+    emb = np.ones((4, DIM), np.float32)
+    labels = np.zeros(4, np.int32)
+    inj.script("storage", "enospc")
+    with pytest.raises(OSError):
+        stage.stage_chunk(0, emb, labels)
+    assert stage.watermark == 0  # the ack (watermark) never lies
+    stage.stage_chunk(0, emb, labels)
+    assert stage.watermark == 4
+
+
+# ---------------- registry plumbing ----------------
+
+
+def test_new_metric_names_registered_and_unique():
+    for name in ("durability_state", "durability_degraded_transitions",
+                 "durability_rearms", "durability_probes",
+                 "durability_probe_failures", "enrollments_refused_degraded",
+                 "disk_free_bytes", "disk_pressure_state",
+                 "disk_pressure_compactions",
+                 "disk_pressure_retention_shrinks", "wal_append_errors",
+                 "checkpoint_gc_errors", "journal_torn_tails",
+                 "journal_shed", "trace_span_errors", "trace_spans_shed",
+                 "trace_dumps_shed"):
+        assert name in mn.all_names(), name
+    names = mn.all_names()
+    assert len(names) == len(set(names))
+
+
+# ---------------- the fast deterministic chaos variant (tier-1) ----------------
+
+
+def test_disk_chaos_fast_deterministic():
+    """`chaos_soak.py --scenario disk` in miniature: seed 7, 2 simulated
+    seconds — full disk mid-enrollment, EIO mid-checkpoint, slow fsync
+    under load, watermark ladder, recovery — passing only with zero
+    acked loss, exact ledger + per-sink accounting, refused-enrollment
+    statuses during the outage, and a clean automatic re-arm."""
+    report = chaos_soak.run_disk(seconds=2.0, seed=7)
+    assert report["ok"], report["failures"]
+    assert report["acked_enrollments"] >= 5
+    assert report["enospc_refusals"] == {"oserror": 2, "closed": 4}
+    acct = report["sink_accounting"]
+    assert acct["wal_append_errors"] == 2
+    assert acct["checkpoint_failures"] == 1
+    assert acct["durability_degraded_transitions"] == 2  # enospc + critical
+    assert acct["durability_rearms"] == 2
+    assert acct["journal_shed"] >= 1
+    assert acct["trace_dumps_shed"] >= 1
+    assert acct["trace_spans_shed"] >= 1
+    ledger = report["shutdown"]["ledger"]
+    assert ledger["admitted"] == ledger["completed"] > 0
+    assert report["verify"]["ok"]

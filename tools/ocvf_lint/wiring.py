@@ -50,6 +50,12 @@ ATTR_HINTS: Dict[str, str] = {
     # Cascade early-exit detection (ISSUE 13): the pipeline's
     # ``self.cascade`` is the stage-1 face-proposal model.
     "cascade": "FaceGate",
+    # Degraded durability (ISSUE 15): the lifecycle's ``self.durability``
+    # is the state machine whose probe thread owns the recovery tmp-file
+    # write + fsync; ``span_sink`` is the tracer's JSONL journal (the
+    # RotatingJournal base, with its own per-sink counters).
+    "durability": "DurabilityMonitor",
+    "span_sink": "RotatingJournal",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
