@@ -1372,6 +1372,59 @@ def run_rollout_smoke(seconds: float = 2.0, batch_size: int = 8,
     return out
 
 
+def run_partition_smoke(seconds: float = 4.0, seed: int = 7):
+    """Partition-tolerance smoke (ISSUE 16): runs the chaos driver's
+    ``partition`` scenario at a pinned seed — 3 routed replicas, the
+    busiest one partitioned and healed, a flapping second link, a 50%
+    duplicate storm, a half-open writer losing its lease dir — and
+    lifts the load-bearing numbers into the artifact:
+
+    - ``failover_s``: partition onset to link-down detection (the link
+      deadline + a few health cycles is the budget; tracked across
+      artifacts by ``scripts/bench_compare.py`` as
+      ``partition_failover_s``);
+    - ``survivor_p99_ms`` vs ``baseline_p99_ms``: survivor interactive
+      tail through the partition against the unloaded fleet (<= 2x is
+      the scenario's own gate);
+    - exactly-once accounting: hedges fired/won, total dedups absorbed,
+      zero duplicate upstream publishes.
+
+    ``partition_ok`` gates the smoke's exit code."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   "scripts", "chaos_soak.py"))
+    chaos_soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_soak)
+    report = chaos_soak.run_partition(seconds=seconds, seed=seed)
+    router = report.get("router", {})
+    out = {
+        "note": ("chaos partition scenario at a pinned seed: partition + "
+                 "heal the busiest replica, flap a second link, 50% "
+                 "duplicate storm, half-open writer fail-closed"),
+        "config": {"seconds": seconds, "seed": seed},
+        "failover_s": report.get("failover_s"),
+        "baseline_p99_ms": report.get("baseline_p99_ms"),
+        "survivor_p99_ms": report.get("survivor_p99_ms"),
+        "blackout_offered": report.get("blackout_offered"),
+        "blackout_rescued": report.get("blackout_rescued"),
+        "router_hedges": router.get("router_hedges"),
+        "router_hedge_wins": router.get("router_hedge_wins"),
+        "router_hedge_wasted": router.get("router_hedge_wasted"),
+        "deduped_total": report.get("deduped_total"),
+        "duplicate_publishes": report.get("duplicate_publishes"),
+        "link_failures": router.get("link_failures"),
+        "link_recoveries": router.get("link_recoveries"),
+        "split_brain": report.get("split_brain"),
+        "failures": report.get("failures"),
+        "partition_ok": bool(report.get("ok")),
+    }
+    print(json.dumps(out), file=sys.stderr)
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--rates", type=float, nargs="+",
@@ -1409,6 +1462,7 @@ def main(argv=None):
         artifact["replica_scaleout"] = run_replica_scaleout()
         artifact["rollout"] = run_rollout_smoke()
         artifact["cascade"] = run_cascade_smoke()
+        artifact["partition"] = run_partition_smoke()
         with open("BENCH_SERVING_smoke.json", "w") as fh:
             json.dump(artifact, fh, indent=2)
         print("wrote BENCH_SERVING_smoke.json", file=sys.stderr)
@@ -1455,22 +1509,33 @@ def main(argv=None):
             "cascade_stage1_recall": artifact["cascade"]["recall"]
             .get("stage1_recall"),
             "cascade_ok": artifact["cascade"]["cascade_ok"],
+            "partition_failover_s": artifact["partition"].get("failover_s"),
+            "partition_survivor_p99_ms": artifact["partition"].get(
+                "survivor_p99_ms"),
+            "partition_deduped_total": artifact["partition"].get(
+                "deduped_total"),
+            "partition_ok": artifact["partition"].get("partition_ok"),
         }))
-        # All four gates fail closed (False on a failed measurement):
+        # All five gates fail closed (False on a failed measurement):
         # tracing overhead, the 2-replica >= 1.6x completed-frames
         # scaling, the ingest gate (ring H2D p99 within 3x p50 at
         # every rung, >= 1.15x uint8 completed-frames uplift at b32 with
         # >= 3.5x fewer bytes/frame, zero steady-state staging allocs,
-        # compressed intake completing every offered frame), AND the
+        # compressed intake completing every offered frame), the
         # cascade gate (>= 2x completed-frames uplift at 0% face
         # density / >= 1.3x at 30%, stage-1 recall >= 0.99 at the
         # default threshold, zero post-warmup recompiles across cascade
         # on/off x ingest modes, exact completed_empty settlement under
-        # the reject-all chaos fault).
+        # the reject-all chaos fault), AND the partition gate (the
+        # chaos partition scenario's own verdicts: bounded failover,
+        # survivor p99 <= 2x baseline, hedge rescue, exactly-once
+        # publishes, exact ledgers under duplication, split-brain
+        # fail-closed + re-arm).
         return (0 if trace_cmp.get("within_gate")
                 and scaleout.get("scaling_2x_ok")
                 and ingest.get("ingest_ok")
-                and artifact["cascade"].get("cascade_ok") else 3)
+                and artifact["cascade"].get("cascade_ok")
+                and artifact["partition"].get("partition_ok") else 3)
 
     import jax
 

@@ -396,6 +396,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="index (into --router) of the replica that owns "
                         "enrollment: control-topic traffic routes only "
                         "there")
+    p.add_argument("--router-link-deadline-s", type=float, default=0.0,
+                   help="link supervision: app-level heartbeat (ping/pong "
+                        "over the data link itself) per replica per health "
+                        "cycle; a pong older than this marks the LINK down "
+                        "— routing excludes it and the flight recorder "
+                        "dumps a failover — independent of /health, which "
+                        "a partition can leave green. 0 = off")
+    p.add_argument("--router-hedge-deadline-s", type=float, default=0.0,
+                   help="interactive hedging: an interactive frame with no "
+                        "result after this many seconds is re-sent once to "
+                        "the next-preferred replica (same frame id — the "
+                        "loser's result is deduped at fan-in). 0 = off")
+    p.add_argument("--router-dedup-window", type=int, default=4096,
+                   help="idempotent fan-in: remember this many recent "
+                        "frame ids at the router's result intake so a "
+                        "duplicated or hedged result publishes upstream "
+                        "exactly once (replica intake keeps its own "
+                        "window). 0 = off")
     p.add_argument("--slo-loop-stale-s", type=float, default=30.0,
                    help="loop-liveness objective bound: seconds without a "
                         "serving-loop iteration before the gauge reads "
@@ -588,7 +606,24 @@ def run_router(args) -> int:
             health_fn=(http_health_probe(healths[i]) if healths[i] else None),
             budget_fps=args.router_budget_fps or None,
             writer=i == args.router_writer))
-    router = TopicRouter(replicas, metrics=metrics, tracer=tracer)
+    router = TopicRouter(
+        replicas, metrics=metrics, tracer=tracer,
+        link_deadline_s=args.router_link_deadline_s or None,
+        hedge_deadline_s=args.router_hedge_deadline_s or None,
+        dedup_window=args.router_dedup_window)
+    slo_monitor = None
+    if args.slo and args.router_link_deadline_s:
+        from opencv_facerecognizer_tpu.runtime.slo import (
+            SLOMonitor, link_health_objective,
+        )
+
+        # The router's /health speaks for the FABRIC, not a model: the
+        # only objective that makes sense here is the supervised-link
+        # fraction (one dark replica = failover's job, a majority dark
+        # = a network event the fleet cannot route around).
+        slo_monitor = SLOMonitor(
+            metrics, [link_health_objective(router.down_link_fraction)],
+            tracer=tracer)
     if args.source == "socket":
         upstream = SocketConnector(host=args.host, port=args.port,
                                    listen=True, metrics=metrics)
@@ -605,7 +640,7 @@ def run_router(args) -> int:
         from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
 
         expo = ExpoServer(metrics=metrics, tracer=tracer, router=router,
-                          port=args.expo_port)
+                          slo=slo_monitor, port=args.expo_port)
         expo.start()
         print(f"router expo endpoint: http://{expo.host}:{expo.port}/",
               file=sys.stderr)

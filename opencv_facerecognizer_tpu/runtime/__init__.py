@@ -57,6 +57,7 @@ from opencv_facerecognizer_tpu.runtime.slo import (
     SLOMonitor,
     default_objectives,
     disk_free_objective,
+    link_health_objective,
     loop_liveness_objective,
     replication_lag_objective,
     rollout_parity_objective,
@@ -110,6 +111,7 @@ __all__ = [
     "resolve_ingest_mode",
     "default_objectives",
     "disk_free_objective",
+    "link_health_objective",
     "loop_liveness_objective",
     "replication_lag_objective",
     "rollout_parity_objective",

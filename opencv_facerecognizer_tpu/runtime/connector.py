@@ -30,6 +30,7 @@ import base64
 import io
 import json
 import os
+import random
 import select
 import socket
 import threading
@@ -305,11 +306,30 @@ class SocketConnector(_TopicDispatchConnector):
                  listen: bool = False, metrics=None,
                  reconnect_attempts: int = 8,
                  reconnect_backoff_base_s: float = 0.05,
-                 reconnect_backoff_max_s: float = 2.0):
+                 reconnect_backoff_max_s: float = 2.0,
+                 reconnect_jitter: float = 0.5,
+                 fault_injector=None, peer_name: Optional[str] = None):
         super().__init__(metrics=metrics)
         self.host = host
         self.port = port
         self.listen = listen
+        # Transport fault boundary (ISSUE 16): when an injector is
+        # installed, every published message crosses
+        # ``on_transport(peer, "send", ...)`` before hitting the wire and
+        # every received message crosses ``(peer, "recv", ...)`` before
+        # dispatch — partition/half-open/slow/drop/duplicate/reorder all
+        # land on the exact send/recv paths production traffic uses.
+        # ``peer_name`` labels the remote end for per-peer injection;
+        # defaults to "host:port".
+        self._faults = fault_injector
+        self._peer_name = peer_name
+        # Reconnect backoff jitter: a deterministic exponential schedule
+        # synchronizes a thundering herd (every peer of a restarted
+        # replica redials on the same beat). Each delay is multiplied by
+        # a uniform draw from [1 - jitter, 1 + jitter]; 0 restores the
+        # deterministic schedule for tests that pin timing.
+        self.reconnect_jitter = min(1.0, max(0.0, float(reconnect_jitter)))
+        self._backoff_rng = random.Random()
         # Client-mode reconnect (bounded exponential backoff): a server
         # blip used to permanently kill the client connector — the read
         # loop ended, ``eof`` fired, and nothing ever dialed again. Now a
@@ -444,6 +464,9 @@ class SocketConnector(_TopicDispatchConnector):
         for attempt in range(self.reconnect_attempts):
             delay = min(self.reconnect_backoff_max_s,
                         self.reconnect_backoff_base_s * 2 ** attempt)
+            if self.reconnect_jitter > 0:
+                delay *= self._backoff_rng.uniform(
+                    1.0 - self.reconnect_jitter, 1.0 + self.reconnect_jitter)
             deadline = time.monotonic() + delay
             while self._running and time.monotonic() < deadline:
                 time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
@@ -497,8 +520,39 @@ class SocketConnector(_TopicDispatchConnector):
                 return False
         return True
 
+    def _transport_peer(self) -> str:
+        return self._peer_name or f"{self.host}:{self.port}"
+
+    def _transport_sink(self, kind: str) -> None:
+        self._count(mn.TRANSPORT_FAULTS_PREFIX + kind)
+
+    def _dispatch(self, topic: str, data: Dict[str, Any]) -> None:
+        # Receive side of the transport fault boundary: a parsed wire
+        # message crosses the injector before any handler sees it, so an
+        # injected recv-drop/duplicate/reorder is indistinguishable from
+        # the network doing it.
+        if self._faults is None:
+            super()._dispatch(topic, data)
+            return
+        for msg in self._faults.on_transport(self._transport_peer(), "recv",
+                                             data, sink=self._transport_sink):
+            super()._dispatch(topic, msg)
+
     def publish(self, topic: str, message: Dict[str, Any]) -> None:
-        payload = (json.dumps({"topic": topic, "data": message}) + "\n").encode()
+        messages = [message]
+        if self._faults is not None:
+            # Send side of the transport boundary: a dropped/partitioned
+            # message never reaches the wire; a duplicated one is framed
+            # twice in the same payload (back-to-back lines, exactly what
+            # a retransmit-happy link delivers).
+            messages = self._faults.on_transport(
+                self._transport_peer(), "send", message,
+                sink=self._transport_sink)
+            if not messages:
+                return
+        payload = "".join(
+            json.dumps({"topic": topic, "data": m}) + "\n"
+            for m in messages).encode()
         with self._lock:
             socks = [(s, self._send_locks[s]) for s in self._client_socks]
         dead = []

@@ -356,7 +356,10 @@ def build_replica_fleet(n_replicas: int, frame_shape=(32, 32),
                         batch_size: int = 8, dispatch_s: float = 0.04,
                         health_interval_s: float = 0.1,
                         budget_fps=None, router_metrics=None,
-                        tracer=None):
+                        tracer=None, replica_fault_injectors=None,
+                        router_fault_injector=None,
+                        link_deadline_s=None, hedge_deadline_s=None,
+                        dedup_window: int = 4096):
     """N in-process serving replicas behind one ``TopicRouter`` — the
     deterministic scale-out harness: each replica is the canonical
     overload stack (``build_overload_stack``: a hard ``batch_size /
@@ -366,7 +369,14 @@ def build_replica_fleet(n_replicas: int, frame_shape=(32, 32),
     ``bench_serving.run_replica_scaleout`` and the replication chaos
     scenario, so the bench ladder and the soak's failover assertions
     exercise one configuration. Returns ``(router, stacks)`` where each
-    stack is ``(pipeline, service, connector, metrics)``."""
+    stack is ``(pipeline, service, connector, metrics)``.
+
+    Partition-chaos knobs (ISSUE 16): ``replica_fault_injectors`` (list
+    or per-index dict) arms each replica's OWN fault boundary;
+    ``router_fault_injector`` arms the router's transport crossings;
+    ``link_deadline_s``/``hedge_deadline_s``/``dedup_window`` pass
+    straight through to ``TopicRouter`` — all default off/inert so the
+    scale-out bench keeps its exact pre-16 configuration."""
     from opencv_facerecognizer_tpu.runtime.replication import (
         ReplicaHandle, TopicRouter, service_health_probe,
     )
@@ -376,16 +386,27 @@ def build_replica_fleet(n_replicas: int, frame_shape=(32, 32),
     handles = []
     for i in range(n_replicas):
         metrics = Metrics()
+        if isinstance(replica_fault_injectors, dict):
+            faults = replica_fault_injectors.get(i)
+        elif replica_fault_injectors is not None:
+            faults = replica_fault_injectors[i]
+        else:
+            faults = None
         pipeline, service, connector = build_overload_stack(
             frame_shape=frame_shape, batch_size=batch_size,
-            dispatch_s=dispatch_s, metrics=metrics)
+            dispatch_s=dispatch_s, metrics=metrics,
+            fault_injector=faults)
         stacks.append((pipeline, service, connector, metrics))
         handles.append(ReplicaHandle(
             f"replica-{i}", connector,
             health_fn=service_health_probe(service),
             budget_fps=budget_fps))
     router = TopicRouter(handles, metrics=router_metrics, tracer=tracer,
-                         health_interval_s=health_interval_s)
+                         health_interval_s=health_interval_s,
+                         fault_injector=router_fault_injector,
+                         link_deadline_s=link_deadline_s,
+                         hedge_deadline_s=hedge_deadline_s,
+                         dedup_window=dedup_window)
     return router, stacks
 
 

@@ -96,12 +96,42 @@ BOUNDARIES: Dict[str, tuple] = {
     # kinds and read crossings only "read_error", so one scripted queue
     # can interleave both without a read consuming a write fault.
     "storage": ("enospc", "eio", "slow_fsync", "read_error"),
+    # Transport boundary (ISSUE 16) — the network the PR 10/11 fleet lives
+    # on.  Two families share the boundary:
+    # STATEFUL link conditions, toggled per-(peer, direction) via
+    # ``set_partition`` / ``set_half_open`` / ``set_slow_link`` and
+    # cleared by the ``heal_*`` siblings — they apply to EVERY crossing
+    # of that link while set:
+    #   "partition" = the link is cut; messages vanish (the caller sees
+    #     the same nothing a real partition delivers);
+    #   "half_open" = the peer's TCP stack still ACKs but the application
+    #     never sees the bytes — indistinguishable from partition at the
+    #     message level, detectable only by heartbeat deadline;
+    #   "slow"      = every crossing sleeps latency + uniform jitter (a
+    #     congested or long-haul link — blocking senders feel it).
+    # PER-CROSSING faults, scripted/rate-drawn like every other boundary:
+    #   "drop" = this one message vanishes; "duplicate" = delivered
+    #   twice; "reorder" = held back and delivered AFTER the next
+    #   message that crosses the same link (out-of-order delivery the
+    #   idempotent-routing layer must absorb).
+    "transport": ("partition", "half_open", "slow",
+                  "drop", "duplicate", "reorder"),
 }
 
 #: storage-boundary fault kinds applicable per crossing direction (the
 #: filtered draw ``on_storage``/``on_storage_read`` use).
 STORAGE_WRITE_KINDS = ("enospc", "eio", "slow_fsync")
 STORAGE_READ_KINDS = ("read_error",)
+
+#: transport-boundary kinds eligible for the per-crossing scripted/rate
+#: draw (the stateful link conditions are toggled, never drawn — a
+#: scripted "partition" would be a one-message blackhole masquerading as
+#: a link cut, so ``script`` refuses the stateful kinds for transport).
+TRANSPORT_DRAW_KINDS = ("drop", "duplicate", "reorder")
+
+#: valid directions of a transport crossing, from the injecting side's
+#: point of view: "send" = toward the peer, "recv" = from the peer.
+TRANSPORT_DIRECTIONS = ("send", "recv")
 
 
 class InjectedCrashError(RuntimeError):
@@ -216,13 +246,26 @@ class FaultInjector:
         self.flood_factor = max(2, int(flood_factor))
         self.rates = rates or {}
         for boundary, fault_rates in self.rates.items():
-            unknown = set(fault_rates) - set(BOUNDARIES.get(boundary, ()))
+            valid = (TRANSPORT_DRAW_KINDS if boundary == "transport"
+                     else BOUNDARIES.get(boundary, ()))
+            unknown = set(fault_rates) - set(valid)
             if boundary not in BOUNDARIES or unknown:
                 raise ValueError(f"unknown fault(s) for {boundary!r}: "
                                  f"{sorted(unknown) or boundary}")
         self._scripted: Dict[str, deque] = {b: deque() for b in BOUNDARIES}
         self.injected: Counter = Counter()
         self.enabled = True
+        # ---- transport link state (ISSUE 16) ----
+        # Keys are (peer, direction) with direction in
+        # TRANSPORT_DIRECTIONS; ``set_*(peer, direction="both")`` expands
+        # to both keys.  ``_slow_links`` maps the key to
+        # (latency_s, jitter_s); ``_holdback`` parks a reordered message
+        # until the next crossing of the same link flushes it behind the
+        # newer delivery.
+        self._partitioned: set = set()
+        self._half_open: set = set()
+        self._slow_links: Dict[tuple, tuple] = {}
+        self._holdback: Dict[tuple, list] = {}
 
     def script(self, boundary: str, *faults: str) -> None:
         """Queue deterministic faults at ``boundary``, consumed in order —
@@ -230,6 +273,8 @@ class FaultInjector:
         kinds = BOUNDARIES.get(boundary)
         if kinds is None:
             raise ValueError(f"unknown boundary {boundary!r}")
+        if boundary == "transport":
+            kinds = TRANSPORT_DRAW_KINDS  # stateful kinds are toggled
         for fault in faults:
             if fault not in kinds:
                 raise ValueError(f"boundary {boundary!r} has no fault "
@@ -402,6 +447,119 @@ class FaultInjector:
         if self._draw_filtered("storage", STORAGE_READ_KINDS) is not None:
             raise OSError(errno.EIO,
                           f"injected storage fault (read_error) at {op}")
+
+    # ---- transport boundary (ISSUE 16) ----
+
+    @staticmethod
+    def _link_keys(peer: str, direction: str) -> List[tuple]:
+        if direction == "both":
+            return [(peer, d) for d in TRANSPORT_DIRECTIONS]
+        if direction not in TRANSPORT_DIRECTIONS:
+            raise ValueError(f"unknown transport direction {direction!r} "
+                             f"(valid: {TRANSPORT_DIRECTIONS + ('both',)})")
+        return [(peer, direction)]
+
+    def set_partition(self, peer: str, direction: str = "both") -> None:
+        """Cut the link to ``peer``: every crossing in ``direction``
+        vanishes until ``heal_partition``."""
+        self._partitioned.update(self._link_keys(peer, direction))
+
+    def heal_partition(self, peer: str, direction: str = "both") -> None:
+        self._partitioned.difference_update(self._link_keys(peer, direction))
+
+    def set_half_open(self, peer: str, direction: str = "send") -> None:
+        """Half-open link: crossings in ``direction`` are silently
+        blackholed — no error, no EOF, exactly the shape a dead peer
+        behind a still-ACKing TCP stack presents.  Only a heartbeat
+        deadline can detect it."""
+        self._half_open.update(self._link_keys(peer, direction))
+
+    def heal_half_open(self, peer: str, direction: str = "both") -> None:
+        self._half_open.difference_update(self._link_keys(peer, direction))
+
+    def set_slow_link(self, peer: str, latency_s: float,
+                      jitter_s: float = 0.0,
+                      direction: str = "both") -> None:
+        """Every crossing of the link sleeps ``latency_s`` plus a uniform
+        draw from ``[0, jitter_s]`` before delivering."""
+        for key in self._link_keys(peer, direction):
+            self._slow_links[key] = (float(latency_s), float(jitter_s))
+
+    def heal_slow_link(self, peer: str, direction: str = "both") -> None:
+        for key in self._link_keys(peer, direction):
+            self._slow_links.pop(key, None)
+
+    def heal_all_links(self) -> None:
+        """Clear every stateful link condition (scripted/rate transport
+        faults are untouched — use ``disarm`` for a full passthrough).
+        Held-back reordered messages stay parked until traffic flushes
+        them; a drained link's remnant is dropped by ``flush_holdback``."""
+        self._partitioned.clear()
+        self._half_open.clear()
+        self._slow_links.clear()
+
+    def flush_holdback(self, peer: str,
+                       direction: str = "both") -> List[Dict[str, Any]]:
+        """Return (and forget) any reorder-held messages for the link —
+        callers that tear a link down use this so an accounting test can
+        settle exactly."""
+        flushed: List[Dict[str, Any]] = []
+        for key in self._link_keys(peer, direction):
+            flushed.extend(self._holdback.pop(key, ()))
+        return flushed
+
+    def on_transport(self, peer: str, direction: str,
+                     message: Dict[str, Any],
+                     sink=None) -> List[Dict[str, Any]]:
+        """Transport boundary: one send/recv crossing of the link to
+        ``peer``.  Returns the messages to actually deliver, in order —
+        ``[]`` (partitioned / half-open / dropped / held for reorder),
+        ``[m, m]`` (duplicated), or the newer message followed by a
+        previously held one (the reorder materializing).  ``sink``, when
+        given, is called with each fault kind enacted — the caller's
+        bridge to its own ``transport_fault_<kind>`` counters."""
+        if not self.enabled:
+            return [message]
+        key = (peer, direction)
+
+        def fire(kind: str) -> None:
+            self.injected[f"transport:{kind}"] += 1
+            if sink is not None:
+                sink(kind)
+
+        # Stateful link conditions first: a cut or half-open link eats
+        # the message before any per-crossing draw (and leaves holdback
+        # parked — nothing crosses a dead link, not even stragglers).
+        if key in self._partitioned:
+            fire("partition")
+            return []
+        if key in self._half_open:
+            fire("half_open")
+            return []
+        slow = self._slow_links.get(key)
+        if slow is not None:
+            latency_s, jitter_s = slow
+            delay = latency_s + (self._rng.random() * jitter_s
+                                 if jitter_s > 0 else 0.0)
+            if delay > 0:
+                time.sleep(delay)
+            fire("slow")
+        fault = self._draw_filtered("transport", TRANSPORT_DRAW_KINDS)
+        if fault is not None and sink is not None:
+            sink(fault)  # _draw_filtered already counted into .injected
+        if fault == "drop":
+            return []
+        if fault == "reorder":
+            self._holdback.setdefault(key, []).append(message)
+            return []
+        held = self._holdback.pop(key, None)
+        if fault == "duplicate":
+            out = [message, message]
+        else:
+            out = [message]
+        if held:
+            out.extend(held)  # newer-first: the held message lands late
+        return out
 
     def summary(self) -> Dict[str, int]:
         return dict(self.injected)

@@ -131,6 +131,12 @@ FRAME_TOPIC = "ocvfacerec/frames"
 RESULT_TOPIC = "ocvfacerec/results"
 CONTROL_TOPIC = "ocvfacerec/control"
 STATUS_TOPIC = "ocvfacerec/status"
+#: link-supervision heartbeats (ISSUE 16): the router pings each replica
+#: on ``ping``; the service echoes the payload back on ``pong``.  An
+#: application-level round trip proves the whole path — connector, wire,
+#: dispatch thread — where TCP liveness proves only the kernel's half.
+LINK_PING_TOPIC = "ocvfacerec/link/ping"
+LINK_PONG_TOPIC = "ocvfacerec/link/pong"
 
 #: Fallback-path readback poll: with ``readback_worker=False`` the inline
 #: drain waits for an over-depth/forced head batch by sleeping this long
@@ -325,6 +331,14 @@ class RecognizerService:
         # cheapest shed — reject borderline frames at stage 1 before the
         # intake skip drops admitted frames outright). 0 disables.
         cascade_brownout_notch: float = CASCADE_BROWNOUT_NOTCH,
+        # ---- idempotent intake (ISSUE 16) ----
+        # Frame-id dedup window: a delivery whose ``meta["_fid"]`` was
+        # already ADMITTED is refused before admission (counted
+        # ``frames_deduped``, outside the ledger like rejections), so
+        # duplicated transports, retries and hedge re-sends can never
+        # double-count the ledger or double-publish a result from this
+        # replica. 0 disables; frames without a fid always pass.
+        dedup_window: int = 4096,
     ):
         self.pipeline = pipeline
         self.connector = connector
@@ -495,8 +509,21 @@ class RecognizerService:
         # dead accelerator after a CPU fallback.
         self._embed_device = None
 
+        # Idempotent-intake window (ISSUE 16): fids of ADMITTED frames,
+        # set for O(1) membership + deque for FIFO eviction. Sized so a
+        # legitimately re-sent frame (hedge, retry after a partition
+        # heals) is still remembered long after its twin completed —
+        # the window bounds memory, not correctness, because a fid that
+        # was evicted AND re-delivered that late would need > window
+        # admissions in between.
+        self._dedup_window = max(0, int(dedup_window))
+        self._dedup_seen: set = set()
+        self._dedup_order: deque = deque()
+        self._dedup_lock = threading.Lock()
+
         connector.subscribe(FRAME_TOPIC, self._on_frame)
         connector.subscribe(CONTROL_TOPIC, self._on_control)
+        connector.subscribe(LINK_PING_TOPIC, self._on_link_ping)
 
     def _build_bucket_ladder(self, bucket_sizes, batch_size: int) -> List[int]:
         """Ascending dispatch sizes, always ending at ``batch_size``. Only
@@ -904,6 +931,23 @@ class RecognizerService:
                 # (monotonic) for wire transports that record parse time;
                 # absent it, the receive span starts at handler entry.
                 t_recv = msg.get("_recv_ts") or time.monotonic()
+            # Idempotent intake (ISSUE 16): a fid this replica already
+            # ADMITTED is refused before admission — like rejections,
+            # dedup sits OUTSIDE the ledger, so a duplicated transport
+            # or hedge re-send can never double-count it. Checked before
+            # admit, recorded only AFTER admit succeeds: a frame whose
+            # first delivery was rejected stays re-admittable on retry.
+            meta = msg.get("meta")  # caller passthrough — ANY type
+            fid = (meta.get("_fid")
+                   if self._dedup_window and isinstance(meta, dict)
+                   else None)
+            if fid is not None and self._dedup_hit(fid):
+                self.metrics.incr(mn.FRAMES_DEDUPED)
+                if tid:
+                    tracer.emit(tid, "receive", topic=topic, t0=t_recv,
+                                dur=time.monotonic() - t_recv,
+                                verdict="deduped", priority=priority)
+                continue
             # Admission FIRST, decode second: a rejected frame must cost
             # ~nothing (the whole point of shedding at the front door).
             if self.admission is not None:
@@ -920,6 +964,8 @@ class RecognizerService:
                     continue
             # Admitted: from here on the frame is the ledger's problem —
             # it must end as completed or as exactly one counted drop.
+            if fid is not None:
+                self._dedup_record(fid)
             self.metrics.incr(mn.FRAMES_ADMITTED)
             if tid:
                 tracer.emit(tid, "receive", topic=topic, t0=t_recv,
@@ -951,6 +997,34 @@ class RecognizerService:
                 self._trace_settle([tid], mn.FRAMES_MALFORMED, "decode")
                 continue
             self._intake_frame(frame, msg.get("meta"), priority, tid)
+
+    def _dedup_hit(self, fid) -> bool:
+        """True iff ``fid`` was already admitted within the window."""
+        with self._dedup_lock:
+            return fid in self._dedup_seen
+
+    def _dedup_record(self, fid) -> None:
+        """Remember an admitted fid; FIFO-evict past the window bound."""
+        with self._dedup_lock:
+            if fid in self._dedup_seen:
+                return
+            self._dedup_seen.add(fid)
+            self._dedup_order.append(fid)
+            while len(self._dedup_order) > self._dedup_window:
+                self._dedup_seen.discard(self._dedup_order.popleft())
+
+    def _on_link_ping(self, topic: str, message: Dict) -> None:
+        """Link-supervision echo (ISSUE 16): bounce the router's ping
+        payload back on the pong topic. Runs on the connector dispatch
+        thread — proving exactly the path frames travel — and stays
+        O(1): a replica too wedged to echo is, for routing purposes,
+        down, which is the honest answer."""
+        try:
+            pong = dict(message) if isinstance(message, dict) else {}
+            pong["replica"] = self.replica or pong.get("replica")
+            self.connector.publish(LINK_PONG_TOPIC, pong)
+        except Exception:  # ocvf-lint: disable=swallowed-exception -- a failed echo IS the signal: the router's pong deadline turns silence into a link-down verdict
+            pass
 
     def _intake_frame(self, frame, meta, priority: int, tid: int) -> None:
         """Post-decode intake shared by the connector handler and the

@@ -71,6 +71,7 @@ import os
 import socket
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -780,6 +781,17 @@ class ReplicaHandle:
         self.cordoned = False
         self.routed = 0
         self.last_probe_error: Optional[str] = None
+        #: consecutive health-probe exceptions (capped; reset on the
+        #: first clean probe) — the warn log fires only on the 0 -> 1
+        #: transition, so a permanently-raising probe is one line, not
+        #: one per cycle.
+        self.probe_streak = 0
+        #: link supervision (ISSUE 16): up = a heartbeat pong was seen
+        #: within the router's ``link_deadline_s``. A down link excludes
+        #: the replica from rendezvous exactly like bad health — the
+        #: half-open-TCP case where the probe may still say "healthy".
+        self.link_up = True
+        self.last_pong_t: Optional[float] = None
 
 
 class TopicRouter(MiddlewareConnector):
@@ -800,17 +812,47 @@ class TopicRouter(MiddlewareConnector):
     """
 
     def __init__(self, replicas: List[ReplicaHandle], metrics=None,
-                 tracer=None, health_interval_s: float = 1.0):
+                 tracer=None, health_interval_s: float = 1.0,
+                 fault_injector=None,
+                 link_deadline_s: Optional[float] = None,
+                 hedge_deadline_s: Optional[float] = None,
+                 dedup_window: int = 4096):
         from opencv_facerecognizer_tpu.runtime.recognizer import (
-            CONTROL_TOPIC, FRAME_TOPIC, RESULT_TOPIC, STATUS_TOPIC,
+            CONTROL_TOPIC, FRAME_TOPIC, LINK_PING_TOPIC, LINK_PONG_TOPIC,
+            RESULT_TOPIC, STATUS_TOPIC,
         )
 
         self.metrics = metrics
         self.tracer = tracer
         self.health_interval_s = float(health_interval_s)
+        #: transport fault boundary (ISSUE 16): when installed, every
+        #: forward/heartbeat (send) and every fan-in/pong (recv) crosses
+        #: ``on_transport(<replica name>, direction, ...)`` — the chaos
+        #: layer cuts, slows, drops, duplicates and reorders the exact
+        #: paths production messages travel.
+        self._faults = fault_injector
+        #: link supervision: None disables. When set, the health loop
+        #: pings each replica every cycle and a replica whose last pong
+        #: is older than the deadline is excluded from rendezvous until
+        #: it pongs again — bounded-time detection of half-open links.
+        self.link_deadline_s = (None if link_deadline_s is None
+                                else float(link_deadline_s))
+        #: interactive hedging: None disables. When set, an interactive
+        #: frame with no result after the deadline is re-sent to its
+        #: next rendezvous-preferred replica; first result wins, the
+        #: loser is deduped at fan-in.
+        self.hedge_deadline_s = (None if hedge_deadline_s is None
+                                 else float(hedge_deadline_s))
+        #: idempotent routing: size of the frame-id windows (stamped
+        #: ``meta["_fid"]``, fan-in seen-set, hedge in-flight map).
+        #: 0 disables stamping and result dedup entirely.
+        self.dedup_window = max(0, int(dedup_window))
         self.frame_topic = FRAME_TOPIC
         self.control_topic = CONTROL_TOPIC
         self.status_topic = STATUS_TOPIC
+        self.result_topic = RESULT_TOPIC
+        self.link_ping_topic = LINK_PING_TOPIC
+        self.link_pong_topic = LINK_PONG_TOPIC
         self._result_topics = (RESULT_TOPIC, STATUS_TOPIC)
         self._lock = threading.Lock()
         self._replicas: List[ReplicaHandle] = list(replicas)
@@ -822,6 +864,15 @@ class TopicRouter(MiddlewareConnector):
         self._order_cache: Dict[str, List[ReplicaHandle]] = {}
         self._health_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Hedge/dedup state, all under one lock separate from the
+        # routing lock (fan-in runs on replica dispatch threads):
+        # _inflight tracks un-answered interactive fids; _seen_results
+        # is the first-result-wins window keyed by fid.
+        self._hedge_lock = threading.Lock()
+        self._fid_counter = 0
+        self._inflight: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._seen_results: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._ping_counter = 0
         for handle in self._replicas:
             self._wire_replica(handle)
         self._set_replica_gauges()
@@ -832,20 +883,59 @@ class TopicRouter(MiddlewareConnector):
         for topic in self._result_topics:
             handle.connector.subscribe(
                 topic, self._make_fan_in(topic, handle.name))
+        handle.connector.subscribe(self.link_pong_topic,
+                                   self._make_pong(handle.name))
+
+    def _transport_sink(self, kind: str) -> None:
+        if self.metrics is not None:
+            self.metrics.incr(mn.TRANSPORT_FAULTS_PREFIX + kind)
+
+    def _cross(self, name: str, direction: str,
+               message: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """One transport-boundary crossing of the link to replica
+        ``name`` — the identity list when no injector is installed."""
+        if self._faults is None:
+            return [message]
+        return self._faults.on_transport(name, direction, message,
+                                         sink=self._transport_sink)
 
     def _make_fan_in(self, topic: str, name: str):
         # Status messages are stamped with the originating replica (an
         # orchestrator needs to know WHICH replica went degraded); result
         # messages pass through untouched — keyed on the subscription
-        # topic, never sniffed from the payload.
+        # topic, never sniffed from the payload.  Both cross the
+        # transport boundary (recv direction), and results additionally
+        # pass the first-result-wins fid window — a duplicated delivery,
+        # a failover re-send, or a hedge loser can never double-publish
+        # upstream.
         stamp = topic == self.status_topic
+        dedup = topic == self.result_topic
 
-        def fan_in(_topic, message, _name=name, _up=topic, _stamp=stamp):
-            if _stamp and isinstance(message, dict):
-                message = {**message, "replica": _name}
-            self._dispatch_up(_up, message)
+        def fan_in(_topic, message, _name=name, _up=topic, _stamp=stamp,
+                   _dedup=dedup):
+            for msg in self._cross(_name, "recv", message):
+                if _stamp and isinstance(msg, dict):
+                    msg = {**msg, "replica": _name}
+                if _dedup and not self._admit_result(_name, msg):
+                    continue
+                self._dispatch_up(_up, msg)
 
         return fan_in
+
+    def _make_pong(self, name: str):
+        def on_pong(_topic, message, _name=name):
+            if not self._cross(_name, "recv", message):
+                return  # the pong died on the (injected) wire
+            with self._lock:
+                handle = next((r for r in self._replicas
+                               if r.name == _name), None)
+            if handle is None:
+                return
+            handle.last_pong_t = time.monotonic()
+            if self.metrics is not None:
+                self.metrics.incr(mn.LINK_HEARTBEATS_RECEIVED)
+
+        return on_pong
 
     def replace_connector(self, name: str,
                           connector: MiddlewareConnector) -> None:
@@ -937,6 +1027,8 @@ class TopicRouter(MiddlewareConnector):
                 "budget_fps": handle.budget_fps,
                 "topics": sorted(by_name.get(handle.name, ())),
                 "probe_error": handle.last_probe_error,
+                "probe_streak": handle.probe_streak,
+                "link_up": handle.link_up,
             })
         return out
 
@@ -944,10 +1036,17 @@ class TopicRouter(MiddlewareConnector):
         if self.metrics is None:
             return
         with self._lock:
-            total = len(self._replicas)
-            healthy = sum(1 for r in self._replicas if r.healthy)
+            handles = list(self._replicas)
+            total = len(handles)
+            healthy = sum(1 for r in handles if r.healthy)
         self.metrics.set_gauge(mn.ROUTER_REPLICAS, total)
         self.metrics.set_gauge(mn.ROUTER_HEALTHY_REPLICAS, healthy)
+        if self.link_deadline_s is not None:
+            down = sum(1 for r in handles if not r.link_up)
+            self.metrics.set_gauge(mn.LINKS_DOWN, down)
+            for handle in handles:
+                self.metrics.set_gauge(mn.LINK_STATE_PREFIX + handle.name,
+                                       1 if handle.link_up else 0)
 
     # ---- rendezvous routing ----
 
@@ -982,7 +1081,7 @@ class TopicRouter(MiddlewareConnector):
         Returns None (counted) when nothing can take it."""
         spilled = False
         for handle in self._preference_order(topic):
-            if not handle.healthy or handle.cordoned:
+            if not handle.healthy or handle.cordoned or not handle.link_up:
                 continue
             if handle.budget is not None and not handle.budget.try_acquire():
                 spilled = True
@@ -1002,6 +1101,7 @@ class TopicRouter(MiddlewareConnector):
         handle = self.route(topic)
         if handle is None:
             return
+        message = self._stamp_fid(message)
         handle.routed += 1
         now = time.monotonic()
         with self._lock:
@@ -1014,12 +1114,132 @@ class TopicRouter(MiddlewareConnector):
         forwarded = message
         if topic != self.frame_topic:
             forwarded = {**message, "_route_topic": topic}
-        handle.connector.publish(self.frame_topic, forwarded)
+        self._track_inflight(topic, forwarded, handle, now)
+        self._forward(handle, forwarded)
         if self.metrics is not None:
             self.metrics.incr(mn.ROUTER_ROUTED)
 
     #: test/bench ergonomics, same as FakeConnector.
     inject = publish
+
+    def _forward(self, handle: ReplicaHandle,
+                 forwarded: Dict[str, Any]) -> None:
+        for msg in self._cross(handle.name, "send", forwarded):
+            handle.connector.publish(self.frame_topic, msg)
+
+    # ---- idempotent routing: fid stamping + first-result-wins ----
+
+    def _stamp_fid(self, message: Dict[str, Any]) -> Dict[str, Any]:
+        """Stamp a router-unique frame id into ``meta["_fid"]``.  The
+        service round-trips ``meta`` into its results untouched, so the
+        same id identifies the frame at replica intake (dedup window)
+        and at result fan-in (first-result-wins) — retries, duplicated
+        deliveries and hedge re-sends all carry the ORIGINAL id."""
+        if self.dedup_window <= 0 or not isinstance(message, dict):
+            return message
+        meta = message.get("meta")
+        if meta is not None and not isinstance(meta, dict):
+            return message  # caller passthrough of a non-dict: hands off
+        meta = dict(meta) if meta else {}
+        if "_fid" in meta:
+            return message  # a re-send keeps its identity
+        with self._hedge_lock:
+            self._fid_counter += 1
+            meta["_fid"] = f"f{self._fid_counter}"
+        return {**message, "meta": meta}
+
+    def _track_inflight(self, topic: str, forwarded: Dict[str, Any],
+                        handle: ReplicaHandle, now: float) -> None:
+        """Record an interactive frame as hedge-eligible (no-op unless
+        hedging is on): ``check_hedges`` re-sends it to the next
+        preference if no result lands within the deadline."""
+        if self.hedge_deadline_s is None or not isinstance(forwarded, dict):
+            return
+        if forwarded.get("priority") != "interactive":
+            return
+        meta = forwarded.get("meta")
+        fid = meta.get("_fid") if isinstance(meta, dict) else None
+        if fid is None:
+            return
+        with self._hedge_lock:
+            self._inflight[fid] = {"topic": topic, "forwarded": forwarded,
+                                   "t0": now, "replicas": [handle.name],
+                                   "hedged": False}
+            while len(self._inflight) > self.dedup_window:
+                self._inflight.popitem(last=False)
+
+    def _admit_result(self, name: str, message: Any) -> bool:
+        """First-result-wins gate at fan-in: True admits the message
+        upstream, False swallows it (counted).  Messages without a fid
+        (dedup off, foreign producers) always pass."""
+        if self.dedup_window <= 0 or not isinstance(message, dict):
+            return True
+        meta = message.get("meta")
+        fid = meta.get("_fid") if isinstance(meta, dict) else None
+        if fid is None:
+            return True
+        wasted = deduped = win = False
+        with self._hedge_lock:
+            seen = self._seen_results.get(fid)
+            if seen is not None:
+                deduped = True
+                wasted = seen["hedged"]
+            else:
+                entry = self._inflight.pop(fid, None)
+                hedged = bool(entry and entry["hedged"])
+                self._seen_results[fid] = {"hedged": hedged,
+                                           "winner": name}
+                while len(self._seen_results) > self.dedup_window:
+                    self._seen_results.popitem(last=False)
+                win = hedged and bool(entry["replicas"]) \
+                    and name != entry["replicas"][0]
+        if self.metrics is not None:
+            if deduped:
+                self.metrics.incr(mn.ROUTER_RESULTS_DEDUPED)
+                if wasted:
+                    self.metrics.incr(mn.ROUTER_HEDGE_WASTED)
+            elif win:
+                self.metrics.incr(mn.ROUTER_HEDGE_WINS)
+        return not deduped
+
+    def check_hedges(self, now: Optional[float] = None) -> int:
+        """Re-send past-deadline interactive frames to their next
+        rendezvous-preferred replica (one hedge per frame).  Runs on the
+        health thread; tests call it directly.  Returns hedges fired."""
+        if self.hedge_deadline_s is None:
+            return 0
+        now = time.monotonic() if now is None else now
+        to_send: List[Tuple[ReplicaHandle, Dict[str, Any]]] = []
+        with self._hedge_lock:
+            stale_after = max(30.0 * self.hedge_deadline_s, 30.0)
+            for fid in list(self._inflight):
+                entry = self._inflight[fid]
+                age = now - entry["t0"]
+                if age > stale_after:
+                    del self._inflight[fid]  # both copies died; stop tracking
+                    continue
+                if entry["hedged"] or age < self.hedge_deadline_s:
+                    continue
+                target = self._hedge_target(entry)
+                entry["hedged"] = True  # one hedge per frame, ever
+                if target is not None:
+                    entry["replicas"].append(target.name)
+                    to_send.append((target, entry["forwarded"]))
+        for target, forwarded in to_send:
+            self._forward(target, forwarded)
+            if self.metrics is not None:
+                self.metrics.incr(mn.ROUTER_HEDGES)
+        return len(to_send)
+
+    def _hedge_target(self, entry: Dict[str, Any]) -> Optional[ReplicaHandle]:
+        tried = set(entry["replicas"])
+        for handle in self._preference_order(entry["topic"]):
+            if handle.name in tried:
+                continue
+            if not handle.healthy or handle.cordoned or not handle.link_up:
+                continue
+            return handle
+        return None
 
     def _publish_control(self, message: Dict[str, Any]) -> None:
         """Control traffic (enrollment) routes to the writer replica
@@ -1046,12 +1266,27 @@ class TopicRouter(MiddlewareConnector):
                 continue
             try:
                 state = int(handle.health_fn())
+                if handle.probe_streak:
+                    logger.info("router: health probe for %s recovered "
+                                "after %d consecutive error(s)",
+                                handle.name, handle.probe_streak)
+                handle.probe_streak = 0
                 handle.last_probe_error = None
             except Exception as exc:  # noqa: BLE001 — a dead probe fails the replica closed
-                logger.warning("router: health probe for %s failed: %r",
-                               handle.name, exc)
+                # Log only the INTO-erroring transition: a permanently
+                # raising probe is one warn line per streak, never one
+                # per cycle; the streak itself is capped and surfaced in
+                # the registry so forensics still see "it has been
+                # failing for a while".
+                if handle.probe_streak == 0:
+                    logger.warning("router: health probe for %s failed "
+                                   "(suppressing repeats): %r",
+                                   handle.name, exc)
+                handle.probe_streak = min(handle.probe_streak + 1,
+                                          self.PROBE_STREAK_CAP)
                 if self.metrics is not None:
                     self.metrics.incr(mn.ROUTER_HEALTH_PROBE_FAILURES)
+                    self.metrics.incr(mn.ROUTER_PROBE_ERRORS)
                 handle.last_probe_error = repr(exc)
                 state = STATE_CRITICAL
             handle.health_state = state
@@ -1059,6 +1294,72 @@ class TopicRouter(MiddlewareConnector):
             if healthy != handle.healthy:
                 self._transition(handle, healthy)
         self._set_replica_gauges()
+
+    #: ceiling on the per-replica consecutive-probe-error streak (the
+    #: monotonic ``router_probe_errors`` counter is unbounded; the streak
+    #: is a diagnostic that must not grow without limit).
+    PROBE_STREAK_CAP = 1000
+
+    # ---- link supervision (application-level heartbeats) ----
+
+    def check_links(self, now: Optional[float] = None) -> None:
+        """One heartbeat cycle (no-op unless ``link_deadline_s`` is set):
+        ping every replica through the transport boundary, then fail any
+        link whose last pong is older than the deadline.  A half-open
+        peer — TCP alive, application deaf — is detected here in bounded
+        time, never by waiting on a socket.  Runs on the health thread;
+        tests call it directly with a pinned ``now``."""
+        if self.link_deadline_s is None:
+            return
+        now = time.monotonic() if now is None else now
+        for handle in self.replicas():
+            with self._lock:
+                self._ping_counter += 1
+                ping = {"ping": self._ping_counter,
+                        "replica": handle.name}
+            for msg in self._cross(handle.name, "send", ping):
+                handle.connector.publish(self.link_ping_topic, msg)
+            if self.metrics is not None:
+                self.metrics.incr(mn.LINK_HEARTBEATS_SENT)
+            if handle.last_pong_t is None:
+                # Grace: the deadline clock starts at the first ping —
+                # a replica is never failed for silence before it was
+                # ever asked.
+                handle.last_pong_t = now
+                continue
+            up = (now - handle.last_pong_t) <= self.link_deadline_s
+            if up != handle.link_up:
+                self._link_transition(handle, up)
+        self._set_replica_gauges()
+
+    def _link_transition(self, handle: ReplicaHandle, up: bool) -> None:
+        handle.link_up = up
+        if self.metrics is not None:
+            self.metrics.incr(mn.LINK_RECOVERIES if up
+                              else mn.LINK_FAILURES)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "link",
+                             topic=LIFECYCLE_TOPIC, replica=handle.name,
+                             link_up=up)
+            if not up:
+                # A dead link IS a failover: the rings hold what was
+                # routed when the link went dark.
+                self.tracer.dump("failover",
+                                 extra={"replica": handle.name,
+                                        "link": "down",
+                                        "registry": self.registry()})
+        logger.warning("router: link to replica %s %s", handle.name,
+                       "recovered (pong within deadline)" if up else
+                       "down (pong deadline passed) — rerouting its "
+                       "topics")
+
+    def down_link_fraction(self) -> float:
+        """Fraction of replica links currently down — the ``link_health``
+        SLO objective's gauge value (``runtime.slo.link_health_objective``)."""
+        handles = self.replicas()
+        if not handles:
+            return 0.0
+        return sum(1 for h in handles if not h.link_up) / len(handles)
 
     def _transition(self, handle: ReplicaHandle, healthy: bool) -> None:
         handle.healthy = healthy
@@ -1084,6 +1385,8 @@ class TopicRouter(MiddlewareConnector):
         while not self._stop.wait(timeout=self.health_interval_s):
             try:
                 self.check_health()
+                self.check_links()
+                self.check_hedges()
             except Exception:  # noqa: BLE001 — the health thread must live
                 logger.exception("router health sweep failed")
                 if self.metrics is not None:
@@ -1096,6 +1399,7 @@ class TopicRouter(MiddlewareConnector):
             return
         self._stop.clear()
         self.check_health()
+        self.check_links()
         self._health_thread = threading.Thread(target=self._health_loop,
                                                daemon=True,
                                                name="ocvf-router-health")

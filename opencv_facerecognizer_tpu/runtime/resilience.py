@@ -251,6 +251,7 @@ class DurabilityMonitor:
         self._degraded = False
         self._degraded_reason: Optional[str] = None
         self._consecutive_wal_failures = 0
+        self._consecutive_lease_failures = 0
         self._disk_state = DISK_OK
         self._retention_shrunk = False
         self._saved_retention: dict = {}
@@ -304,6 +305,7 @@ class DurabilityMonitor:
             "degraded": self._degraded,
             "reason": self._degraded_reason,
             "consecutive_wal_failures": self._consecutive_wal_failures,
+            "consecutive_lease_failures": self._consecutive_lease_failures,
             "disk_state": self._disk_state,
             "free_bytes": self._free_bytes,
             "low_watermark_bytes": self.low_watermark_bytes,
@@ -428,6 +430,41 @@ class DurabilityMonitor:
             self._rearm()
         return True
 
+    # ---- split-brain lease guard (ISSUE 16) ----
+
+    def _check_lease(self) -> None:
+        """Writer split-brain safety: a writer whose state dir (home of
+        ``writer.lease``) has become unreachable can no longer PROVE it
+        still owns enrollment — a healed partition may find a second
+        writer leased over the same volume. After ``degraded_after``
+        consecutive reachability failures the writer flips
+        durability-degraded, which fails enrollments closed (the same
+        machinery as WAL failures) while recognition serving continues.
+        Recovery rides the existing probe: a durable write+fsync in the
+        state dir is strictly stronger proof than this stat."""
+        state_dir = getattr(self.state, "state_dir", None)
+        if state_dir is None:
+            return
+        try:
+            if self._faults is not None:
+                self._faults.on_storage_read("lease_check")
+            os.stat(state_dir)
+        except OSError:
+            if self.metrics is not None:
+                self.metrics.incr(mn.DURABILITY_LEASE_CHECK_FAILURES)
+            with self._lock:
+                self._consecutive_lease_failures += 1
+                should_flip = (not self._degraded
+                               and self._consecutive_lease_failures
+                               >= self.degraded_after)
+            if should_flip:
+                self._flip_degraded(
+                    "lease_unreachable",
+                    consecutive=self._consecutive_lease_failures)
+            return
+        with self._lock:
+            self._consecutive_lease_failures = 0
+
     # ---- disk-pressure watermarks ----
 
     def _sample_disk(self) -> None:
@@ -545,6 +582,11 @@ class DurabilityMonitor:
             should_probe = probe and self._degraded
         finally:
             self._tick_lock.release()
+        if probe:
+            # Split-brain guard (ISSUE 16): like the recovery probe, real
+            # I/O against a possibly-dead volume — background thread only,
+            # outside the claim.
+            self._check_lease()
         if should_probe:
             # Outside the claim: the probe is file I/O (possibly a slow
             # fsync) and must never hold the tick lock against the

@@ -240,6 +240,41 @@ def disk_free_objective(free_bytes_fn: Callable[[], float],
                short_s=short_s, long_s=long_s)
 
 
+def link_health_objective(down_fraction_fn: Callable[[], float],
+                          max_down_fraction: float = 0.5,
+                          short_s: float = 30.0,
+                          long_s: float = 300.0) -> SLO:
+    """Gauge objective over the router's failed-link fraction (ISSUE 16):
+    burn = ``down_fraction / max_down_fraction`` — exactly 1.0 (warn)
+    once the allowed fraction of supervised links is down, 6.0
+    (critical) when the fleet is effectively partitioned away. One dead
+    replica out of four is failover's job and stays under the bound; a
+    majority dark is a NETWORK event no per-replica failover can route
+    around, and /health should say so before the queue does. Takes any
+    down-fraction callable — the stock wiring passes
+    ``TopicRouter.down_link_fraction``; this module deliberately does
+    not import replication (which imports the state store beside us).
+    Short windows by default: link verdicts already debounce behind the
+    pong deadline, so the objective's job is to REPORT fast.
+
+    The critical threshold is lowered from the stock 6x wherever 6x is
+    unreachable: a fraction tops out at 1.0, so against the default 0.5
+    bound a fully-dark fleet would burn 2.0 forever and the standard
+    6x critical could NEVER fire — critical lands at
+    ``min(6 x bound, every supervised link down)`` instead."""
+    bound = float(max_down_fraction)
+    if not bound > 0:
+        raise ValueError("link_health_objective needs a positive "
+                         "max_down_fraction")
+
+    def value() -> float:
+        return float(down_fraction_fn()) / bound
+
+    return SLO(name="link_health", kind="gauge", value_fn=value, bound=1.0,
+               short_s=short_s, long_s=long_s,
+               critical_burn=min(6.0, 1.0 / bound))
+
+
 def rollout_parity_objective(coordinator, min_agreement: float = 0.98,
                              short_s: float = 60.0,
                              long_s: float = 600.0) -> SLO:

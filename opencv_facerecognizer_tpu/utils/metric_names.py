@@ -137,6 +137,41 @@ CONNECTOR_RECONNECTS = "connector_reconnects"
 CONNECTOR_RECONNECT_FAILURES = "connector_reconnect_failures"
 CONNECTOR_STALLED_CLIENTS_DROPPED = "connector_stalled_clients_dropped"
 
+# ---- transport fault boundary (runtime.faults, ISSUE 16) -------------------
+#: per-kind family of transport faults a send/recv crossing actually
+#: enacted: ``transport_fault_<partition|slow|drop|duplicate|reorder|
+#: half_open>``.  Counted by the CALLER that crossed the boundary (router
+#: forward/fan-in, socket connector send/recv), so the metrics surface and
+#: the injector's own ``injected`` ledger can be cross-checked exactly.
+TRANSPORT_FAULTS_PREFIX = "transport_fault_"
+
+# ---- idempotent routing: frame-id dedup (ISSUE 16) -------------------------
+#: duplicate deliveries of an already-admitted frame id, refused at
+#: replica intake BEFORE admission — like ``frames_rejected_<reason>``
+#: these sit OUTSIDE the admission ledger by design, so
+#: ``admitted == completed + completed_empty + Σ drops`` holds exactly
+#: under duplication, retries, and failover re-sends.
+FRAMES_DEDUPED = "frames_deduped"
+#: duplicate results for one frame id swallowed at the router's fan-in
+#: (the second copy of a hedged or duplicated frame's result) — the
+#: guarantee that a result is never double-published upstream.
+ROUTER_RESULTS_DEDUPED = "router_results_deduped"
+
+# ---- link supervision (runtime.replication.TopicRouter, ISSUE 16) ----------
+#: application-level heartbeats: pings the router sent down each replica
+#: link, and pongs that made it back through the transport boundary.
+LINK_HEARTBEATS_SENT = "link_heartbeats_sent"
+LINK_HEARTBEATS_RECEIVED = "link_heartbeats_received"
+#: per-replica link gauge family ``link_state_<replica>``: 1 = pong seen
+#: within the deadline, 0 = link down (partitioned / half-open — the
+#: replica is excluded from rendezvous until the link heals).
+LINK_STATE_PREFIX = "link_state_"
+#: link up->down / down->up transitions, and the current count of down
+#: links (gauge — the ``link_health`` SLO objective's numerator).
+LINK_FAILURES = "link_failures"
+LINK_RECOVERIES = "link_recoveries"
+LINKS_DOWN = "links_down"
+
 # ---- dead-letter journal ---------------------------------------------------
 JOURNAL_ERRORS = "journal_errors"
 JOURNAL_RECORDS = "journal_records"
@@ -197,6 +232,11 @@ DURABILITY_PROBE_FAILURES = "durability_probe_failures"
 #: enroll commands / finished enrolments refused CLOSED while degraded
 #: (explicit ``durability_degraded`` status — the ack never lies).
 ENROLLMENTS_REFUSED_DEGRADED = "enrollments_refused_degraded"
+#: split-brain safety (ISSUE 16): the monitor's lease-directory
+#: reachability check failed — a writer partitioned from its own lease
+#: volume must flip durability-degraded rather than ack enrollments the
+#: fleet can't see.
+DURABILITY_LEASE_CHECK_FAILURES = "durability_lease_check_failures"
 
 # ---- disk-pressure watermarks (runtime.resilience, ISSUE 15) ---------------
 #: statvfs free bytes on the state volume (gauge, refreshed by the
@@ -333,8 +373,20 @@ ROUTER_RECOVERIES = "router_recoveries"
 #: the cutover re-anchor path; distinct from health failover.
 ROUTER_CUTOVER_DRAINS = "router_cutover_drains"
 ROUTER_HEALTH_PROBE_FAILURES = "router_health_probe_failures"
+#: consecutive-probe-exception accounting (ISSUE 16): every probe raise
+#: increments this, but the per-replica streak is capped and the warn log
+#: fires once per into-erroring transition — a permanently-raising probe
+#: is one log line, not one per cycle.
+ROUTER_PROBE_ERRORS = "router_probe_errors"
 ROUTER_REPLICAS = "router_replicas"
 ROUTER_HEALTHY_REPLICAS = "router_healthy_replicas"
+#: interactive-priority hedged dispatch (ISSUE 16): re-sends of an
+#: interactive frame to the next rendezvous-preferred replica after the
+#: hedge deadline; ``wins`` = the hedged copy's result arrived first,
+#: ``wasted`` = the original won and the hedge's result was deduped.
+ROUTER_HEDGES = "router_hedges"
+ROUTER_HEDGE_WINS = "router_hedge_wins"
+ROUTER_HEDGE_WASTED = "router_hedge_wasted"
 
 # ---- supervisor ------------------------------------------------------------
 SUPERVISOR_CHECKPOINTS = "supervisor_checkpoints"

@@ -142,6 +142,16 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("cascade") or {})
         .get("uplift", {}).get("d0", {}).get("uplift"),
         "ratio_min", 0.90, 0.0),
+    # Partition tolerance (ISSUE 16): partition onset to link-down
+    # detection in the chaos scenario. A candidate may not quietly slow
+    # the failover the baseline demonstrated (a longer deadline, a lazier
+    # health loop) — ratio + half-second absolute slack, since at a
+    # ~0.25 s detection floor a scheduler hiccup is a large ratio.
+    # Artifacts predating the partition section ride the
+    # baseline-predates-metric skip.
+    "partition_failover_s": (
+        lambda d: (d.get("partition") or {}).get("failover_s"),
+        "ratio_max", 1.50, 0.5),
 }
 
 

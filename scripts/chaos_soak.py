@@ -2369,6 +2369,354 @@ def run_disk(seconds: float = 6.0, seed: int | None = None,
     return report
 
 
+def run_partition(seconds: float = 8.0, seed: int | None = None,
+                  state_dir: str | None = None) -> dict:
+    """Partition scenario (ISSUE 16 acceptance): a 3-replica serving
+    fleet behind the topic router with link supervision, hedged
+    interactive dispatch and frame-id dedup armed, pounded through a
+    transport fault boundary — then the network, not any process, is
+    what fails.
+
+    Phases: (B) hard partition of the busiest replica (both directions)
+    → pong deadline fails the link, its topics reroute, the blackout's
+    interactive frames are rescued by hedging; heal → link recovers.
+    (C) flapping link (partition toggled faster than traffic can adapt)
+    — the fleet must simply survive it and converge link-up. (D)
+    duplicate storm (rate-drawn ``transport: duplicate`` on every
+    crossing) — intake dedup + fan-in dedup must keep delivery
+    exactly-once. (E) half-open writer: a ``StateLifecycle`` whose state
+    dir (home of ``writer.lease``) stops answering reads flips
+    durability-degraded (reason ``lease_unreachable``) instead of
+    acking enrollments, and re-arms when the volume heals.
+
+    Pass criteria (any miss -> ``ok: False``):
+
+    1. **failover is bounded** — link-down detection within
+       ``link_deadline + 4 health cycles`` of the partition (+0.5 s
+       scheduler floor), and survivor interactive p99 after detection
+       stays within 2x the unloaded baseline (+100 ms floor);
+    2. **hedging rescues the blackout** — at least one hedge fired and
+       won during the detection window;
+    3. **exactly-once delivery** — a raw result-delivery counter above
+       the router's fan-in sees EVERY completed seq exactly once (zero
+       duplicate publishes), while the dedup counters prove duplicates
+       actually arrived and were absorbed;
+    4. **ledgers settle exactly** — every replica ends
+       ``in_system == 0`` with ``admitted == completed +
+       completed_empty + Σ drops``;
+    5. **split-brain fails closed** — the half-open writer refuses
+       enrollment while degraded and recovers on heal;
+    6. **observability** — the link failure leaves a parseable
+       ``failover`` flight dump; link state is visible in the registry.
+    """
+    import random as random_mod
+    import threading
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.runtime import (
+        FaultInjector, StateLifecycle,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        TrafficRecorder, build_replica_fleet,
+    )
+    from opencv_facerecognizer_tpu.runtime.recognizer import RESULT_TOPIC
+    from opencv_facerecognizer_tpu.runtime.resilience import (
+        DurabilityDegradedError, DurabilityMonitor,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak partition seed={seed} seconds={seconds}",
+          file=sys.stderr)
+
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 16, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.1)
+    link_deadline_s = 0.25
+    hedge_deadline_s = 0.12
+    health_interval_s = 0.05
+    offered_hz = 60.0
+    topics = 12
+
+    report = {"scenario": "partition", "seed": seed, "seconds": seconds,
+              "ok": False}
+    failures: list = []
+
+    netfi = FaultInjector(seed=seed)
+    router_metrics = Metrics()
+    router, stacks = build_replica_fleet(
+        3, dispatch_s=0.01, health_interval_s=health_interval_s,
+        router_metrics=router_metrics, tracer=tracer,
+        router_fault_injector=netfi, link_deadline_s=link_deadline_s,
+        hedge_deadline_s=hedge_deadline_s)
+    recorder = TrafficRecorder(router)
+    #: raw delivery counter ABOVE the fan-in dedup — ``TrafficRecorder``
+    #: setdefaults duplicate results away silently, so the exactly-once
+    #: assertion needs its own count of every upstream dispatch.
+    raw_lock = threading.Lock()
+    raw_deliveries: dict = {}
+
+    def count_raw(topic, message):
+        seq = (message.get("meta") or {}).get("seq")
+        if seq is not None:
+            with raw_lock:
+                raw_deliveries[seq] = raw_deliveries.get(seq, 0) + 1
+
+    router.subscribe(RESULT_TOPIC, count_raw)
+    frame_msg = encode_frame(np.zeros((32, 32), np.float32))
+    seq_box = {"seq": 0}
+
+    def offer() -> int:
+        seq = seq_box["seq"]
+        seq_box["seq"] = seq + 1
+        recorder.send_t[seq] = time.monotonic()
+        router.publish(f"camera/{seq % topics}",
+                       {**frame_msg, "priority": "interactive",
+                        "meta": {"seq": seq}})
+        return seq
+
+    def link_up(name: str) -> bool:
+        return next(r["link_up"] for r in router.registry()
+                    if r["name"] == name)
+
+    def drain_all(timeout: float = 15.0) -> None:
+        for _p, svc, _c, _m in stacks:
+            svc.drain(timeout=timeout)
+
+    interval = 1.0 / offered_hz
+    base_p99_ms = p99_survivor = float("nan")
+    failover_s = None
+    blackout_seqs: list = []
+    survivor_seqs: list = []
+    storm_seqs: list = []
+    try:
+        for _p, svc, _c, _m in stacks:
+            svc.start(warmup=False)
+        router.start()
+
+        # ---- phase A: unloaded baseline across the healthy fleet ----
+        base_seqs = []
+        base_end = time.monotonic() + min(1.0, seconds / 4)
+        while time.monotonic() < base_end:
+            base_seqs.append(offer())
+            time.sleep(interval)
+        drain_all()
+        base_p99_ms = recorder.percentile_ms(base_seqs, 99)
+
+        # ---- phase B: hard partition of the busiest replica ----
+        busiest = max(router.registry(), key=lambda r: len(r["topics"]))
+        victim = busiest["name"]
+        netfi.set_partition(victim)
+        t_part = time.monotonic()
+        detect_budget = link_deadline_s + 4 * health_interval_s + 0.5
+        heal_at = t_part + max(1.0, seconds * 0.2)
+        t_detect = None
+        while time.monotonic() < heal_at:
+            seq = offer()
+            if t_detect is None:
+                if not link_up(victim):
+                    t_detect = time.monotonic()
+                    failover_s = t_detect - t_part
+                else:
+                    blackout_seqs.append(seq)
+            elif time.monotonic() > t_detect + 2 * health_interval_s:
+                survivor_seqs.append(seq)
+            time.sleep(interval)
+        if t_detect is None:
+            failures.append(f"link to {victim} never failed over "
+                            f"(partitioned at t+0, waited "
+                            f"{heal_at - t_part:.1f}s)")
+        elif failover_s > detect_budget:
+            failures.append(f"failover took {failover_s:.2f}s > "
+                            f"{detect_budget:.2f}s budget")
+        netfi.heal_partition(victim)
+        recover_deadline = time.monotonic() + detect_budget
+        while (not link_up(victim)
+               and time.monotonic() < recover_deadline):
+            time.sleep(health_interval_s)
+        if not link_up(victim):
+            failures.append(f"link to {victim} never recovered after heal")
+
+        # ---- phase C: flapping link on a second replica ----
+        others = [r["name"] for r in router.registry() if r["name"] != victim]
+        flappy = others[0]
+        for _ in range(3):
+            netfi.set_partition(flappy)
+            flap_end = time.monotonic() + 2 * health_interval_s
+            while time.monotonic() < flap_end:
+                offer()
+                time.sleep(interval)
+            netfi.heal_partition(flappy)
+            flap_end = time.monotonic() + 2 * health_interval_s
+            while time.monotonic() < flap_end:
+                offer()
+                time.sleep(interval)
+        recover_deadline = time.monotonic() + detect_budget
+        while (not link_up(flappy)
+               and time.monotonic() < recover_deadline):
+            time.sleep(health_interval_s)
+        if not link_up(flappy):
+            failures.append(f"flapped link to {flappy} never converged up")
+
+        # ---- phase D: duplicate storm on every transport crossing ----
+        netfi.rates["transport"] = {"duplicate": 0.5}
+        storm_end = time.monotonic() + max(1.0, seconds * 0.2)
+        while time.monotonic() < storm_end:
+            storm_seqs.append(offer())
+            time.sleep(interval)
+        netfi.rates["transport"] = {}
+        drain_all()
+        # Let straggler hedge results and pongs settle before judging.
+        time.sleep(4 * health_interval_s)
+        p99_survivor = recorder.percentile_ms(survivor_seqs, 99)
+    finally:
+        try:
+            router.stop()
+        finally:
+            for _p, svc, _c, _m in stacks:
+                try:
+                    svc.stop()
+                except Exception:  # noqa: BLE001 — teardown must finish
+                    import traceback
+
+                    traceback.print_exc()
+
+    # ---- phase E: half-open writer — split-brain fails closed ----
+    temp_dir = state_dir is None
+    if temp_dir:
+        state_dir = tempfile.mkdtemp(prefix="ocvf_partition_")
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+
+    storefi = FaultInjector(seed=seed)
+    writer_metrics = Metrics()
+    DIM = 8
+    writer_gallery = ShardedGallery(capacity=64, dim=DIM, mesh=make_mesh())
+    state = StateLifecycle(state_dir, metrics=writer_metrics,
+                           checkpoint_every_s=1e9, fault_injector=storefi)
+    state.bind(writer_gallery, [])
+    monitor = DurabilityMonitor(state, metrics=writer_metrics,
+                                degraded_after=2, probe_interval_s=0.01,
+                                fault_injector=storefi)
+    frame_rng = np.random.default_rng(seed)
+    split_brain = {"refused": False, "degraded_reason": None,
+                   "rearmed": False, "recovered_ack": False}
+
+    def enroll_once(tag: str):
+        emb = frame_rng.normal(size=(1, DIM)).astype(np.float32)
+        return state.append_enrollment(
+            emb, np.zeros(1, np.int32), subject=tag, label=0)
+
+    try:
+        enroll_once("pre_partition")  # the volume provably works first
+        # The state dir goes half-open: reads fail (the lease can no
+        # longer be proven held), writes fail (the probe cannot re-arm).
+        storefi.rates["storage"] = {"read_error": 1.0, "eio": 1.0}
+        flip_deadline = time.monotonic() + 5.0
+        while not monitor.degraded and time.monotonic() < flip_deadline:
+            monitor.tick(force=True, probe=True)
+            time.sleep(0.01)
+        split_brain["degraded_reason"] = monitor.degraded_reason
+        if not monitor.degraded:
+            failures.append("half-open writer never flipped degraded")
+        elif monitor.degraded_reason != "lease_unreachable":
+            failures.append(f"writer degraded for the wrong reason: "
+                            f"{monitor.degraded_reason!r}")
+        try:
+            enroll_once("during_partition")
+            failures.append("degraded writer ACKED an enrollment — "
+                            "split-brain window is open")
+        except DurabilityDegradedError:
+            split_brain["refused"] = True
+        # Heal the volume: the recovery probe re-arms, enrollment flows.
+        storefi.rates["storage"] = {}
+        rearm_deadline = time.monotonic() + 5.0
+        while monitor.degraded and time.monotonic() < rearm_deadline:
+            monitor.tick(force=True, probe=True)
+            time.sleep(0.01)
+        split_brain["rearmed"] = not monitor.degraded
+        if monitor.degraded:
+            failures.append("healed writer never re-armed")
+        else:
+            try:
+                enroll_once("post_heal")
+                split_brain["recovered_ack"] = True
+            except Exception as exc:  # noqa: BLE001 — any refusal here is the failure being tested
+                failures.append(f"healed writer refused enrollment: {exc!r}")
+    finally:
+        state.close()
+        if temp_dir:
+            shutil.rmtree(state_dir, ignore_errors=True)
+
+    # ---- verdicts over the fleet phases ----
+    rc = router_metrics.counters()
+    per_replica = []
+    deduped_total = 0.0
+    for i, (_p, svc, _c, metrics) in enumerate(stacks):
+        ledger = svc.ledger()
+        deduped = metrics.counters().get("frames_deduped", 0.0)
+        deduped_total += deduped
+        per_replica.append({"name": f"replica-{i}", "ledger": ledger,
+                            "frames_deduped": deduped})
+        if abs(ledger["in_system"]) > 1e-6:
+            failures.append(f"replica-{i} ledger unsettled: {ledger}")
+    deduped_total += rc.get("router_results_deduped", 0.0)
+
+    if base_p99_ms != base_p99_ms:
+        failures.append("no baseline frame completed")
+    if p99_survivor != p99_survivor:
+        failures.append("no survivor frame completed after failover")
+    elif base_p99_ms == base_p99_ms \
+            and p99_survivor > 2.0 * base_p99_ms + 100.0:
+        failures.append(f"survivor p99 after failover blew the budget: "
+                        f"{p99_survivor:.0f} ms > 2x baseline "
+                        f"{base_p99_ms:.0f} ms + 100 ms")
+    if not rc.get("router_hedges"):
+        failures.append("no hedge fired during the blackout window")
+    dup_seqs = {s: n for s, n in raw_deliveries.items() if n > 1}
+    if dup_seqs:
+        failures.append(f"duplicate result publishes for "
+                        f"{len(dup_seqs)} seq(s): "
+                        f"{dict(list(dup_seqs.items())[:5])}")
+    if deduped_total < 1:
+        failures.append("duplicate storm produced zero dedups — the "
+                        "dedup layer was never exercised")
+    if not rc.get("link_failures") or not rc.get("link_recoveries"):
+        failures.append(f"link supervision never cycled: {rc}")
+
+    failover_dumps = glob.glob(os.path.join(trace_dir,
+                                            "flight-*failover*.json"))
+    if not failover_dumps:
+        failures.append("link failover left no flight-recorder dump")
+    _check_flight_dumps(trace_dir, failures, require=1)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+
+    report.update({
+        "offered": seq_box["seq"],
+        "baseline_p99_ms": None if base_p99_ms != base_p99_ms
+        else round(base_p99_ms, 1),
+        "survivor_p99_ms": None if p99_survivor != p99_survivor
+        else round(p99_survivor, 1),
+        "failover_s": None if failover_s is None else round(failover_s, 3),
+        "blackout_offered": len(blackout_seqs),
+        "blackout_rescued": recorder.completed(blackout_seqs),
+        "storm_offered": len(storm_seqs),
+        "storm_completed": recorder.completed(storm_seqs),
+        "deduped_total": deduped_total,
+        "duplicate_publishes": len(dup_seqs),
+        "split_brain": split_brain,
+        "router": {k: v for k, v in rc.items()},
+        "replicas": per_replica,
+        "transport_injected": {k: v for k, v in netfi.injected.items()},
+    })
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seconds", type=float, default=10.0)
@@ -2376,7 +2724,7 @@ def main(argv=None) -> int:
                         help="replay a previous run exactly (logged on stderr)")
     parser.add_argument("--scenario", choices=["soak", "overload", "recovery",
                                                "replication", "rollout",
-                                               "disk"],
+                                               "disk", "partition"],
                         default="soak",
                         help="soak: randomized fault soak (default); "
                              "overload: 4x flood against the admission/"
@@ -2399,7 +2747,12 @@ def main(argv=None) -> int:
                              "assert refused-closed enrollments, serving "
                              "continuity, exact per-sink shed accounting, "
                              "automatic re-arm, zero acked loss "
-                             "(run_disk)")
+                             "(run_disk); partition: the NETWORK fails — "
+                             "router<->replica partition + heal, flapping "
+                             "link, duplicate storm, half-open writer; "
+                             "assert bounded failover, hedge rescue, "
+                             "exactly-once delivery, exact ledgers, "
+                             "split-brain fail-closed (run_partition)")
     parser.add_argument("--journal", default=None,
                         help="overload scenario: write the dead-letter "
                              "journal here instead of a temp file")
@@ -2422,6 +2775,9 @@ def main(argv=None) -> int:
     elif args.scenario == "disk":
         report = run_disk(seconds=args.seconds, seed=args.seed,
                           state_dir=args.state_dir)
+    elif args.scenario == "partition":
+        report = run_partition(seconds=args.seconds, seed=args.seed,
+                               state_dir=args.state_dir)
     else:
         report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))

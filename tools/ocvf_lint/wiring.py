@@ -56,6 +56,15 @@ ATTR_HINTS: Dict[str, str] = {
     # RotatingJournal base, with its own per-sink counters).
     "durability": "DurabilityMonitor",
     "span_sink": "RotatingJournal",
+    # Partition tolerance (PR 16): link supervision and hedged dispatch
+    # both live ON the router itself (per-replica state rides the
+    # handles), so ``link``/``hedge`` attribute reads dispatch to
+    # ``TopicRouter``; ``_faults`` is the shared injector whose transport
+    # boundary the connector and router crossings call into (private
+    # name on purpose — that is how every holder stores it).
+    "link": "TopicRouter",
+    "hedge": "TopicRouter",
+    "_faults": "FaultInjector",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
