@@ -137,6 +137,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="escape hatch: serve single-stage even with a "
                         "--cascade checkpoint loaded (e.g. to A/B the "
                         "gate's recall in production)")
+    # ---- temporal identity cache (runtime.tracker; README) ----
+    p.add_argument("--track-reverify-frames", type=int, default=8,
+                   metavar="N",
+                   help="temporal identity cache: a track whose stream "
+                        "stays coherent serves its confirmed identity "
+                        "from the cache (frames settle completed_cached, "
+                        "skipping detect+embed+match) for at most N-1 "
+                        "consecutive frames before a scheduled full "
+                        "re-verify; appearance drift or association "
+                        "ambiguity re-verifies immediately. Brownout "
+                        "level >= 1 stretches the interval before "
+                        "shedding intake")
+    p.add_argument("--track-iou-min", type=float, default=0.3,
+                   metavar="IOU",
+                   help="minimum box IoU for frame-to-frame track "
+                        "association (centroid fallback below it)")
+    p.add_argument("--no-track-cache", action="store_true",
+                   help="escape hatch: disable the temporal identity "
+                        "cache — every frame takes the full "
+                        "detect+embed+match path")
     p.add_argument("--similarity-threshold", type=float, default=0.3)
     p.add_argument("--capacity", type=int, default=4096, help="gallery capacity")
     p.add_argument("--gallery-dtype", choices=["bf16", "f32"], default="bf16",
@@ -902,6 +922,21 @@ def main(argv=None) -> int:
     else:
         connector = FakeConnector()
 
+    tracker = None
+    if not args.no_track_cache:
+        from opencv_facerecognizer_tpu.runtime.tracker import (
+            IdentityTracker, TrackerConfig,
+        )
+
+        # Replica-local by construction: the tracker lives on THIS
+        # service instance, and PR 10's rendezvous routing pins each
+        # topic to one replica — failover/resync lands on a replica
+        # whose cache simply starts cold.
+        tracker = IdentityTracker(
+            TrackerConfig(reverify_frames=max(1, args.track_reverify_frames),
+                          iou_min=args.track_iou_min),
+            metrics=metrics)
+
     service = RecognizerService(
         pipeline, connector,
         batch_size=args.batch_size,
@@ -940,6 +975,7 @@ def main(argv=None) -> int:
         replica=replica,
         cascade=not args.no_cascade,
         cascade_threshold=args.cascade_threshold,
+        tracker=tracker,
     )
     if slo_monitor is not None and replica is not None:
         # Stale-replica brownout: the lag gauge objective rides the same

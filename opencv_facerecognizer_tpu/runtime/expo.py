@@ -46,6 +46,11 @@ path                    payload
                         (``runtime.rollout.RolloutCoordinator.status``):
                         phase, staged-re-embed watermark, dual-score
                         parity verdict; ``{"rollout": null}`` when none
+``/tracks``             the temporal identity cache's track registry
+                        (``runtime.tracker.IdentityTracker.registry``):
+                        per-track stream/box/identity/confirmation state
+                        plus hit-rate stats; ``{"tracks": null}`` when no
+                        tracker is wired
 ======================  =====================================================
 
 **Read-only contract**: every verb except GET is answered ``405 Method Not
@@ -305,7 +310,7 @@ class ExpoServer:
             return {
                 "endpoints": ["/", "/metrics", "/prom", "/health", "/ledger",
                               "/brownout", "/spans", "/attribution",
-                              "/replicas", "/rollout"],
+                              "/replicas", "/rollout", "/tracks"],
                 "uptime_s": round(time.monotonic() - self._started_t, 1),
                 "brownout_level": getattr(service, "brownout_level", None),
                 "health": (self.slo.state if self.slo is not None else None),
@@ -344,6 +349,18 @@ class ExpoServer:
             if coordinator is None:
                 return {"rollout": None, "detail": "no rollout in flight"}
             return {"rollout": coordinator.status()}
+        if path == "/tracks":
+            # Temporal identity cache (ISSUE 17): the replica-local
+            # track registry + hit-rate stats as a read-only snapshot —
+            # what an operator polls to see WHO the cache thinks is in
+            # each stream and how much device work it is absorbing.
+            # Same unwired shape as /replicas: null payload, never 404.
+            tracker = getattr(service, "tracker", None)
+            if tracker is None:
+                return {"tracks": None,
+                        "detail": "no identity tracker wired"}
+            return {"tracks": tracker.registry(),
+                    "stats": tracker.stats()}
         raise KeyError(path)
 
     @staticmethod

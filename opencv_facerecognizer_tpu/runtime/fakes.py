@@ -92,7 +92,9 @@ class InstantPipeline:
                  h2d_gb_s: Optional[float] = None,
                  dispatch_per_frame_s: float = 0.0,
                  cascade_stub: bool = False,
-                 cascade_score_s: float = 0.0):
+                 cascade_score_s: float = 0.0,
+                 video_oracle: bool = False,
+                 oracle_sim: float = 0.9):
         self.frame_shape = tuple(frame_shape)
         self.top_k = int(top_k)
         self.max_faces = int(max_faces)
@@ -158,6 +160,18 @@ class InstantPipeline:
         #: Tests clear this to inject a post-warmup compile.
         self.compiled_batch_sizes: set = set()
         self.last_dispatch_info: dict = {}
+        #: video oracle (ISSUE 17): derive detections host-side from the
+        #: frame pixels instead of scripting them — each identity in a
+        #: ``synthetic_video_stream`` frame is a blob filled with the
+        #: distinct value ``160 + 24*i`` (all >= the brightness-stub's
+        #: 150 floor), so the oracle recovers box AND label exactly:
+        #: label ``i`` at the mask's bounding box, fixed ``oracle_sim``
+        #: similarity. This is what lets the tracker bench/chaos runs
+        #: assert identity-correctness end-to-end without a trained
+        #: embedder: the pipeline "recognizes" whoever is actually in
+        #: the frame, and an in-place fill swap IS an identity change.
+        self.video_oracle = bool(video_oracle)
+        self.oracle_sim = float(oracle_sim)
 
     @staticmethod
     def _sig(batch, dtype) -> tuple:
@@ -217,6 +231,28 @@ class InstantPipeline:
         # sims(k); valid=0 everywhere -> zero faces per frame (unless
         # faces_per_frame scripts some detections in).
         packed = np.zeros((b, self.max_faces, 6 + 2 * self.top_k), np.float32)
+        if self.video_oracle:
+            # Pixel-derived detections (see __init__): one face per
+            # distinct identity fill value present in the frame.
+            for fi in range(b):
+                slot = 0
+                for v in np.unique(host[fi]):
+                    fv = float(v)
+                    if fv < 160.0 or fv > 232.0 or (fv - 160.0) % 24.0:
+                        continue
+                    if slot >= self.max_faces:
+                        break
+                    ys, xs = np.nonzero(host[fi] == v)
+                    packed[fi, slot, 0:4] = (float(ys.min()), float(xs.min()),
+                                             float(ys.max()) + 1.0,
+                                             float(xs.max()) + 1.0)
+                    packed[fi, slot, 4] = 1.0   # det_score
+                    packed[fi, slot, 5] = 1.0   # valid
+                    packed[fi, slot, 6] = (fv - 160.0) / 24.0  # label
+                    packed[fi, slot, 6 + self.top_k] = self.oracle_sim
+                    slot += 1
+            return FakePacked(packed, time.monotonic() + self.compute_s,
+                              poll_cost_s=self.sync_poll_floor_s)
         if self.faces_per_frame:
             h, w = self.frame_shape
             for j in range(self.faces_per_frame):
@@ -304,6 +340,102 @@ def synthetic_frame_stream(n: int, frame_hw: Tuple[int, int] = (64, 64),
             out.append((encode_jpeg(frame, quality=quality), frame, k))
         else:
             out.append((frame, k))
+    return out
+
+
+def synthetic_video_stream(n: int, frame_hw: Tuple[int, int] = (64, 64),
+                           streams: int = 1, tracks_per_stream: int = 1,
+                           coherence: float = 0.9, face_density: float = 1.0,
+                           seed: int = 0, step_px: int = 1,
+                           identity_swap_at: Optional[int] = None,
+                           track_churn: float = 0.0, jpeg: bool = False,
+                           quality: int = 85):
+    """Seeded multi-stream video traffic (ISSUE 17): ``n`` frames
+    round-robined across ``streams`` camera keys, each carrying
+    ``tracks_per_stream`` persistent identity blobs whose motion is
+    temporally coherent — the workload the temporal identity cache is
+    built to exploit, and the one its chaos arms attack.
+
+    Identity encoding: blob ``i`` is filled with the constant value
+    ``160 + 24*(identity % 4)``, which ``InstantPipeline(video_oracle=
+    True)`` decodes back into (box, label) exactly — so recognition
+    results track frame CONTENT, and the knobs below change what the
+    pipeline reports, not just the pixels:
+
+    - ``coherence``: per-frame probability a blob takes a small
+      ``±step_px`` walk instead of teleporting to a random position.
+      0.9 ~ video, 0.0 ~ shuffled stills (every frame a jump, so box
+      association — and with it the cache — finds nothing to reuse).
+    - ``track_churn``: per-frame probability a blob is replaced
+      outright (new position AND next identity) — scene-cut churn.
+    - ``identity_swap_at``: per-stream frame index at which track 0
+      changes identity IN PLACE (same box, new fill) — the cache-
+      poisoning probe: a tracker that trusts box association alone
+      would keep publishing the old name.
+    - ``face_density``: probability a frame carries its blobs at all;
+      blob-free frames are pure background (the cascade rejects them).
+
+    Returns ``[(frame, stream_key, n_faces)]`` (uint8), or with
+    ``jpeg=True`` ``[(jpeg_bytes, frame, stream_key, n_faces)]`` —
+    composing with the PR 12 compressed-intake path like
+    ``synthetic_frame_stream``. (JPEG is lossy: feed the oracle the
+    raw ``frame``, not the decode, when identity exactness matters.)"""
+    n = int(n)
+    streams = max(1, int(streams))
+    rng = np.random.default_rng(seed)
+    h, w = int(frame_hw[0]), int(frame_hw[1])
+    side = max(8, h // 4)
+    step = max(1, int(step_px))
+
+    def _spawn(ident):
+        return {"ident": int(ident) % 4,
+                "y": int(rng.integers(0, max(1, h - side))),
+                "x": int(rng.integers(0, max(1, w - side)))}
+
+    state = []
+    for _s in range(streams):
+        tracks = [_spawn(i) for i in range(int(tracks_per_stream))]
+        state.append({"tracks": tracks, "frame_idx": 0,
+                      "next_ident": int(tracks_per_stream)})
+
+    out = []
+    for i in range(n):
+        s = i % streams
+        st = state[s]
+        for ti, t in enumerate(st["tracks"]):
+            if track_churn and rng.random() < float(track_churn):
+                st["tracks"][ti] = _spawn(st["next_ident"])
+                st["next_ident"] += 1
+                continue
+            if rng.random() < float(coherence):
+                t["y"] = int(np.clip(t["y"] + rng.integers(-step, step + 1),
+                                     0, max(0, h - side)))
+                t["x"] = int(np.clip(t["x"] + rng.integers(-step, step + 1),
+                                     0, max(0, w - side)))
+            else:
+                t["y"] = int(rng.integers(0, max(1, h - side)))
+                t["x"] = int(rng.integers(0, max(1, w - side)))
+        if (identity_swap_at is not None
+                and st["frame_idx"] == int(identity_swap_at)
+                and st["tracks"]):
+            t0 = st["tracks"][0]
+            t0["ident"] = (t0["ident"] + 1) % 4
+        frame = rng.integers(20, 90, size=(h, w)).astype(np.uint8)
+        faced = rng.random() < float(face_density)
+        k = 0
+        if faced:
+            for t in st["tracks"]:
+                fill = 160 + 24 * (t["ident"] % 4)
+                frame[t["y"]:t["y"] + side, t["x"]:t["x"] + side] = fill
+                k += 1
+        st["frame_idx"] += 1
+        key = "cam%d" % s
+        if jpeg:
+            from opencv_facerecognizer_tpu.runtime.ingest import encode_jpeg
+
+            out.append((encode_jpeg(frame, quality=quality), frame, key, k))
+        else:
+            out.append((frame, key, k))
     return out
 
 
@@ -432,14 +564,20 @@ class TrafficRecorder:
             with self._lock:
                 self.done_t.setdefault(seq, time.monotonic())
 
-    def offer(self, connector, payload: dict, seq, priority: str) -> None:
+    def offer(self, connector, payload: dict, seq, priority: str,
+              meta_extra: Optional[dict] = None) -> None:
         """Stamp + inject one frame message (``payload`` carries the frame
-        encoding; priority rides both the admission field and the meta)."""
+        encoding; priority rides both the admission field and the meta).
+        ``meta_extra`` merges additional meta keys — the video bench
+        stamps ``stream`` so the tracker can scope its cache."""
         from opencv_facerecognizer_tpu.runtime.recognizer import FRAME_TOPIC
 
         self.send_t[seq] = time.monotonic()
+        meta = {"seq": seq, "pri": priority}
+        if meta_extra:
+            meta.update(meta_extra)
         connector.inject(FRAME_TOPIC, {**payload, "priority": priority,
-                                       "meta": {"seq": seq, "pri": priority}})
+                                       "meta": meta})
 
     def completed(self, seqs) -> int:
         with self._lock:

@@ -331,6 +331,16 @@ class RecognizerService:
         # cheapest shed — reject borderline frames at stage 1 before the
         # intake skip drops admitted frames outright). 0 disables.
         cascade_brownout_notch: float = CASCADE_BROWNOUT_NOTCH,
+        # ---- temporal identity cache (ISSUE 17) ----
+        # An IdentityTracker (runtime.tracker) or None. When set, frames
+        # whose ``meta["stream"]`` has live confirmed tracks — all inside
+        # their re-verify window, appearance-stable and embedder-version
+        # matched — settle as ``completed_cached`` with the cached
+        # identities BEFORE the cascade gate (a tracker lookup is pure
+        # host work, cheaper than the stage-1 device pass); every full
+        # published result feeds back through ``tracker.update``. None =
+        # every frame takes the full path (the --no-track-cache hatch).
+        tracker=None,
         # ---- idempotent intake (ISSUE 16) ----
         # Frame-id dedup window: a delivery whose ``meta["_fid"]`` was
         # already ADMITTED is refused before admission (counted
@@ -407,6 +417,7 @@ class RecognizerService:
             DEFAULT_CASCADE_THRESHOLD if cascade_threshold is None
             else cascade_threshold)
         self.cascade_brownout_notch = float(cascade_brownout_notch)
+        self.tracker = tracker
         # Cumulative scored/rejected counts behind the /prom rate gauges
         # (serving-thread only — no lock needed).
         self._cascade_scored = 0
@@ -577,26 +588,31 @@ class RecognizerService:
         """One atomic admission-ledger snapshot: ``admitted``,
         ``completed``, ``completed_empty`` (cascade early exits — frames
         published with an empty face list because stage 1 scored them
-        face-free; terminal completions, not drops), per-reason
-        ``drops_by_reason`` and the ``in_system`` remainder (frames
-        admitted but not yet finished — queued in the batcher, riding an
-        in-flight batch, or mid-publish). The invariant is
-        ``admitted == completed + completed_empty + Σ drops`` at
-        quiescence (after ``drain()``, ``in_system`` must be exactly 0) —
-        chaos_soak and the overload/cascade tests enforce it."""
+        face-free; terminal completions, not drops), ``completed_cached``
+        (track-cache exits, ISSUE 17: published with the cached
+        identities, never dispatched — terminal completions too),
+        per-reason ``drops_by_reason`` and the ``in_system`` remainder
+        (frames admitted but not yet finished — queued in the batcher,
+        riding an in-flight batch, or mid-publish). The invariant is
+        ``admitted == completed + completed_empty + completed_cached +
+        Σ drops`` at quiescence (after ``drain()``, ``in_system`` must be
+        exactly 0) — chaos_soak and the overload/cascade/tracker tests
+        enforce it."""
         c = self.metrics.counters()
         drops = {name: c[name] for name in self.LEDGER_DROP_COUNTERS
                  if c.get(name)}
         admitted = c.get(mn.FRAMES_ADMITTED, 0.0)
         completed = c.get(mn.FRAMES_COMPLETED, 0.0)
         completed_empty = c.get(mn.FRAMES_COMPLETED_EMPTY, 0.0)
+        completed_cached = c.get(mn.FRAMES_COMPLETED_CACHED, 0.0)
         return {
             "admitted": admitted,
             "completed": completed,
             "completed_empty": completed_empty,
+            "completed_cached": completed_cached,
             "drops_by_reason": drops,
             "in_system": (admitted - completed - completed_empty
-                          - sum(drops.values())),
+                          - completed_cached - sum(drops.values())),
         }
 
     def frames_in_system(self) -> float:
@@ -608,7 +624,8 @@ class RecognizerService:
         quiescence."""
         return max(0.0, self.metrics.sum_counters(
             (mn.FRAMES_ADMITTED,),
-            (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY)
+            (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+             mn.FRAMES_COMPLETED_CACHED)
             + self.LEDGER_DROP_COUNTERS))
 
     def _journal_drop(self, reason: str, entries: List[Dict[str, Any]],
@@ -847,6 +864,17 @@ class RecognizerService:
         ``rejected`` rows are ``(meta, enqueue_ts, trace_id, priority)``.
         A crash escaping mid-run settles the remainder as crashed,
         exactly like ``_publish`` — no frame is ever left in limbo."""
+        if self.tracker is not None:
+            # A face-free verdict on a tracked stream is a miss for its
+            # live tracks: a vanished subject ages out within the miss
+            # TTL instead of being served from a stale cache entry.
+            for meta, _ts, _tid, _pri in rejected:
+                key = self._track_stream_key(meta)
+                if key is not None:
+                    try:
+                        self.tracker.note_miss(key)
+                    except Exception:  # noqa: BLE001 — observation only
+                        self.metrics.incr(mn.TRACK_ERRORS)
         published = 0
         try:
             for meta, _ts, _tid, _pri in rejected:
@@ -870,6 +898,88 @@ class RecognizerService:
             # belongs in the SLO histograms like any published frame.
             now_mono = time.monotonic()
             for _meta, ts, _tid, pri in rejected[:published]:
+                if ts is not None:
+                    self._observe_e2e(ts, pri, now_mono)
+
+    # ---- temporal identity cache (ISSUE 17) ----
+
+    @staticmethod
+    def _track_stream_key(meta):
+        """The tracking scope of one frame: its camera stream/topic from
+        ``meta`` (``stream`` preferred, ``topic`` accepted — the same key
+        PR 10's rendezvous routing pins to one replica). None = the frame
+        is untracked (no cache lookup, no track update) — frames without
+        a stream identity can never alias each other's tracks."""
+        if isinstance(meta, dict):
+            key = meta.get("stream")
+            if key is None:
+                key = meta.get("topic")
+            return key
+        return None
+
+    def _track_reverify_stretch(self) -> float:
+        """Brownout composition (mirrors the cascade threshold notch): at
+        effective level >= 1 the re-verify interval stretches by the
+        tracker's configured factor — serving MORE frames from the cache
+        (bounded staleness) is a cheaper shed than dropping admitted
+        intake outright."""
+        if (self.tracker is not None and self.brownout_policy is not None
+                and self._effective_brownout_level() >= 1):
+            return float(self.tracker.config.brownout_stretch)
+        return 1.0
+
+    def _track_lookup(self, meta, frame, gallery_ver, stretch: float):
+        """One fail-open cache consult: the cached payload or None. A
+        tracker bug must cost the cache win, never the frame — the full
+        pipeline is always the safe answer."""
+        key = self._track_stream_key(meta)
+        if key is None:
+            return None
+        try:
+            return self.tracker.lookup(key, frame,
+                                       embedder_version=gallery_ver,
+                                       reverify_stretch=stretch)
+        except Exception:  # noqa: BLE001 — fail open to the full path
+            logging.getLogger(__name__).exception("tracker lookup failed")
+            self.metrics.incr(mn.TRACK_ERRORS)
+            return None
+
+    def _complete_cached(self, cached, batch_tid: int) -> None:
+        """Settle track-cache hits as ``completed_cached``: each
+        publishes the cached identities (``exit: track_cache`` plus the
+        serving ``track_id``) and lands in the ledger's
+        ``completed_cached`` bucket with a terminal settle span — the
+        ``_complete_empty`` pattern (ISSUE 13) for the cache exit.
+        ``cached`` rows are ``(meta, enqueue_ts, trace_id, priority,
+        hit)`` where ``hit`` is the tracker's lookup payload. A crash
+        escaping mid-run settles the remainder as crashed."""
+        published = 0
+        try:
+            for meta, _ts, _tid, _pri, hit in cached:
+                payload = {"meta": meta, "faces": hit["faces"],
+                           "exit": "track_cache",
+                           "track_id": hit["track_id"]}
+                if hit.get("embedder_version") is not None:
+                    payload["embedder_version"] = hit["embedder_version"]
+                self.connector.publish(RESULT_TOPIC, payload)
+                published += 1
+                self.metrics.incr(mn.FACES_FOUND, len(hit["faces"]))
+        finally:
+            self.metrics.incr(mn.FRAMES_COMPLETED_CACHED, published)
+            self._trace_settle([r[2] for r in cached[:published]],
+                               tracing.OUTCOME_COMPLETED_CACHED,
+                               "track_cache.hit", batch=batch_tid)
+            if published < len(cached):
+                self.metrics.incr(mn.FRAMES_DROPPED_CRASHED,
+                                  len(cached) - published)
+                self._trace_settle([r[2] for r in cached[published:]],
+                                   mn.FRAMES_DROPPED_CRASHED,
+                                   "track_cache.publish_crashed",
+                                   batch=batch_tid)
+            # Cache exits are real end-to-end completions: their latency
+            # belongs in the SLO histograms like any published frame.
+            now_mono = time.monotonic()
+            for _meta, ts, _tid, pri, _hit in cached[:published]:
                 if ts is not None:
                     self._observe_e2e(ts, pri, now_mono)
 
@@ -1133,6 +1243,8 @@ class RecognizerService:
                     "scored": self._cascade_scored,
                     "rejected": self._cascade_rejected,
                 }
+            if self.tracker is not None:
+                status["tracks"] = self.tracker.stats()
             self.connector.publish(STATUS_TOPIC, status)
 
     # ---- lifecycle ----
@@ -1426,6 +1538,67 @@ class RecognizerService:
             count = cap
         accounted = False
         try:
+            # Track-cache gate (ISSUE 17), BEFORE the cascade: a lookup
+            # is pure host work, cheaper than the stage-1 device pass, so
+            # cache hits save both stages. Hits settle as
+            # ``completed_cached`` (published with the cached identities,
+            # never dispatched); the survivors compact toward the staging
+            # buffer's front exactly like the cascade's, so the rungs
+            # below dispatch only what actually needs device work.
+            if count and self.tracker is not None:
+                stretch = self._track_reverify_stretch()
+                track_ver = getattr(self.pipeline.gallery,
+                                    "embedder_version", None)
+                if track_ver is not None:
+                    track_ver = int(track_ver)
+                cached = []
+                keep_list = []
+                for i in range(count):
+                    hit = self._track_lookup(metas[i], frames[i],
+                                             track_ver, stretch)
+                    if hit is not None:
+                        cached.append((metas[i], batch.enqueue_ts[i],
+                                       trace_ids[i], batch.priorities[i],
+                                       hit))
+                    else:
+                        keep_list.append(i)
+                if cached:
+                    keep_idx = np.asarray(keep_list, dtype=np.intp)
+                    kept = len(keep_idx)
+                    if kept:
+                        frames[:kept] = frames[keep_idx]
+                    metas = ([metas[i] for i in keep_list]
+                             + [None] * (len(metas) - kept))
+                    batch = batch._replace(
+                        metas=metas, count=kept,
+                        enqueue_ts=[batch.enqueue_ts[i] for i in keep_list],
+                        trace_ids=[trace_ids[i] for i in keep_list],
+                        priorities=[batch.priorities[i] for i in keep_list])
+                    trace_ids = batch.trace_ids
+                    count = kept
+                    if batch_tid:
+                        tracer.emit(batch_tid, "track_cache",
+                                    topic=tracing.BATCH_TOPIC,
+                                    frames=kept + len(cached),
+                                    hits=len(cached))
+                    self._complete_cached(cached, batch_tid)
+                    if not count:
+                        # Whole batch answered from the cache: no device
+                        # work at all this iteration.
+                        self.metrics.incr(mn.TRACK_BATCH_EXITS)
+                        if batch_tid:
+                            tracer.emit(batch_tid, "dispatch",
+                                        topic=tracing.BATCH_TOPIC,
+                                        dur=time.perf_counter() - t0,
+                                        bucket=0, frames=0,
+                                        exit="track_cache",
+                                        brownout=self._brownout_level)
+                        accounted = True
+                        self._mark_completed()
+                        self.batcher.recycle(frames)
+                        self.batcher.report_service_time(
+                            time.perf_counter() - t0)
+                        return
             # Stage-1 cascade gate (ISSUE 13): score the whole batch at
             # its ladder rung, settle face-free frames as
             # ``completed_empty`` (published with an empty face list,
@@ -2045,6 +2218,21 @@ class RecognizerService:
                 self.connector.publish(RESULT_TOPIC, payload)
                 published += 1
                 self.metrics.incr(mn.FACES_FOUND, len(faces))
+                if self.tracker is not None:
+                    # Every FULL published result re-verifies its
+                    # stream's tracks (association + identity
+                    # cross-check + miss aging). Fail open: a tracker
+                    # bug costs future cache wins, never this result.
+                    key = self._track_stream_key(metas[i])
+                    if key is not None:
+                        try:
+                            self.tracker.update(
+                                key, faces, frames[i],
+                                embedder_version=gallery_ver)
+                        except Exception:  # noqa: BLE001 — cache only
+                            logging.getLogger(__name__).exception(
+                                "tracker update failed")
+                            self.metrics.incr(mn.TRACK_ERRORS)
                 if rollout is not None and faces:
                     # Dual-score parity sampling (rate-limited + copied
                     # inside; scored on the rollout thread). A coordinator
@@ -2194,6 +2382,12 @@ class RecognizerService:
     def reload_gallery(self, new_gallery) -> None:
         """Swap in a rebuilt gallery between batches (double-buffered)."""
         self.pipeline.gallery.swap_from(new_gallery)
+        if self.tracker is not None:
+            # Cached identities were verified against the OLD gallery's
+            # labels/names: cold-start the cache (the embedder-version
+            # fence catches cutovers, but a same-version swap can still
+            # renumber labels).
+            self.tracker.flush_all()
         self.connector.publish(STATUS_TOPIC, {"status": "reloaded",
                                               "gallery_size": self.pipeline.gallery.size})
         self._run_commit_hooks()

@@ -78,6 +78,33 @@ CASCADE_REJECT_RATE = "cascade_reject_rate"
 CASCADE_PASS_RATE = "cascade_pass_rate"
 CASCADE_THRESHOLD = "cascade_threshold"
 
+# ---- temporal identity cache (runtime.tracker, ISSUE 17) -------------------
+#: terminal admission-ledger status for frames served FROM the track
+#: cache: published with the cached identities (``exit: track_cache``),
+#: never dispatched — a sibling of ``completed``/``completed_empty``, not
+#: a drop. The ledger invariant is ``admitted == completed +
+#: completed_empty + completed_cached + Σ drops``.
+FRAMES_COMPLETED_CACHED = "frames_completed_cached"
+#: cache consults (one per tracked frame entering _serve_one) and the
+#: frames they answered from the cache.
+TRACK_LOOKUPS = "track_lookups"
+TRACK_CACHE_HITS = "track_cache_hits"
+#: /prom gauges: cumulative hit fraction of lookups, and live tracks.
+TRACK_CACHE_HIT_RATE = "track_cache_hit_rate"
+TRACKS_LIVE = "tracks_live"
+TRACKS_CREATED = "tracks_created"
+TRACKS_CONFIRMED = "tracks_confirmed"
+#: full verifies forced by the schedule (every reverify_frames) or by
+#: appearance drift under a live track.
+TRACK_REVERIFIES = "track_reverifies"
+#: per-reason flush family ``track_flushes_<identity|ambiguity|version|
+#: lost|reset>`` (see runtime/tracker.py module docstring).
+TRACK_FLUSHES_PREFIX = "track_flushes_"
+#: whole batches that settled entirely from the cache (no dispatch), and
+#: tracker call failures (fail OPEN: the frame takes the full path).
+TRACK_BATCH_EXITS = "track_batch_exits"
+TRACK_ERRORS = "track_errors"
+
 # ---- admission / brownout (overload layer) --------------------------------
 #: per-reason rejection family: ``frames_rejected_<reason>``
 FRAMES_REJECTED_PREFIX = "frames_rejected_"

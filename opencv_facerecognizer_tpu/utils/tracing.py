@@ -79,6 +79,11 @@ OUTCOME_COMPLETED = "completed"
 #: full detector — the ledger's ``completed_empty`` terminal status, a
 #: sibling of completed, not a drop.
 OUTCOME_COMPLETED_EMPTY = "completed_empty"
+#: ``settle`` outcome of a frame answered FROM the temporal identity
+#: cache (ISSUE 17): published with the cached identities, never
+#: dispatched — the ledger's ``completed_cached`` terminal status, a
+#: sibling of completed/completed_empty, not a drop.
+OUTCOME_COMPLETED_CACHED = "completed_cached"
 
 _HASH_MULT = 2654435761  # Knuth multiplicative hash (mod 2^32)
 
@@ -362,6 +367,7 @@ def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     ``ledger()`` exactly — the chaos soak's span-accounting check."""
     completed = 0
     completed_empty = 0
+    completed_cached = 0
     drops: Dict[str, int] = {}
     admitted_traces = set()
     for span in spans:
@@ -376,10 +382,15 @@ def account_spans(spans: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                 # Cascade early exits are terminal completions, not drops
                 # — mirrored as their own ledger bucket.
                 completed_empty += 1
+            elif outcome == OUTCOME_COMPLETED_CACHED:
+                # Track-cache exits (ISSUE 17): same terminal-completion
+                # treatment, own bucket.
+                completed_cached += 1
             elif outcome:
                 drops[outcome] = drops.get(outcome, 0) + 1
     return {"traced": len(admitted_traces), "completed": completed,
-            "completed_empty": completed_empty, "drops": drops}
+            "completed_empty": completed_empty,
+            "completed_cached": completed_cached, "drops": drops}
 
 
 def device_busy_fraction(batch_spans: Iterable[Dict[str, Any]],

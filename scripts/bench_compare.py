@@ -142,6 +142,17 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("cascade") or {})
         .get("uplift", {}).get("d0", {}).get("uplift"),
         "ratio_min", 0.90, 0.0),
+    # Temporal identity cache (ISSUE 17): completed-frames uplift at
+    # coherence 0.9, cache on vs off, against the per-frame dispatch
+    # wall — the headline track-cache win. A candidate may not quietly
+    # lose it (an association that stops matching, a re-verify cadence
+    # gone pathological, a gate that stops compacting). Artifacts
+    # predating the video section ride the baseline-predates-metric
+    # skip.
+    "video_cache_uplift": (
+        lambda d: (d.get("video") or {})
+        .get("cells", {}).get("c90", {}).get("uplift"),
+        "ratio_min", 0.90, 0.0),
     # Partition tolerance (ISSUE 16): partition onset to link-down
     # detection in the chaos scenario. A candidate may not quietly slow
     # the failover the baseline demonstrated (a longer deadline, a lazier

@@ -105,6 +105,11 @@ def _check_span_accounting(dump_path: str, ring_size: int, ledger: dict,
             f"{where}: {acct['completed_empty']} completed_empty settle "
             f"spans != ledger completed_empty "
             f"{ledger.get('completed_empty', 0)}")
+    if acct["completed_cached"] != int(ledger.get("completed_cached", 0)):
+        failures.append(
+            f"{where}: {acct['completed_cached']} completed_cached settle "
+            f"spans != ledger completed_cached "
+            f"{ledger.get('completed_cached', 0)}")
     want_drops = {k: int(v) for k, v in ledger["drops_by_reason"].items()}
     if acct["drops"] != want_drops:
         failures.append(f"{where}: settle-span drops {acct['drops']} != "
@@ -2717,6 +2722,296 @@ def run_partition(seconds: float = 8.0, seed: int | None = None,
     return report
 
 
+def run_video(seconds: float = 6.0, seed: int | None = None,
+              state_dir: str | None = None) -> dict:
+    """Video scenario (ISSUE 17 acceptance): the temporal identity cache
+    under the attacks it was designed to survive. Four arms, all
+    closed-loop (one frame offered, drained, then the next — so every
+    full result lands before the following lookup, making the guarantees
+    exactly checkable with zero pipeline-lag slack):
+
+    1. **identity swap, drift armed** — coherent single-stream video
+       whose subject is swapped IN PLACE (same box, new identity)
+       mid-run: the appearance-drift check must force the full verify on
+       the very next frame — ZERO cached publishes of the old identity
+       after the swap frame, with an ``identity`` flush recorded.
+    2. **identity swap, drift disabled** — the same attack with the
+       drift check neutered (threshold inf): the scheduled re-verify is
+       now the only defense, and every stale cached publish must fall
+       WITHIN the re-verify window after the swap — never past it — and
+       the cache must recover onto the new identity afterwards.
+    3. **ambiguity** — two identities converge until their tracks
+       overlap above the IoU ceiling: the next full-path frame (at
+       latest the scheduled re-verify) flushes BOTH tracks
+       (``ambiguity`` x2 minimum) and no cached serve lands past the
+       window edge — poisoning cannot cross tracks.
+    4. **failover cold-start** — replica A serves the stream cache-hot,
+       is killed, and the stream resumes on fresh replica B (PR 10's
+       rendezvous routing pins topic->replica, so failover lands on a
+       replica whose tracker is empty by construction): B's first frames
+       MUST take the full path before any cached serve, both replicas'
+       extended ledgers settle exactly, and an embedder-version bump on
+       B flushes its cache (``version``) without serving a stale entry.
+
+    Observability: arm 1 runs traced at sample=1.0 and must leave a
+    parseable flight dump whose settle spans reproduce the extended
+    ledger (``completed_cached`` included) exactly.
+    """
+    import random as random_mod
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, synthetic_video_stream,
+    )
+    from opencv_facerecognizer_tpu.runtime.recognizer import (
+        FRAME_TOPIC, RESULT_TOPIC, RecognizerService,
+    )
+    from opencv_facerecognizer_tpu.runtime.tracker import (
+        IdentityTracker, TrackerConfig,
+    )
+    from opencv_facerecognizer_tpu.utils import metric_names as mn
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak video seed={seed} seconds={seconds}",
+          file=sys.stderr)
+
+    frame_hw = (64, 64)
+    reverify = 6
+    n_frames = max(36, int(seconds * 12))
+    # Offset the swap off the re-verify period (6): were they aligned,
+    # the scheduled verify would land ON the swap frame and the
+    # drift-disabled arm would never observe the stale window it exists
+    # to bound.
+    swap_at = n_frames // 2 + 3
+    names = ["id0", "id1", "id2", "id3"]
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 15, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.1)
+    report = {"scenario": "video", "seed": seed, "seconds": seconds,
+              "reverify_frames": reverify, "ok": False}
+    failures: list = []
+
+    def build(drift_threshold=None, svc_tracer=None):
+        metrics = Metrics()
+        pipeline = InstantPipeline(frame_hw, cascade_stub=True,
+                                   video_oracle=True)
+        connector = FakeConnector()
+        kwargs = {"reverify_frames": reverify}
+        if drift_threshold is not None:
+            kwargs["drift_threshold"] = drift_threshold
+        tracker = IdentityTracker(TrackerConfig(**kwargs), metrics=metrics)
+        service = RecognizerService(
+            pipeline, connector, batch_size=4, frame_shape=frame_hw,
+            flush_timeout=0.01, inflight_depth=2,
+            similarity_threshold=0.0, metrics=metrics, tracer=svc_tracer,
+            bucket_sizes=(1, 2, 4), cascade=True, subject_names=names,
+            tracker=tracker)
+        pipeline.prewarm_batch_shapes(service._bucket_ladder, frame_hw,
+                                      service.batcher.dtype)
+        service._warmed = True
+        results = []
+        connector.subscribe(RESULT_TOPIC,
+                            lambda t, m: results.append(m))
+        service.start(warmup=False)
+        return service, connector, metrics, tracker, results
+
+    def drive(service, connector, frames, start_seq, where):
+        """Closed-loop offer: one frame, one drain — full determinism."""
+        for i, (frame, key, _k) in enumerate(frames):
+            connector.inject(FRAME_TOPIC, {
+                "frame": frame,
+                "meta": {"seq": start_seq + i, "stream": key}})
+            if not service.drain(timeout=10.0):
+                failures.append(f"{where}: drain wedged at frame "
+                                f"{start_seq + i}")
+                return False
+        return True
+
+    def cached_of(results, label=None, min_seq=None):
+        out = []
+        for m in results:
+            if m.get("exit") != "track_cache":
+                continue
+            if min_seq is not None and m["meta"]["seq"] < min_seq:
+                continue
+            if label is not None and not any(
+                    f["label"] == label for f in m["faces"]):
+                continue
+            out.append(m["meta"]["seq"])
+        return out
+
+    def check_ledger(service, where):
+        ledger = service.ledger()
+        drops = sum(ledger["drops_by_reason"].values())
+        settled = (ledger["completed"] + ledger["completed_empty"]
+                   + ledger["completed_cached"] + drops)
+        if ledger["admitted"] != settled or ledger["in_system"] != 0:
+            failures.append(f"{where}: extended ledger not exact: {ledger}")
+        return ledger
+
+    # -- arm 1: identity swap with the drift check armed (traced) --
+    service, conn, metrics, _tracker, results = build(svc_tracer=tracer)
+    stream = synthetic_video_stream(
+        n_frames, frame_hw, streams=1, coherence=1.0,
+        identity_swap_at=swap_at, seed=seed % 100003)
+    quiesced = drive(service, conn, stream, 0, "swap/drift")
+    service.stop()
+    # The generator's first identity is 0; the in-place swap moves it to
+    # 1 — a cached publish of label 0 at or past the swap frame IS the
+    # stale serve the drift check exists to prevent (the swap frame
+    # itself counts: its content is already the new identity when the
+    # lookup runs).
+    stale = cached_of(results, label=0, min_seq=swap_at)
+    warm = cached_of(results, min_seq=None)
+    if not warm or (warm and min(warm) > swap_at):
+        failures.append("swap/drift: cache never engaged before the swap")
+    if stale:
+        failures.append(f"swap/drift: stale identity served from cache "
+                        f"after the swap at seqs {stale[:5]}")
+    if metrics.counter(mn.TRACK_FLUSHES_PREFIX + "identity") < 1:
+        failures.append("swap/drift: no identity flush recorded")
+    ledger = check_ledger(service, "swap/drift")
+    report["swap_drift"] = {
+        "frames": n_frames, "swap_at": swap_at,
+        "cached_total": len(warm), "stale_after_swap": len(stale),
+        "identity_flushes": int(metrics.counter(
+            mn.TRACK_FLUSHES_PREFIX + "identity")),
+        "reverifies": int(metrics.counter(mn.TRACK_REVERIFIES)),
+    }
+
+    # -- arm 2: same swap, drift DISABLED -> the window is the bound --
+    service2, conn2, _m2, _t2, results2 = build(drift_threshold=1e9)
+    stream2 = synthetic_video_stream(
+        n_frames, frame_hw, streams=1, coherence=1.0,
+        identity_swap_at=swap_at, seed=seed % 100003)
+    drive(service2, conn2, stream2, 0, "swap/window")
+    service2.stop()
+    stale2 = cached_of(results2, label=0, min_seq=swap_at)
+    recovered = cached_of(results2, label=1, min_seq=swap_at + 1)
+    if stale2 and max(stale2) > swap_at + reverify:
+        failures.append(
+            f"swap/window: stale identity served PAST the re-verify "
+            f"window (seq {max(stale2)} > {swap_at + reverify})")
+    if not recovered:
+        failures.append("swap/window: cache never recovered onto the "
+                        "new identity after the verify")
+    check_ledger(service2, "swap/window")
+    report["swap_window"] = {
+        "stale_within_window": len(stale2),
+        "last_stale_seq": max(stale2) if stale2 else None,
+        "window_edge_seq": swap_at + reverify,
+        "recovered_cached": len(recovered),
+    }
+
+    # -- arm 3: nested faces -> ambiguity flushes BOTH --
+    # Two live tracks over the IoU ceiling, neither failing the identity
+    # cross-check: a smaller face moves INSIDE a larger one (think a
+    # face passing in front of a close-up). The big blob's border ring
+    # stays visible so its detected box stays full-size; the nested box
+    # overlaps it at IoU ~0.69 while both faces keep matching their own
+    # tracks — only the ambiguity sweep can catch this. The contract is
+    # the bounded one the cache is designed around: the overlap is
+    # detected on the next FULL-path frame (drift-forced, or at latest
+    # the scheduled re-verify), BOTH tracks flush, and the cache stays
+    # off for the rest of the overlap — so no cached serve can land
+    # more than one re-verify interval past the merge. (Whether the
+    # march's drift trips early is noise-sensitive — a stale track box
+    # over a sliding fill straddles the median threshold — so the
+    # window edge, not the merge frame, is the assertable line.)
+    service3, conn3, m3, _t3, results3 = build()
+    rng = np.random.default_rng(seed ^ 0x5EED)
+    path = ([(10, 36)] * 6                       # separate: confirm + cache
+            + [(10, 28), (10, 20), (12, 12), (12, 6)]  # march inside
+            + [(12, 6)] * (reverify + 4))        # hold nested past the window
+    merge_seq = 9                                # (12, 6) first nests here
+    conv = []
+    for yb, xb in path:
+        frame = rng.integers(20, 90, size=frame_hw).astype(np.uint8)
+        frame[10:34, 4:28] = 160                 # identity 0: 24x24, static
+        frame[yb:yb + 20, xb:xb + 20] = 184      # identity 1: 20x20, moving
+        conv.append((frame, "cam0", 2))
+    drive(service3, conn3, conv, 0, "ambiguity")
+    service3.stop()
+    amb_flushes = int(m3.counter(mn.TRACK_FLUSHES_PREFIX + "ambiguity"))
+    if amb_flushes < 2:
+        failures.append(f"ambiguity: expected both tracks flushed, got "
+                        f"{amb_flushes} ambiguity flushes")
+    overlapped = cached_of(results3, min_seq=merge_seq + reverify + 1)
+    if overlapped:
+        failures.append(f"ambiguity: cached serve past the re-verify "
+                        f"window edge (seq {merge_seq + reverify}), "
+                        f"seqs {overlapped[:5]}")
+    warm3 = cached_of(results3)
+    if not warm3 or min(warm3) > merge_seq:
+        failures.append("ambiguity: cache never engaged before the merge")
+    check_ledger(service3, "ambiguity")
+    report["ambiguity"] = {"flushes": amb_flushes,
+                           "cached_before_merge": len(warm3),
+                           "cached_past_window": len(overlapped)}
+
+    # -- arm 4: replica kill -> failover cold-start + version fence --
+    svc_a, conn_a, _ma, _ta, res_a = build()
+    svc_b, conn_b, mb, _tb, res_b = build()
+    # Stamp a concrete embedder version on B before it serves: entries
+    # verified under version None are fence-exempt by design (the fence
+    # only fires on a MISMATCH of known versions), and the cutover
+    # sub-check below needs stamped entries to invalidate.
+    svc_b.pipeline.gallery.embedder_version = 1
+    half = max(16, n_frames // 2)
+    stream4 = synthetic_video_stream(2 * half, frame_hw, streams=1,
+                                     coherence=1.0, seed=(seed + 7) % 100003)
+    drive(svc_a, conn_a, stream4[:half], 0, "failover/A")
+    svc_a.stop()  # the kill: rendezvous routing re-pins the topic to B
+    hot_a = cached_of(res_a)
+    if not hot_a:
+        failures.append("failover/A: cache never engaged before the kill")
+    drive(svc_b, conn_b, stream4[half:], half, "failover/B")
+    cached_b = cached_of(res_b)
+    # Cold start: B cannot serve from cache until its own tracker has
+    # confirmed the track from full frames (confirm_hits=2) — the first
+    # two frames after failover MUST be full-path.
+    early = [s for s in cached_b if s < half + 2]
+    if early:
+        failures.append(f"failover/B: cached serve before the cold "
+                        f"cache could have confirmed (seqs {early})")
+    if not cached_b:
+        failures.append("failover/B: cache never re-engaged after "
+                        "failover")
+    # Embedder-version fence: a cutover bump on B's gallery must flush
+    # its tracks (reason ``version``) instead of serving entries
+    # verified under the old embedder.
+    svc_b.pipeline.gallery.embedder_version = 2
+    tail_seq = half + len(stream4[half:])
+    extra = synthetic_video_stream(6, frame_hw, streams=1, coherence=1.0,
+                                   seed=(seed + 7) % 100003)
+    drive(svc_b, conn_b, extra, tail_seq, "failover/version")
+    svc_b.stop()
+    if int(mb.counter(mn.TRACK_FLUSHES_PREFIX + "version")) < 1:
+        failures.append("failover/version: no version flush after the "
+                        "embedder bump")
+    check_ledger(svc_a, "failover/A")
+    check_ledger(svc_b, "failover/B")
+    report["failover"] = {
+        "a_cached": len(hot_a), "b_cached": len(cached_b),
+        "b_first_cached_seq": min(cached_b) if cached_b else None,
+        "version_flushes": int(mb.counter(
+            mn.TRACK_FLUSHES_PREFIX + "version")),
+    }
+
+    # -- observability: arm 1's dump mirrors the extended ledger --
+    _finish_observability(tracer, trace_dir, "video_end", ledger,
+                          quiesced, failures, report)
+
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--seconds", type=float, default=10.0)
@@ -2724,7 +3019,8 @@ def main(argv=None) -> int:
                         help="replay a previous run exactly (logged on stderr)")
     parser.add_argument("--scenario", choices=["soak", "overload", "recovery",
                                                "replication", "rollout",
-                                               "disk", "partition"],
+                                               "disk", "partition",
+                                               "video"],
                         default="soak",
                         help="soak: randomized fault soak (default); "
                              "overload: 4x flood against the admission/"
@@ -2752,7 +3048,16 @@ def main(argv=None) -> int:
                              "link, duplicate storm, half-open writer; "
                              "assert bounded failover, hedge rescue, "
                              "exactly-once delivery, exact ledgers, "
-                             "split-brain fail-closed (run_partition)")
+                             "split-brain fail-closed (run_partition); "
+                             "video: the temporal identity cache under "
+                             "attack — in-place identity swap with the "
+                             "drift check armed (zero stale) and disabled "
+                             "(stale bounded by the re-verify window), "
+                             "ambiguity flushing both tracks, replica "
+                             "kill + failover cold-start, embedder-"
+                             "version fence; exact extended ledgers and "
+                             "span accounting incl. completed_cached "
+                             "(run_video)")
     parser.add_argument("--journal", default=None,
                         help="overload scenario: write the dead-letter "
                              "journal here instead of a temp file")
@@ -2778,6 +3083,9 @@ def main(argv=None) -> int:
     elif args.scenario == "partition":
         report = run_partition(seconds=args.seconds, seed=args.seed,
                                state_dir=args.state_dir)
+    elif args.scenario == "video":
+        report = run_video(seconds=args.seconds, seed=args.seed,
+                           state_dir=args.state_dir)
     else:
         report = run_soak(seconds=args.seconds, seed=args.seed)
     print(json.dumps(report, indent=2, default=str))

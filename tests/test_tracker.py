@@ -1,0 +1,393 @@
+"""Temporal identity cache (ISSUE 17): the ``IdentityTracker`` unit
+contract (confirmation, re-verify window + brownout stretch, median-
+signature drift, embedder-version fence, ambiguity sweep, miss aging,
+teleport re-acquisition), the synthetic video generator + oracle, the
+serving gate's ``completed_cached`` ledger settlement, the fast seed-7
+chaos-video variant, and the registry/bench plumbing."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+from opencv_facerecognizer_tpu.runtime.connector import FakeConnector
+from opencv_facerecognizer_tpu.runtime.fakes import (
+    InstantPipeline,
+    synthetic_video_stream,
+)
+from opencv_facerecognizer_tpu.runtime.recognizer import (
+    FRAME_TOPIC,
+    RESULT_TOPIC,
+    RecognizerService,
+)
+from opencv_facerecognizer_tpu.runtime.tracker import (
+    IdentityTracker,
+    TrackerConfig,
+)
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+HW = (64, 64)
+CAM = "cam0"
+
+
+def _frame(box=(10, 8, 26, 24), value=160.0, seed=0):
+    """Noise background + one identity blob (the oracle encoding:
+    ``160 + 24 * label``)."""
+    frame = np.random.default_rng(seed).integers(
+        20, 90, size=HW).astype(np.uint8).astype(np.float32)
+    if box is not None:
+        y0, x0, y1, x1 = box
+        frame[y0:y1, x0:x1] = value
+    return frame
+
+
+def _face(box=(10, 8, 26, 24), label=0, name="id0", sim=0.9, det=0.9):
+    """Publish-path face dict (x-first box), as ``update`` consumes."""
+    y0, x0, y1, x1 = box
+    return {"box": [x0, y0, x1, y1], "label": label, "name": name,
+            "similarity": sim, "detection_score": det}
+
+
+def _tracker(metrics=None, **cfg):
+    cfg.setdefault("reverify_frames", 4)
+    return IdentityTracker(TrackerConfig(**cfg),
+                           metrics=metrics or Metrics())
+
+
+def _confirm(tracker, box=(10, 8, 26, 24), label=0, value=160.0,
+             version=None):
+    """Two full frames: seed + confirm one track (confirm_hits=2)."""
+    frame = _frame(box, value)
+    for _ in range(2):
+        tracker.update(CAM, [_face(box, label)], frame,
+                       embedder_version=version)
+    return frame
+
+
+# ---- unit: lifecycle, window, drift, fences --------------------------------
+
+
+def test_lookup_requires_confirmation_then_hits():
+    tracker = _tracker()
+    frame = _frame()
+    assert tracker.lookup(CAM, frame) is None          # no tracks yet
+    tracker.update(CAM, [_face()], frame)
+    assert tracker.lookup(CAM, frame) is None          # tentative
+    tracker.update(CAM, [_face()], frame)
+    hit = tracker.lookup(CAM, frame)
+    assert hit is not None
+    face = hit["faces"][0]
+    # Payload shaped exactly like the publish path's, plus track_id.
+    assert face["box"] == [8.0, 10.0, 24.0, 26.0]      # x-first
+    assert face["label"] == 0 and face["name"] == "id0"
+    assert face["track_id"] == hit["track_id"]
+    assert tracker.stats()["tracks_live"] == 1
+
+
+def test_reverify_window_and_brownout_stretch():
+    tracker = _tracker(reverify_frames=4)
+    frame = _confirm(tracker)
+    hits = sum(tracker.lookup(CAM, frame) is not None for _ in range(6))
+    assert hits == 3                                   # interval 4: 3 cached
+    assert tracker.metrics.counter(mn.TRACK_REVERIFIES) == 1
+    # The window edge parks the track until the next FULL frame...
+    assert tracker.lookup(CAM, frame) is None
+    tracker.update(CAM, [_face()], frame)
+    # ...and a brownout stretch of 2.0 doubles the cached run.
+    hits = sum(tracker.lookup(CAM, frame, reverify_stretch=2.0) is not None
+               for _ in range(10))
+    assert hits == 7
+
+
+def test_drift_flags_identity_swap_but_tolerates_motion():
+    tracker = _tracker(reverify_frames=100)
+    frame = _confirm(tracker)
+    # Ordinary 1px motion: only edge cells of the pooled signature move,
+    # the MEDIAN stays ~0 — still a hit.
+    assert tracker.lookup(CAM, _frame((10, 9, 26, 25))) is not None
+    # In-place identity swap (same box, new fill): every cell moves by
+    # the full label delta — forced verify on this very frame.
+    assert tracker.lookup(CAM, _frame(value=232.0)) is None
+    assert tracker.metrics.counter(mn.TRACK_REVERIFIES) >= 1
+    # Parked (never served stale) until a full frame re-verifies; the
+    # verify flushes the old identity and seeds the new one, which must
+    # confirm (two full frames) before it serves.
+    assert tracker.lookup(CAM, _frame(value=232.0)) is None
+    tracker.update(CAM, [_face(label=3, name="id3")], _frame(value=232.0))
+    assert tracker.metrics.counter(
+        mn.TRACK_FLUSHES_PREFIX + "identity") == 1
+    tracker.update(CAM, [_face(label=3, name="id3")], _frame(value=232.0))
+    hit = tracker.lookup(CAM, _frame(value=232.0))
+    assert hit is not None and hit["faces"][0]["label"] == 3
+
+
+def test_embedder_version_fence_flushes():
+    tracker = _tracker(reverify_frames=100)
+    frame = _confirm(tracker, version=1)
+    assert tracker.lookup(CAM, frame, embedder_version=1) is not None
+    # Cutover: entries stamped v1 are dead on arrival under v2.
+    assert tracker.lookup(CAM, frame, embedder_version=2) is None
+    assert tracker.metrics.counter(
+        mn.TRACK_FLUSHES_PREFIX + "version") == 1
+    assert tracker.stats()["tracks_live"] == 0
+
+
+def test_ambiguity_flushes_both_tracks():
+    tracker = _tracker()
+    a, b = (10, 4, 34, 28), (10, 36, 30, 56)
+    frame = _frame(a)
+    frame[10:30, 36:56] = 184.0
+    for _ in range(2):
+        tracker.update(CAM, [_face(a, 0), _face(b, 1, "id1")], frame)
+    assert tracker.lookup(CAM, frame) is not None
+    # The small face moves inside the big one (IoU ~0.69 > ceiling):
+    # neither fails the identity check, only the sweep catches it —
+    # BOTH flush, before either can capture the other's identity.
+    nested = (12, 6, 32, 26)
+    tracker.update(CAM, [_face(a, 0), _face(nested, 1, "id1")], frame)
+    assert tracker.metrics.counter(
+        mn.TRACK_FLUSHES_PREFIX + "ambiguity") == 2
+    assert tracker.stats()["tracks_live"] == 0
+
+
+def test_note_miss_parks_then_ttl_flushes_lost():
+    tracker = _tracker(reverify_frames=100)
+    frame = _confirm(tracker)
+    tracker.note_miss(CAM)
+    # Occlusion parks the track out of the cache without burning it...
+    assert tracker.lookup(CAM, frame) is None
+    tracker.update(CAM, [_face()], frame)
+    assert tracker.lookup(CAM, frame) is not None
+    # ...but past the TTL (miss_ttl=2) the subject is gone: flush lost.
+    for _ in range(3):
+        tracker.note_miss(CAM)
+    assert tracker.metrics.counter(mn.TRACK_FLUSHES_PREFIX + "lost") == 1
+    assert tracker.stats()["tracks_live"] == 0
+
+
+def test_reacquisition_after_teleport_keeps_confirmed_state():
+    tracker = _tracker(reverify_frames=100)
+    _confirm(tracker)
+    # The subject teleports (admission drop gap, scene cut): no IoU, no
+    # centroid reach — but the FULL pipeline just verified this label at
+    # the new box, so the unique unmatched track re-seeds there instead
+    # of orphaning + cold-starting.
+    far = (40, 40, 56, 56)
+    tracker.update(CAM, [_face(far, 0)], _frame(far))
+    reg = tracker.registry()
+    assert len(reg) == 1 and reg[0]["confirmed"]
+    assert reg[0]["box"] == [40.0, 40.0, 56.0, 56.0]
+    assert tracker.lookup(CAM, _frame(far)) is not None
+
+
+def test_flush_all_cold_starts():
+    tracker = _tracker()
+    frame = _confirm(tracker)
+    assert tracker.flush_all() == 1
+    assert tracker.lookup(CAM, frame) is None
+    assert tracker.metrics.counter(mn.TRACK_FLUSHES_PREFIX + "reset") == 1
+
+
+# ---- video generator + oracle ----------------------------------------------
+
+
+def test_synthetic_video_stream_deterministic_and_coherent():
+    a = synthetic_video_stream(30, HW, streams=2, coherence=0.9, seed=3)
+    b = synthetic_video_stream(30, HW, streams=2, coherence=0.9, seed=3)
+    assert len(a) == 30
+    for (fa, ka, na), (fb, kb, nb) in zip(a, b):
+        assert ka == kb and na == nb
+        np.testing.assert_array_equal(fa, fb)
+    assert {k for _f, k, _n in a} == {"cam0", "cam1"}
+    # Identity blobs use the oracle encoding (160 + 24 * label).
+    for frame, _k, n in a:
+        if n:
+            vals = set(np.unique(frame[frame >= 150]).tolist())
+            assert vals <= {160, 184, 208, 232}
+
+
+def test_synthetic_video_stream_identity_swap_in_place():
+    rows = synthetic_video_stream(12, HW, coherence=1.0, seed=5,
+                                  identity_swap_at=6)
+    def blob_val(frame):
+        return int(frame[frame >= 150].max())
+    before, after = blob_val(rows[5][0]), blob_val(rows[6][0])
+    assert before != after                             # identity changed
+
+
+def test_instant_pipeline_video_oracle_decodes_labels():
+    pipeline = InstantPipeline(HW, cascade_stub=True, video_oracle=True)
+    # The oracle is what lets tests assert identity CORRECTNESS, not
+    # just settlement: label = (fill - 160) / 24 at the blob's bbox.
+    batch = np.stack([_frame(value=160.0), _frame(value=208.0)])
+    packed = np.asarray(pipeline.recognize_batch_packed(batch))
+    from opencv_facerecognizer_tpu.parallel.pipeline import unpack_result
+    result = unpack_result(packed, pipeline.top_k)
+    assert bool(result.valid[0, 0]) and bool(result.valid[1, 0])
+    assert int(result.labels[0, 0, 0]) == 0
+    assert int(result.labels[1, 0, 0]) == 2
+
+
+# ---- serving gate: completed_cached settlement -----------------------------
+
+
+def _service(tracker):
+    metrics = tracker.metrics
+    pipeline = InstantPipeline(HW, cascade_stub=True, video_oracle=True)
+    connector = FakeConnector()
+    service = RecognizerService(
+        pipeline, connector, batch_size=4, frame_shape=HW,
+        flush_timeout=0.01, inflight_depth=2, similarity_threshold=0.0,
+        metrics=metrics, bucket_sizes=(1, 2, 4), cascade=True,
+        subject_names=["id0", "id1", "id2", "id3"], tracker=tracker)
+    pipeline.prewarm_batch_shapes(service._bucket_ladder, HW,
+                                  service.batcher.dtype)
+    service._warmed = True
+    return service, connector
+
+
+def test_service_settles_cache_hits_as_completed_cached():
+    tracker = _tracker(reverify_frames=6)
+    service, connector = _service(tracker)
+    results = []
+    connector.subscribe(RESULT_TOPIC, lambda t, m: results.append(m))
+    service.start(warmup=False)
+    rows = synthetic_video_stream(24, HW, coherence=1.0, seed=1)
+    for i, (frame, key, _n) in enumerate(rows):
+        connector.inject(FRAME_TOPIC, {"frame": frame,
+                                       "meta": {"seq": i, "stream": key}})
+        assert service.drain(timeout=20.0)
+    service.stop()
+    ledger = service.ledger()
+    assert ledger["completed_cached"] > 0 and ledger["completed"] > 0
+    drops = sum(ledger["drops_by_reason"].values())
+    # The extended invariant: every admitted frame lands in exactly one
+    # terminal bucket, cached included.
+    assert ledger["admitted"] == (ledger["completed"]
+                                  + ledger["completed_empty"]
+                                  + ledger["completed_cached"] + drops)
+    assert ledger["in_system"] == 0
+    assert len(results) == 24
+    cached = [m for m in results if m.get("exit") == "track_cache"]
+    assert len(cached) == ledger["completed_cached"]
+    full_label = next(m for m in results
+                      if m.get("exit") is None)["faces"][0]["label"]
+    for m in cached:
+        assert "track_id" in m
+        assert m["faces"][0]["label"] == full_label  # never a wrong identity
+    assert tracker.metrics.counter(mn.TRACK_BATCH_EXITS) >= 0
+
+
+def test_service_without_stream_key_takes_full_path():
+    tracker = _tracker()
+    service, connector = _service(tracker)
+    service.start(warmup=False)
+    for i in range(8):
+        connector.inject(FRAME_TOPIC, {"frame": _frame(seed=i),
+                                       "meta": {"seq": i}})
+        assert service.drain(timeout=20.0)
+    service.stop()
+    ledger = service.ledger()
+    # No stream identity -> no temporal coherence to exploit: the cache
+    # must stand aside, not guess.
+    assert ledger["completed_cached"] == 0
+    assert ledger["completed"] == 8
+
+
+# ---- chaos: the fast seed-7 video variant ----------------------------------
+
+REPO_ROOT = os.path.join(os.path.dirname(__file__), "..")
+_spec = importlib.util.spec_from_file_location(
+    "chaos_soak_video", os.path.join(REPO_ROOT, "scripts", "chaos_soak.py"))
+chaos_soak = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(chaos_soak)
+
+
+def test_chaos_video_fast_deterministic():
+    """Seed-7 tier-1 variant of ``--scenario video``: identity swap with
+    the drift check armed (zero stale) and disabled (stale bounded by
+    the re-verify window), ambiguity flushing both, failover cold-start
+    + version fence, exact extended ledgers and span accounting."""
+    report = chaos_soak.run_video(seconds=1.0, seed=7)
+    assert report["ok"], report["failures"]
+    assert report["swap_drift"]["stale_after_swap"] == 0
+    assert report["swap_drift"]["cached_total"] > 0
+    assert report["ambiguity"]["flushes"] >= 2
+    assert report["ambiguity"]["cached_past_window"] == 0
+    assert report["failover"]["version_flushes"] >= 1
+    acct = report["span_accounting"]
+    assert acct["completed_cached"] > 0
+    assert acct["traced"] == (acct["completed"] + acct["completed_empty"]
+                              + acct["completed_cached"]
+                              + sum(acct["drops"].values()))
+
+
+# ---- registry / plumbing ---------------------------------------------------
+
+
+def test_track_metric_names_registered():
+    names = set(mn.all_names())
+    for name in (mn.TRACK_LOOKUPS, mn.TRACK_CACHE_HITS,
+                 mn.TRACK_CACHE_HIT_RATE, mn.TRACK_REVERIFIES,
+                 mn.TRACK_BATCH_EXITS, mn.TRACK_ERRORS,
+                 mn.FRAMES_COMPLETED_CACHED):
+        assert name in names
+    assert mn.TRACK_FLUSHES_PREFIX in set(mn.all_prefixes())
+    from tools.ocvf_lint.wiring import ATTR_HINTS, HOT_PATH_SUFFIXES
+
+    assert ATTR_HINTS["tracker"] == "IdentityTracker"
+    assert any(s.endswith("runtime/tracker.py") for s in HOT_PATH_SUFFIXES)
+
+
+def test_expo_tracks_endpoint_and_null_shape():
+    import urllib.request
+
+    from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
+
+    tracker = _tracker()
+    _confirm(tracker)
+
+    class _Svc:  # the expo surface only reads .tracker
+        pass
+
+    svc = _Svc()
+    svc.tracker = tracker
+    expo = ExpoServer(metrics=Metrics(), service=svc, port=0)
+    expo.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{expo.host}:{expo.port}/tracks", timeout=5) as r:
+            body = json.loads(r.read())
+        assert len(body["tracks"]) == 1
+        assert body["tracks"][0]["confirmed"]
+        assert body["stats"]["tracks_live"] == 1
+    finally:
+        expo.stop()
+    # Unwired tracker answers the null shape, not a 404.
+    bare = ExpoServer(metrics=Metrics(), port=0)
+    bare.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{bare.host}:{bare.port}/tracks", timeout=5) as r:
+            assert json.loads(r.read())["tracks"] is None
+    finally:
+        bare.stop()
+
+
+def test_bench_compare_tracks_video_uplift():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(REPO_ROOT, "scripts",
+                                      "bench_compare.py"))
+    bench_compare = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_compare)
+    assert "video_cache_uplift" in bench_compare.METRICS
+    doc = {"video": {"cells": {"c90": {"uplift": 2.5}}}}
+    extract = bench_compare.METRICS["video_cache_uplift"][0]
+    assert extract(doc) == 2.5
+    # Regression direction: candidate losing the uplift fails.
+    report = bench_compare.compare(doc, {"video": {"cells": {
+        "c90": {"uplift": 1.0}}}})
+    assert any(r["metric"] == "video_cache_uplift"
+               and r["verdict"] == "regression" for r in report["metrics"])

@@ -33,7 +33,8 @@ REGISTRY_SUFFIX = "utils/metric_names.py"
 #: checked when the receiver looks like a Metrics surface.
 NAME_METHODS = frozenset({"incr", "observe", "set_gauge", "counter",
                           "percentile", "counters_with_prefix",
-                          "_count"})  # the connectors' None-guarded shim
+                          # the connectors' and tracker's None-guarded shims
+                          "_count", "_incr"})
 GENERIC_METHODS = frozenset({"counter", "percentile"})
 
 

@@ -65,6 +65,10 @@ ATTR_HINTS: Dict[str, str] = {
     "link": "TopicRouter",
     "hedge": "TopicRouter",
     "_faults": "FaultInjector",
+    # Temporal identity cache (ISSUE 17): the service's ``self.tracker``
+    # is the per-replica track -> identity cache consulted on the
+    # dispatch thread and updated on the readback worker.
+    "tracker": "IdentityTracker",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -79,6 +83,10 @@ HOT_PATH_SUFFIXES: Tuple[str, ...] = (
     # a stray blocking sync in the model module would land on the
     # dispatch path, so it is scanned like the rest of the hot loop.
     "models/cascade.py",
+    # The temporal identity cache (ISSUE 17) runs per serving batch on
+    # the dispatch AND readback threads: pure host NumPy by contract —
+    # any device sync sneaking in here would stall the serving loop.
+    "runtime/tracker.py",
 )
 
 #: Modules that OWN the epoch-pairing protocol (PR 6): only they may touch
