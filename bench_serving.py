@@ -1584,6 +1584,243 @@ def run_rollout_smoke(seconds: float = 2.0, batch_size: int = 8,
     return out
 
 
+def run_registry_smoke(seconds: float = 2.0, batch_size: int = 8,
+                       frame_hw=(32, 32), dispatch_s: float = 0.01,
+                       topics: int = 12, offered_hz: float = 60.0,
+                       n_rows: int = 16, seed: int = 7):
+    """Versioned model-registry smoke (ISSUE 18): the same 3-replica
+    fleet as the rollout smoke serves steady traffic while the writer
+    swaps the DETECTOR through the registry — live detection-parity
+    window fed from the publish path, ``registry_cutover`` WAL fence,
+    atomic manifest install, replica re-anchor. No re-embed: gallery
+    rows are untouched. The load-bearing numbers:
+
+    - ``parity_agreement``: detection agreement (box-overlap verdict
+      match) between serving and candidate detector on the live sampled
+      window — the gate the swap is allowed through (>= 0.98);
+    - ``swap_window_completed_ratio`` / ``swap_window_max_gap_s``: the
+      serving-never-blanks numbers through the fence + re-anchor window;
+    - ``recompiles_post_warmup``: fleet-wide recompile-watchdog trips —
+      model params are jit ARGUMENTS, so a same-architecture swap must
+      keep every compile cache warm (0 is the gate).
+
+    ``registry_ok`` gates the smoke's exit code;
+    ``scripts/bench_compare.py`` tracks the parity + ratio numbers
+    (baseline-predates skip for older artifacts)."""
+    import os
+    import shutil
+    import tempfile
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        FakeConnector, ModelRegistry, ReadReplica, RecognizerService,
+        RegistrySwapCoordinator, ReplicaHandle, ResiliencePolicy,
+        StateLifecycle, TopicRouter, WriterLease, registry_params_path,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, TrafficRecorder,
+    )
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        service_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils import metric_names as mn
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    DIM = 8
+    rng = np.random.default_rng(seed)
+    mesh = make_mesh()
+    state_dir = tempfile.mkdtemp(prefix="ocvf_registry_bench_")
+
+    # Synthetic detectors over the smoke frames. The live parity window
+    # reuses the SERVING pipeline's published verdict boxes as the old
+    # side (the publish path already paid for them), and InstantPipeline
+    # scripts its face at (2, 2, h-2, w-2) — so v1 matches it exactly
+    # and the candidate agrees at IoU ~0.87 (the parity window's
+    # verdict-match definition is what is under test, not a real CNN).
+    def detect_v1(frame):
+        del frame
+        return [(2.0, 2.0, 30.0, 30.0)]
+
+    def detect_v2(frame):
+        del frame
+        return [(3.0, 3.0, 31.0, 31.0)]
+
+    writer_metrics = Metrics()
+    lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+    gallery = ShardedGallery(capacity=256, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(state_dir, metrics=writer_metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9)
+    state.attach_registry(ModelRegistry(state_dir, metrics=writer_metrics))
+    state.bind(gallery, names)
+    for i in range(n_rows):
+        emb = rng.normal(size=(1, DIM)).astype(np.float32)
+        names.append(f"s{i}")
+        state.append_enrollment(
+            emb, np.full(1, i, np.int32), subject=f"s{i}", label=i,
+            apply_fn=lambda e=emb, i=i: gallery.add(
+                e, np.full(1, i, np.int32)))
+    state.checkpoint_now(wait=True)
+
+    def make_service(g, metrics, registry=None, replica=None):
+        pipe = InstantPipeline(frame_hw, dispatch_s=dispatch_s,
+                               faces_per_frame=1)
+        pipe.gallery = g
+        svc = RecognizerService(
+            pipe, FakeConnector(), batch_size=batch_size,
+            frame_shape=frame_hw, flush_timeout=0.02, inflight_depth=2,
+            similarity_threshold=0.0, metrics=metrics,
+            resilience=ResiliencePolicy(readback_deadline_s=2.0),
+            replica=replica)
+        svc.registry = registry
+        return svc
+
+    writer_svc = make_service(gallery, writer_metrics,
+                              registry=state.registry)
+    readers = []
+    for i in range(2):
+        rmetrics = Metrics()
+        rgallery = ShardedGallery(capacity=256, dim=DIM, mesh=mesh)
+        rep = ReadReplica(state_dir, rgallery, [], metrics=rmetrics,
+                          poll_interval_s=0.02, name=f"reader-{i}")
+        rep.registry = ModelRegistry(state_dir, metrics=rmetrics,
+                                     readonly=True)
+        rep.poll(force=True)
+        svc = make_service(rgallery, rmetrics, registry=rep.registry,
+                           replica=rep)
+        rep.on_registry_change = svc.flush_model_caches
+        readers.append({"replica": rep, "gallery": rgallery,
+                        "svc": svc, "metrics": rmetrics})
+    router_metrics = Metrics()
+    handles = [ReplicaHandle("writer", writer_svc.connector,
+                             health_fn=service_health_probe(writer_svc),
+                             writer=True)]
+    for i, reader in enumerate(readers):
+        handles.append(ReplicaHandle(
+            f"reader-{i}", reader["svc"].connector,
+            health_fn=service_health_probe(reader["svc"])))
+    router = TopicRouter(handles, metrics=router_metrics,
+                         health_interval_s=0.05)
+    for i, reader in enumerate(readers):
+        reader["replica"].on_resync = router.cordon_hook(f"reader-{i}")
+    recorder = TrafficRecorder(router)
+    frame_msg = encode_frame(np.zeros(frame_hw, np.float32))
+    seq_box = {"seq": 0}
+
+    def pump(duration_s):
+        interval = 1.0 / offered_hz
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            seq = seq_box["seq"]
+            seq_box["seq"] = seq + 1
+            recorder.send_t[seq] = time.monotonic()
+            router.publish(f"camera/{seq % topics}",
+                           {**frame_msg, "meta": {"seq": seq}})
+            time.sleep(interval)
+
+    def completions_in(t0, t1):
+        return sum(1 for t in recorder.done_t.values() if t0 <= t <= t1)
+
+    out = {"note": ("writer + 2 read replicas behind the rendezvous "
+                    "router under steady offered load; the writer swaps "
+                    "the detector through the versioned model registry "
+                    "(live detection-parity gate -> WAL fence -> atomic "
+                    "manifest install -> replica re-anchor) mid-traffic. "
+                    "No re-embed; params are jit arguments, so the swap "
+                    "must trip the recompile watchdog exactly zero "
+                    "times."),
+           "config": {"offered_hz": offered_hz, "topics": topics,
+                      "rows": n_rows, "seconds": seconds}}
+    try:
+        writer_svc.start(warmup=False)
+        for reader in readers:
+            reader["svc"].start(warmup=False)
+        router.start()
+        steady_t0 = time.monotonic()
+        pump(max(1.0, seconds / 2))
+        steady_t1 = time.monotonic()
+        steady_hz = completions_in(steady_t0, steady_t1) / (
+            steady_t1 - steady_t0)
+
+        params_path = registry_params_path(state_dir, "detector", 2)
+        os.makedirs(os.path.dirname(params_path), exist_ok=True)
+        with open(params_path, "wb") as fh:
+            fh.write(b"detector-v2-smoke-params" * 64)
+        coordinator = RegistrySwapCoordinator(
+            state, state.registry, "detector", 2,
+            old_detect_fn=detect_v1, new_detect_fn=detect_v2,
+            params_path=params_path, parity_min_samples=12,
+            live_sample_interval_s=0.01,
+            flush_fn=writer_svc.flush_model_caches,
+            metrics=writer_metrics)
+        # Live window: the publish path samples frames into the
+        # coordinator; the driver drains + scores them off-path.
+        writer_svc.registry_swap = coordinator
+        parity_deadline = time.monotonic() + 10.0
+        while (not coordinator.parity_ok()
+               and time.monotonic() < parity_deadline):
+            pump(0.1)
+            coordinator.drain_live()
+        out["parity_agreement"] = (coordinator.parity.agreement
+                                   if coordinator.parity else None)
+        out["parity_samples"] = (coordinator.parity.samples
+                                 if coordinator.parity else 0)
+        swap_t0 = time.monotonic()
+        coordinator.cutover()
+        writer_svc.registry_swap = None
+        deadline = time.monotonic() + 15.0
+        while (any((r["replica"].stats()["registry"] or {})
+                   .get("detector") != 2 for r in readers)
+               and time.monotonic() < deadline):
+            pump(0.1)
+        pump(max(0.5, seconds / 4))  # post-re-anchor tail
+        swap_t1 = time.monotonic()
+        swap_hz = completions_in(swap_t0, swap_t1) / (swap_t1 - swap_t0)
+        done_ts = sorted(t for t in recorder.done_t.values()
+                         if swap_t0 - 0.2 <= t <= swap_t1)
+        max_gap = (max(b - a for a, b in zip(done_ts, done_ts[1:]))
+                   if len(done_ts) > 1 else None)
+        recompiles = (
+            writer_metrics.counter(mn.RECOMPILES_POST_WARMUP)
+            + sum(r["metrics"].counter(mn.RECOMPILES_POST_WARMUP)
+                  for r in readers))
+        readers_reanchored = all(
+            (r["replica"].stats()["registry"] or {}).get("detector") == 2
+            for r in readers)
+        out.update({
+            "steady_completed_hz": round(steady_hz, 1),
+            "swap_window_completed_hz": round(swap_hz, 1),
+            "swap_window_completed_ratio": (
+                round(swap_hz / steady_hz, 3) if steady_hz else None),
+            "swap_window_s": round(swap_t1 - swap_t0, 2),
+            "swap_window_max_gap_s": (round(max_gap, 3)
+                                      if max_gap is not None else None),
+            "readers_reanchored": readers_reanchored,
+            "recompiles_post_warmup": int(recompiles),
+            "registry_swaps": int(
+                writer_metrics.counter(mn.REGISTRY_SWAPS)),
+        })
+        out["registry_ok"] = bool(
+            out["parity_agreement"] is not None
+            and out["parity_agreement"] >= 0.98
+            and readers_reanchored
+            and recompiles == 0
+            and max_gap is not None and max_gap <= 2.0)
+        for svc in [writer_svc] + [r["svc"] for r in readers]:
+            svc.drain(timeout=15.0)
+    finally:
+        router.stop()
+        for svc in [writer_svc] + [r["svc"] for r in readers]:
+            svc.stop()
+        lease.release()
+        state.close()
+        shutil.rmtree(state_dir, ignore_errors=True)
+    print(json.dumps(out), file=sys.stderr)
+    return out
+
+
 def run_partition_smoke(seconds: float = 4.0, seed: int = 7):
     """Partition-tolerance smoke (ISSUE 16): runs the chaos driver's
     ``partition`` scenario at a pinned seed — 3 routed replicas, the
@@ -1673,6 +1910,7 @@ def main(argv=None):
         artifact["tracing_overhead"] = run_tracing_overhead()
         artifact["replica_scaleout"] = run_replica_scaleout()
         artifact["rollout"] = run_rollout_smoke()
+        artifact["registry"] = run_registry_smoke()
         artifact["cascade"] = run_cascade_smoke()
         artifact["video"] = run_video_smoke()
         artifact["partition"] = run_partition_smoke()
@@ -1715,6 +1953,13 @@ def main(argv=None):
                 "parity_agreement"),
             "rollout_cutover_completed_ratio": artifact["rollout"].get(
                 "cutover_window_completed_ratio"),
+            "registry_parity_agreement": artifact["registry"].get(
+                "parity_agreement"),
+            "registry_swap_completed_ratio": artifact["registry"].get(
+                "swap_window_completed_ratio"),
+            "registry_recompiles": artifact["registry"].get(
+                "recompiles_post_warmup"),
+            "registry_ok": artifact["registry"].get("registry_ok"),
             "cascade_uplift_density0": artifact["cascade"]["uplift"]
             .get("d0", {}).get("uplift"),
             "cascade_uplift_density30": artifact["cascade"]["uplift"]
@@ -1757,13 +2002,18 @@ def main(argv=None):
         # chaos partition scenario's own verdicts: bounded failover,
         # survivor p99 <= 2x baseline, hedge rescue, exactly-once
         # publishes, exact ledgers under duplication, split-brain
-        # fail-closed + re-arm).
+        # fail-closed + re-arm), AND the registry gate (detector swap
+        # mid-traffic on the 3-replica fleet: live detection-agreement
+        # parity >= 0.98, every reader re-anchored onto the new
+        # manifest, zero recompile-watchdog trips, bounded
+        # completed-frames gap through the swap window).
         return (0 if trace_cmp.get("within_gate")
                 and scaleout.get("scaling_2x_ok")
                 and ingest.get("ingest_ok")
                 and artifact["cascade"].get("cascade_ok")
                 and artifact["video"].get("video_ok")
-                and artifact["partition"].get("partition_ok") else 3)
+                and artifact["partition"].get("partition_ok")
+                and artifact["registry"].get("registry_ok") else 3)
 
     import jax
 

@@ -28,9 +28,12 @@ import numpy as np
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="ocvf-recognize",
                                 description="Live face recognition on TPU")
-    p.add_argument("--model", required=True, help="CNN model checkpoint (ocvf-train --model cnn)")
-    p.add_argument("--detector", required=True, help="detector checkpoint (CNNFaceDetector.save)")
-    p.add_argument("--gallery", required=True,
+    # Required for every SERVING mode; the offline --registry-swap
+    # runbook touches only the state dir and needs none of them, so the
+    # requirement is enforced in main() rather than by argparse.
+    p.add_argument("--model", help="CNN model checkpoint (ocvf-train --model cnn)")
+    p.add_argument("--detector", help="detector checkpoint (CNNFaceDetector.save)")
+    p.add_argument("--gallery",
                    help="dataset dir to enroll at startup (folder per subject)")
     p.add_argument("--source", choices=["jsonl", "socket", "dir"], default="jsonl")
     p.add_argument("--dir", help="image directory for --source dir")
@@ -267,6 +270,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "embedder's rows must arrive via the staged "
                         "re-embed cutover (or this binary must complete a "
                         "pending one), never by silently mixing spaces")
+    p.add_argument("--detector-version", type=int, default=0, metavar="N",
+                   help="declare the loaded --detector's registry version "
+                        "(model-registry fencing; README 'Model "
+                        "registry'). 0 (default) = adopt whatever the "
+                        "state dir's manifest serves. Nonzero: startup "
+                        "FAILS CLOSED — writer and reader both — when the "
+                        "manifest serves a different detector version; a "
+                        "new detector arrives via the fenced registry "
+                        "swap, never by silently starting a different "
+                        "checkpoint")
+    p.add_argument("--cascade-version", type=int, default=0, metavar="N",
+                   help="declare the loaded --cascade stage-1 gate's "
+                        "registry version: same fail-closed startup fence "
+                        "as --detector-version, for the cascade role")
+    p.add_argument("--registry-swap", metavar="ROLE=VERSION",
+                   help="runbook entry point: perform ONE fenced model-"
+                        "registry swap against --state-dir and exit. The "
+                        "candidate params must already be staged at the "
+                        "registry convention path (state_dir/registry/"
+                        "<role>-v<N>.params); the swap appends the WAL "
+                        "fence, installs the manifest atomically, and "
+                        "exits 0 — serving writers pick the new version "
+                        "up at their next startup fence, readers across "
+                        "their next re-anchor. Roles: detector, cascade")
     p.add_argument("--checkpoint-every-s", type=float, default=300.0,
                    help="age threshold for background checkpoints: WAL "
                         "entries older than this trigger one (only "
@@ -691,8 +718,101 @@ def run_router(args) -> int:
     return 0
 
 
+def _registry_fence(registry, args, who: str) -> None:
+    """Fail-closed startup fence for the non-embedder registry roles
+    (mirrors the --embedder-version fence): a declared version that the
+    state dir's manifest doesn't serve refuses to start — writer AND
+    reader — because serving a detector/cascade the manifest doesn't
+    name is exactly the silent unfenced swap the registry exists to
+    prevent."""
+    for role, declared in (("detector", args.detector_version),
+                           ("cascade", args.cascade_version)):
+        if declared and registry.version(role) != declared:
+            raise SystemExit(
+                f"ocvf-recognize: --{role}-version {declared} declared "
+                f"but the state dir's registry manifest serves {role} "
+                f"v{registry.version(role)} — a {who} never serves a "
+                f"model set the manifest doesn't name. Swap the {role} "
+                f"through the fenced registry (--registry-swap {role}=N "
+                f"or the live coordinator), or start the matching "
+                f"checkpoint")
+
+
+def run_registry_swap(args) -> int:
+    """One fenced model-registry swap against ``--state-dir``, then exit
+    (README "Model registry" runbook): validate the staged candidate
+    params at the registry convention path, take the writer lease (a
+    live writer must never race the manifest install — drive a swap
+    through ITS coordinator instead), append the ``registry_cutover``
+    WAL fence and install the manifest atomically. No serving process is
+    touched: writers adopt the new version at their next startup fence,
+    readers across their next re-anchor."""
+    from opencv_facerecognizer_tpu.runtime.registry import (
+        ModelRegistry, _file_sha256, registry_params_path,
+    )
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        WriterLease, WriterLeaseHeldError,
+    )
+    from opencv_facerecognizer_tpu.runtime.state_store import StateLifecycle
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+
+    if not args.state_dir:
+        raise SystemExit("ocvf-recognize: --registry-swap requires "
+                         "--state-dir")
+    role, sep, version = args.registry_swap.partition("=")
+    role = role.strip()
+    try:
+        to_version = int(version)
+    except ValueError:
+        to_version = 0
+    if not sep or role not in ("detector", "cascade") or to_version <= 0:
+        raise SystemExit(
+            "ocvf-recognize: --registry-swap wants ROLE=VERSION with role "
+            "in (detector, cascade) and a positive integer version, got "
+            f"{args.registry_swap!r}")
+    params_path = registry_params_path(args.state_dir, role, to_version)
+    if not os.path.exists(params_path):
+        raise SystemExit(
+            f"ocvf-recognize: stage the candidate params first — "
+            f"{params_path} does not exist (CNNFaceDetector.save / "
+            f"FaceGate.save to the registry convention path)")
+    metrics = Metrics()
+    lease = WriterLease(args.state_dir, metrics=metrics)
+    try:
+        lease.acquire()
+    except WriterLeaseHeldError as exc:
+        raise SystemExit(
+            f"ocvf-recognize: {exc} — stop the writer first (or drive the "
+            f"swap through its live coordinator); the offline runbook swap "
+            f"needs exclusive ownership of the state dir")
+    try:
+        state = StateLifecycle(args.state_dir, metrics=metrics)
+        state.attach_registry(ModelRegistry(args.state_dir, metrics=metrics))
+        state.adopt_wal_seq()
+        try:
+            seq = state.perform_registry_cutover(
+                role, to_version, params_path=params_path,
+                params_sha256=_file_sha256(params_path))
+        except ValueError as exc:
+            raise SystemExit(f"ocvf-recognize: {exc}")
+        print(f"registry swap fenced at WAL seq {seq}; manifest now "
+              f"serves {state.registry.stamp()} (readers re-anchor once "
+              f"the next writer checkpoint covers the fence)",
+              file=sys.stderr)
+    finally:
+        lease.release()
+    return 0
+
+
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.registry_swap:
+        return run_registry_swap(args)
+    if not (args.model and args.detector and args.gallery):
+        parser.error("the following arguments are required: --model, "
+                     "--detector, --gallery (only --registry-swap runs "
+                     "without a serving stack)")
     if args.router:
         return run_router(args)
     from opencv_facerecognizer_tpu.runtime.connector import (
@@ -800,6 +920,14 @@ def main(argv=None) -> int:
                 f"v{replica.embedder_version} — a reader never mixes "
                 f"versions; start with the matching model (or wait for the "
                 f"writer's cutover checkpoint to land)")
+        # Read-only registry view: the reader fences its detector/cascade
+        # versions against the manifest exactly like the embedder above,
+        # and the replica's tail parks on registry fences from here on.
+        from opencv_facerecognizer_tpu.runtime.registry import ModelRegistry
+
+        replica.registry = ModelRegistry(args.state_dir, metrics=metrics,
+                                         readonly=True)
+        _registry_fence(replica.registry, args, "reader")
     elif args.state_dir:
         # Writer role: exactly one enrollment owner per state dir. The
         # fcntl lease is taken BEFORE the lifecycle touches anything — a
@@ -840,6 +968,19 @@ def main(argv=None) -> int:
                 f"Roll the new embedder out via the staged re-embed "
                 f"(runtime.rollout: stage + parity gate + cutover), or "
                 f"start the matching model")
+        # Model registry (ISSUE 18): recovery attaches one on the fly
+        # when the dir already carries a manifest (and completes or
+        # abandons any fenced-but-uninstalled swap); a fresh dir gets
+        # its manifest created here. The embedder slot mirrors the
+        # recovered gallery version, then the same fail-closed startup
+        # fence as --embedder-version runs for the other roles.
+        from opencv_facerecognizer_tpu.runtime.registry import ModelRegistry
+
+        if state.registry is None:
+            state.attach_registry(ModelRegistry(args.state_dir,
+                                                metrics=metrics))
+        state.registry.mirror_embedder(recovered_version)
+        _registry_fence(state.registry, args, "writer")
         if report["recovered_checkpoint"] is None and not report["replayed_records"]:
             # First run against this state dir: make the baseline gallery
             # durable NOW, so a crash before the first enrollment still
@@ -977,6 +1118,14 @@ def main(argv=None) -> int:
         cascade_threshold=args.cascade_threshold,
         tracker=tracker,
     )
+    # Registry wiring: published results + the tracker key on the full
+    # stamp; a reader's re-anchor onto a post-swap manifest flushes the
+    # identity caches (the writer-side flush rides the swap coordinator).
+    if state is not None and state.registry is not None:
+        service.registry = state.registry
+    elif replica is not None and replica.registry is not None:
+        service.registry = replica.registry
+        replica.on_registry_change = service.flush_model_caches
     if slo_monitor is not None and replica is not None:
         # Stale-replica brownout: the lag gauge objective rides the same
         # health verdict the brownout controller already consumes at

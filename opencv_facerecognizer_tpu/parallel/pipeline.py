@@ -337,6 +337,37 @@ class RecognitionPipeline:
             fn = self._cascade_cache[key] = jax.jit(stage1)  # ocvf-lint: boundary=jit-recompile-hazard -- cache-keyed stage-1 builder: warmup compiles every (rung, ingest dtype) signature up front; serving lands here only on a genuinely new shape
         return fn(gate.params, frames)
 
+    # ---- model-registry installs (runtime.registry swaps) ----
+
+    def install_detector_params(self, params) -> None:
+        """Publish new detector params in place (a registry detector
+        swap's ``install_fn``). Detector params are jit ARGUMENTS of
+        every compiled step — ``step(self.detector.params, ...)`` — so a
+        same-architecture swap is one attribute store: every cached
+        executable in ``_step_cache``/``_packed_cache`` stays warm and
+        the very next dispatch runs the new model. Architecture changes
+        do NOT go through here (they would need a new ``DetectorNet``
+        and a ladder re-prewarm); the registry coordinator stages those
+        as a new detector object + explicit prewarm instead."""
+        self.detector.load_params(params)
+
+    def install_cascade(self, gate) -> None:
+        """Swap the stage-1 cascade gate (a registry cascade swap's
+        ``install_fn``). ``cascade_scores`` reads ``self.cascade`` fresh
+        per call and passes ``gate.params`` as a jit argument, so a
+        same-architecture swap keeps every cached stage-1 executable
+        warm. The cached closures DO hold the net object from fill time,
+        so when the new gate's architecture differs (features /
+        downsample) the stale executables are dropped — the next call
+        per rung recompiles, which is exactly why same-config swaps are
+        the supported zero-recompile path."""
+        old = self.cascade
+        self.cascade = gate
+        if (old is None or gate is None
+                or tuple(old.net.features) != tuple(gate.net.features)
+                or int(old.net.downsample) != int(gate.net.downsample)):
+            self._cascade_cache.clear()
+
     def prewarm_batch_shapes(self, batch_sizes, frame_shape,
                              dtype=np.float32) -> int:
         """Compile the packed serving step for every dispatch-bucket size

@@ -38,6 +38,13 @@ from opencv_facerecognizer_tpu.runtime.replication import (
     WriterLease,
     WriterLeaseHeldError,
 )
+from opencv_facerecognizer_tpu.runtime.registry import (
+    DetectionParity,
+    ModelRegistry,
+    RegistryStateError,
+    RegistrySwapCoordinator,
+    registry_params_path,
+)
 from opencv_facerecognizer_tpu.runtime.rollout import (
     DualScoreParity,
     ReEmbedStage,
@@ -59,6 +66,7 @@ from opencv_facerecognizer_tpu.runtime.slo import (
     disk_free_objective,
     link_health_objective,
     loop_liveness_objective,
+    registry_parity_objective,
     replication_lag_objective,
     rollout_parity_objective,
 )
@@ -77,6 +85,7 @@ __all__ = [
     "CheckpointStore",
     "DeadLetterJournal",
     "DecodeWorkerPool",
+    "DetectionParity",
     "DualScoreParity",
     "DurabilityDegradedError",
     "DurabilityMonitor",
@@ -90,11 +99,14 @@ __all__ = [
     "IngestPipeline",
     "JSONLConnector",
     "MiddlewareConnector",
+    "ModelRegistry",
     "PRIORITY_BULK",
     "PRIORITY_INTERACTIVE",
     "ReadReplica",
     "ReEmbedStage",
     "RecognizerService",
+    "RegistryStateError",
+    "RegistrySwapCoordinator",
     "ReplicaHandle",
     "ResiliencePolicy",
     "RolloutCoordinator",
@@ -113,6 +125,8 @@ __all__ = [
     "disk_free_objective",
     "link_health_objective",
     "loop_liveness_objective",
+    "registry_params_path",
+    "registry_parity_objective",
     "replication_lag_objective",
     "rollout_parity_objective",
     "StateLifecycle",

@@ -194,7 +194,7 @@ class ExpoServer:
                  host: str = "127.0.0.1", port: int = 0,
                  refresh_s: float = 2.0,
                  bench_path: str = DEFAULT_BENCH_PATH,
-                 slo=None, router=None, rollout=None):
+                 slo=None, router=None, rollout=None, registry=None):
         self.service = service
         self.tracer = tracer if tracer is not None else getattr(
             service, "tracer", None)
@@ -217,6 +217,13 @@ class ExpoServer:
         #: deciding whether to cut over). Falls back to the service's
         #: attached coordinator so late attachment is visible.
         self.rollout = rollout
+        #: optional runtime.registry.ModelRegistry behind ``/registry``:
+        #: the served (role, version) manifest plus any in-flight swap
+        #: coordinator's phase/parity — the structured view an operator
+        #: polls during a detector/cascade swap (the ``model_version_*``
+        #: and ``registry_*`` gauges carry the same numbers on /prom).
+        #: Falls back to the service's attached registry, like rollout.
+        self.registry = registry
         self.refresh_s = float(refresh_s)
         self.bench_path = bench_path
         self._started_t = time.monotonic()
@@ -310,7 +317,8 @@ class ExpoServer:
             return {
                 "endpoints": ["/", "/metrics", "/prom", "/health", "/ledger",
                               "/brownout", "/spans", "/attribution",
-                              "/replicas", "/rollout", "/tracks"],
+                              "/replicas", "/rollout", "/registry",
+                              "/tracks"],
                 "uptime_s": round(time.monotonic() - self._started_t, 1),
                 "brownout_level": getattr(service, "brownout_level", None),
                 "health": (self.slo.state if self.slo is not None else None),
@@ -349,6 +357,18 @@ class ExpoServer:
             if coordinator is None:
                 return {"rollout": None, "detail": "no rollout in flight"}
             return {"rollout": coordinator.status()}
+        if path == "/registry":
+            # Versioned model registry (ISSUE 18): the durable manifest's
+            # served roles/versions plus any in-flight swap's phase and
+            # detection-parity window. Same unwired shape as /rollout:
+            # null payload with a pointer, never a 404.
+            registry = (self.registry if self.registry is not None
+                        else getattr(service, "registry", None))
+            if registry is None:
+                return {"registry": None, "detail": "no model registry wired"}
+            swap = getattr(service, "registry_swap", None)
+            return {"registry": registry.status(),
+                    "swap": swap.status() if swap is not None else None}
         if path == "/tracks":
             # Temporal identity cache (ISSUE 17): the replica-local
             # track registry + hit-rate stats as a read-only snapshot —

@@ -393,6 +393,17 @@ class RecognizerService:
         # (rate-limited, copied, scored on the rollout thread — the hot
         # path pays one attribute read when unset). None = no rollout.
         self.rollout = None
+        # Versioned model registry (runtime.registry.ModelRegistry) and
+        # the in-flight swap coordinator, attached by the registry
+        # orchestration. When ``registry`` is set, published results and
+        # the tracker key on the FULL registry stamp (every role), so any
+        # role's cutover invalidates cached identity verdicts; when
+        # ``registry_swap`` is live, _publish samples whole frames + the
+        # serving detector's verdicts into its detection-parity window
+        # (same rate-limited, fail-open contract as ``rollout``). Both
+        # cost one attribute read on the hot path when unset.
+        self.registry = None
+        self.registry_swap = None
         # Serving-loop progress stamp, refreshed every loop iteration
         # (batch AND idle — get_batch's flush timeout guarantees regular
         # iterations even with zero traffic). Read by the loop_liveness
@@ -928,6 +939,57 @@ class RecognizerService:
             return float(self.tracker.config.brownout_stretch)
         return 1.0
 
+    def _model_stamp(self, gallery_ver):
+        """The tracker/publish model stamp: the plain embedder version
+        when no registry is wired (PR 17 behavior, unchanged), else the
+        FULL registry stamp as a sorted (role, version) tuple with the
+        embedder slot overridden by the dispatch-time gallery version.
+        The tracker compares stamps by opaque equality, so keying on the
+        tuple makes ANY role's cutover invalidate cached identity
+        verdicts — a new detector changes which faces exist, not just
+        their embeddings."""
+        reg = self.registry
+        if reg is None:
+            return gallery_ver
+        stamp = reg.stamp()
+        if gallery_ver is not None:
+            stamp["embedder"] = int(gallery_ver)
+        return tuple(sorted(stamp.items()))
+
+    @staticmethod
+    def _stamp_fields(stamp):
+        """Split a model stamp into its published fields: the plain int
+        ``embedder_version`` and, when the stamp is a full registry
+        tuple, the role->version dict for ``payload["registry"]``."""
+        if isinstance(stamp, tuple):
+            roles = {str(k): int(v) for k, v in stamp}  # ocvf-lint: boundary=host-sync -- stamps are plain Python ints (registry manifest versions + the gallery's host-side version counter); nothing device-resident ever enters a stamp tuple
+            emb = roles.get("embedder")
+            return emb, roles
+        return stamp, None
+
+    def flush_model_caches(self, stamp=None, reason: str = "registry"
+                           ) -> int:
+        """Eager identity-cache invalidation on a registry cutover (the
+        swap coordinator's ``flush_fn``): every cached tracker verdict
+        was produced by the pre-swap model set, so flush now instead of
+        waiting for each track's lazy stamp-mismatch eviction. The
+        cascade's per-frame verdicts live in the same served results, so
+        the tracker flush covers both PR 17 and PR 13 caches; the jit
+        COMPILE caches are untouched — params are call arguments, a
+        same-architecture swap never recompiles. Returns tracks
+        flushed."""
+        del stamp  # the flush is total; the stamp is provenance only
+        flushed = 0
+        if self.tracker is not None:
+            try:
+                flushed = self.tracker.flush_all(reason=reason)
+            except Exception:  # noqa: BLE001 — cache only, fail open
+                logging.getLogger(__name__).exception(
+                    "tracker flush on registry cutover failed")
+                self.metrics.incr(mn.TRACK_ERRORS)
+        self.metrics.incr(mn.REGISTRY_CACHE_FLUSHES)
+        return flushed
+
     def _track_lookup(self, meta, frame, gallery_ver, stretch: float):
         """One fail-open cache consult: the cached payload or None. A
         tracker bug must cost the cache win, never the frame — the full
@@ -959,8 +1021,12 @@ class RecognizerService:
                 payload = {"meta": meta, "faces": hit["faces"],
                            "exit": "track_cache",
                            "track_id": hit["track_id"]}
-                if hit.get("embedder_version") is not None:
-                    payload["embedder_version"] = hit["embedder_version"]
+                emb_ver, reg_roles = self._stamp_fields(
+                    hit.get("embedder_version"))
+                if emb_ver is not None:
+                    payload["embedder_version"] = emb_ver
+                if reg_roles is not None:
+                    payload["registry"] = reg_roles
                 self.connector.publish(RESULT_TOPIC, payload)
                 published += 1
                 self.metrics.incr(mn.FACES_FOUND, len(hit["faces"]))
@@ -1551,6 +1617,10 @@ class RecognizerService:
                                     "embedder_version", None)
                 if track_ver is not None:
                     track_ver = int(track_ver)
+                # Full registry stamp when the registry is wired: a
+                # detector/cascade cutover invalidates cached verdicts
+                # exactly like an embedder cutover (opaque equality).
+                track_ver = self._model_stamp(track_ver)
                 cached = []
                 keep_list = []
                 for i in range(count):
@@ -1676,6 +1746,11 @@ class RecognizerService:
                                   "embedder_version", None)
             if gallery_ver is not None:
                 gallery_ver = int(gallery_ver)
+            # Registry-wired services widen the dispatch stamp to the
+            # full (role, version) tuple HERE, for the same reason: a
+            # registry cutover landing while this batch is on device
+            # must never back-stamp its results with the new model set.
+            gallery_ver = self._model_stamp(gallery_ver)
             packed = self._dispatch_with_retry(view, batch_tid)
             if packed is None:
                 # Retries exhausted or the error was permanent (poisoned
@@ -2179,6 +2254,14 @@ class RecognizerService:
 
         published = 0
         rollout = self.rollout
+        registry_swap = self.registry_swap
+        # ``gallery_ver`` is the DISPATCH-time model stamp: a plain int
+        # embedder version, or the full registry (role, version) tuple
+        # when the registry is wired. Split once — every published row
+        # and tracker verdict in this batch carries the same stamp, so a
+        # cutover landing mid-publish never splits a batch.
+        stamp = gallery_ver
+        emb_ver, reg_roles = self._stamp_fields(stamp)
         try:
             result = unpack_result(np.asarray(packed), self.pipeline.top_k)  # no-op if already host
             boxes = result.boxes
@@ -2209,12 +2292,17 @@ class RecognizerService:
                     })
                 self._maybe_collect_enrolment(frames[i], faces)
                 payload = {"meta": metas[i], "faces": faces}
-                if gallery_ver is not None:
+                if emb_ver is not None:
                     # The embedder version the batch was SCORED against
                     # (captured + int-coerced at dispatch) — consumers and
                     # the rollout chaos scenario key the no-mixed-scores
                     # invariant on this stamp.
-                    payload["embedder_version"] = gallery_ver
+                    payload["embedder_version"] = emb_ver
+                if reg_roles is not None:
+                    # The full registry stamp (dispatch-time): the chaos
+                    # registry scenario keys its no-unfenced-version
+                    # assertion on this dict.
+                    payload["registry"] = reg_roles
                 self.connector.publish(RESULT_TOPIC, payload)
                 published += 1
                 self.metrics.incr(mn.FACES_FOUND, len(faces))
@@ -2228,7 +2316,7 @@ class RecognizerService:
                         try:
                             self.tracker.update(
                                 key, faces, frames[i],
-                                embedder_version=gallery_ver)
+                                embedder_version=stamp)
                         except Exception:  # noqa: BLE001 — cache only
                             logging.getLogger(__name__).exception(
                                 "tracker update failed")
@@ -2243,6 +2331,19 @@ class RecognizerService:
                         logging.getLogger(__name__).exception(
                             "rollout live-parity offer failed")
                         self.metrics.incr(mn.ROLLOUT_OBSERVE_ERRORS)
+                if registry_swap is not None:
+                    # Detection-parity sampling for an in-flight registry
+                    # swap: whole frames + the serving detector's verdict
+                    # boxes (the publish path already paid for them), so
+                    # the candidate detector is scored against live
+                    # traffic including face-free frames. Same fail-open
+                    # contract as the rollout offer.
+                    try:
+                        registry_swap.offer_live(frames[i], faces)
+                    except Exception:  # noqa: BLE001 — observation only
+                        logging.getLogger(__name__).exception(
+                            "registry live-parity offer failed")
+                        self.metrics.incr(mn.REGISTRY_OBSERVE_ERRORS)
         finally:
             # Ledger settlement happens HERE, per batch, whatever exits:
             # frames that made it out are completed; on a crash escaping

@@ -398,6 +398,17 @@ class ReadReplica:
         #: peers while the checkpoint load runs on the serving thread and
         #: fleet-wide completed-frames never blanks through a cutover.
         self.on_resync: Optional[Callable[[str], None]] = None
+        #: read-only model-registry view (``runtime.registry.ModelRegistry``
+        #: with ``readonly=True``) + change hook. A ``registry_cutover``
+        #: fence parks the tail exactly like an embedder cutover fence;
+        #: resync re-reads the manifest after every re-anchor, so the
+        #: post-swap model set becomes visible here only across that
+        #: re-anchor — a replica never serves a mixed set. The hook gets
+        #: the new stamp dict (fleet wiring points it at the service's
+        #: ``flush_model_caches``).
+        self.registry = None
+        self.on_registry_change: Optional[
+            Callable[[Dict[str, int]], None]] = None
 
     # ---- sync ----
 
@@ -460,6 +471,25 @@ class ReadReplica:
                 self.subject_names[:] = []
                 self.applied_seq = 0
                 self.anchor_checkpoint = None
+            if self.registry is not None:
+                # Registry re-anchor: the manifest this replica serves
+                # moves only here, never mid-tail — same no-mixing rule
+                # as the gallery snapshot above.
+                prior_stamp = self.registry.stamp()
+                self.registry.reload()
+                new_stamp = self.registry.stamp()
+                if new_stamp != prior_stamp:
+                    logger.info("replica %s re-anchored registry %s -> %s",
+                                self.name, prior_stamp, new_stamp)
+                    if self.metrics is not None:
+                        self.metrics.incr(mn.ROLLOUT_REPLICA_REANCHORS)
+                    if self.on_registry_change is not None:
+                        try:
+                            self.on_registry_change(dict(new_stamp))
+                        except Exception:  # noqa: BLE001 — cache hook only
+                            logger.exception(
+                                "replica %s on_registry_change failed",
+                                self.name)
             self.seen_seq = max(self.seen_seq, self.applied_seq)
             self._anchor_seq = self.applied_seq
             self._aborted_seen.clear()
@@ -625,6 +655,39 @@ class ReadReplica:
                     "checkpoint lands", self.name, seq, to_version,
                     self.embedder_version)
                 break
+            if kind == "registry_cutover" and isinstance(seq, (int, float)):
+                seq = int(seq)
+                if seq <= self.applied_seq:
+                    continue  # covered by the anchor checkpoint: burned
+                role = str(record.get("role", "?"))
+                to_version = int(record.get("to_version", 0))
+                if (self.registry is not None
+                        and self.registry.version(role) >= to_version):
+                    # The manifest visible here already covers this swap
+                    # (resync landed past it): burn the fence.
+                    self.applied_seq = seq
+                    continue
+                # Park exactly like an embedder cutover fence: the swap
+                # becomes visible only across the re-anchor onto the
+                # writer's post-swap checkpoint (or the post-recovery
+                # one, when the swap was abandoned — either way the
+                # checkpoint's wal_seq covers this fence).
+                self._await_cutover = {"to_version": to_version, "seq": seq,
+                                       "role": role}
+                if self.metrics is not None:
+                    self.metrics.set_gauge(mn.ROLLOUT_REPLICA_AWAITING, 1)
+                logger.info(
+                    "replica %s: registry fence seq %d -> %s v%d observed; "
+                    "holding until a covering checkpoint lands",
+                    self.name, seq, role, to_version)
+                break
+            if kind == "registry_abort" and isinstance(seq, (int, float)):
+                # Abandoned-swap tombstone (recovery appended it; its seq
+                # IS the voided fence's seq). Nothing to apply — the
+                # fence it voids parks the tail until a covering
+                # checkpoint lands, and the re-anchor reads the manifest
+                # the abandon left at the old version.
+                continue
             if kind != "enroll" or not isinstance(seq, (int, float)):
                 continue
             seq = int(seq)
@@ -651,6 +714,24 @@ class ReadReplica:
                     "the gallery serves v%d — holding for a matching "
                     "checkpoint (version fence)", self.name, seq,
                     record.get("embedder_version"), self.embedder_version)
+                break
+            row_stamp = record.get("registry")
+            if (isinstance(row_stamp, dict) and self.registry is not None
+                    and any(int(v) != self.registry.version(str(r))
+                            for r, v in row_stamp.items())):
+                # Registry fence without a visible registry_cutover
+                # record (late-start tail past a compacted fence): park
+                # rather than apply rows produced under a model set this
+                # replica hasn't re-anchored onto.
+                self._await_cutover = {"to_version": 0, "seq": seq,
+                                       "registry": dict(row_stamp)}
+                if self.metrics is not None:
+                    self.metrics.set_gauge(mn.ROLLOUT_REPLICA_AWAITING, 1)
+                logger.warning(
+                    "replica %s: enroll seq %d carries registry stamp %s "
+                    "but the manifest here serves %s — holding for a "
+                    "covering checkpoint (registry fence)", self.name,
+                    seq, row_stamp, self.registry.stamp())
                 break
             decoded = decode_enroll_record(record)
             if decoded is None:
@@ -698,6 +779,8 @@ class ReadReplica:
                 "wal_reopens": self.tailer.reopens,
                 "anchor_checkpoint": self.anchor_checkpoint,
                 "embedder_version": self.embedder_version,
+                "registry": (self.registry.stamp()
+                             if self.registry is not None else None),
                 "awaiting_cutover": (dict(self._await_cutover)
                                      if self._await_cutover else None),
                 "gallery_size": int(self.gallery.size)}

@@ -302,6 +302,34 @@ def rollout_parity_objective(coordinator, min_agreement: float = 0.98,
                bound=budget, short_s=short_s, long_s=long_s)
 
 
+def registry_parity_objective(coordinator, min_agreement: float = 0.98,
+                              short_s: float = 60.0,
+                              long_s: float = 600.0) -> SLO:
+    """Gauge objective over a registry swap's detection DISAGREEMENT
+    fraction (``runtime.registry.RegistrySwapCoordinator`` — old vs
+    candidate detector box-overlap verdict agreement on live frames):
+    warn once disagreement crosses ``1 - min_agreement``, critical at
+    6x. Same contract as ``rollout_parity_objective`` — below the
+    window's sample floor the gauge reads 0 (an idle registry never
+    alarms), and it takes any object with a ``parity`` attribute
+    exposing ``disagreement`` so this module never imports the registry
+    (which imports the state store beside us). Rides /health for the
+    whole swap INCLUDING the post-cutover watch, so a candidate that
+    regresses on live traffic alarms while the coordinator's
+    auto-rollback fires."""
+    budget = 1.0 - float(min_agreement)
+    if not budget > 0:
+        raise ValueError("min_agreement must be < 1.0 (a zero "
+                         "disagreement budget can never be scored)")
+
+    def value() -> float:
+        parity = getattr(coordinator, "parity", None)
+        return float(parity.disagreement) if parity is not None else 0.0
+
+    return SLO(name="registry_parity", kind="gauge", value_fn=value,
+               bound=budget, short_s=short_s, long_s=long_s)
+
+
 class SLOMonitor:
     """Evaluate a set of ``SLO`` objectives on a fixed interval and run
     the health state machine over them (module docstring)."""

@@ -558,7 +558,8 @@ class EnrollmentWAL(RotatingJournal):
     def append_enroll(self, seq: int, embeddings: np.ndarray,
                       labels: np.ndarray, subject: Optional[str] = None,
                       label: Optional[int] = None,
-                      embedder_version: int = 1) -> None:
+                      embedder_version: int = 1,
+                      registry: Optional[Dict[str, int]] = None) -> None:
         """Append one enrollment record; raises on write failure (strict)
         or injected crash. The caller acknowledges the enrollment only
         after this returns — with ``fsync="always"`` that acknowledgment
@@ -566,7 +567,11 @@ class EnrollmentWAL(RotatingJournal):
         space the rows live in (the rollout fencing key: replay, replicas
         and the offline verifier all refuse to apply a row to a gallery
         serving a different version; pre-rollout records without the field
-        read as version 1)."""
+        read as version 1). ``registry`` stamps the remaining model-role
+        versions the row was served under (``{"detector": v, "cascade":
+        v}`` — the ISSUE 18 registry stamp): the offline verifier walks
+        it per role, refusing a WAL whose rows span a role's versions
+        without an intervening ``registry_cutover`` fence."""
         emb = np.ascontiguousarray(np.asarray(embeddings, np.float32))
         labels = np.asarray(labels, np.int32)
         if emb.ndim != 2 or emb.shape[0] != labels.shape[0]:
@@ -586,6 +591,9 @@ class EnrollmentWAL(RotatingJournal):
             "emb": base64.b64encode(raw).decode("ascii"),
             "crc32": binascii.crc32(raw) & 0xFFFFFFFF,
         }
+        if registry is not None:
+            record["registry"] = {str(k): int(v)
+                                  for k, v in registry.items()}
         line = json.dumps(record)
         fault = self._faults.on_wal_append() if self._faults is not None else None
         if fault == "crash":
@@ -631,6 +639,48 @@ class EnrollmentWAL(RotatingJournal):
         if self.metrics is not None:
             self.metrics.incr(mn.WAL_CUTOVER_RECORDS)
 
+    def append_registry_cutover(self, seq: int, role: str,
+                                from_version: int, to_version: int,
+                                registry: Dict[str, int],
+                                config: Any = None,
+                                params_path: Optional[str] = None,
+                                params_sha256: Optional[str] = None) -> None:
+        """Append one model-registry fence record (strict: the manifest
+        install and the in-memory param publish are allowed only AFTER
+        this fsyncs — write-ahead, exactly like the embedder cutover).
+        The record marks the WAL position where ``role``'s served version
+        changed and carries the full post-swap registry stamp plus the
+        candidate params' checksum, so recovery can COMPLETE a fenced
+        swap whose manifest install never ran (params verify) or CLEANLY
+        ABANDON it (params damaged — a ``registry_abort`` tombstone, the
+        role stays at ``from_version``)."""
+        self.append_line(json.dumps({
+            "kind": "registry_cutover", "seq": int(seq), "role": str(role),
+            "from_version": int(from_version),
+            "to_version": int(to_version),
+            "registry": {str(k): int(v) for k, v in registry.items()},
+            "config": config, "params_path": params_path,
+            "params_sha256": params_sha256, "ts": time.time(),
+        }), strict=True)
+        if self.metrics is not None:
+            self.metrics.incr(mn.WAL_REGISTRY_RECORDS)
+
+    def append_registry_abort(self, fence_seq: int, role: str,
+                              to_version: int) -> None:
+        """Tombstone a ``registry_cutover`` fence recovery ABANDONED (the
+        staged candidate params were missing or damaged — the role never
+        served ``to_version``): replay and the offline verifier's
+        multi-role walk treat the fence as void, so rows after it stamped
+        ``from_version`` are consistent, never a span violation. Strict:
+        the abandonment is part of the durable version history."""
+        seq = int(fence_seq)
+        self.append_line(json.dumps({
+            "kind": "registry_abort", "seq": seq, "role": str(role),
+            "to_version": int(to_version), "ts": time.time(),
+        }), strict=True)
+        if self.metrics is not None:
+            self.metrics.incr(mn.WAL_REGISTRY_ABORTS)
+
     def scan(self) -> Tuple[List[Dict[str, Any]], int]:
         """ONE parse of the whole WAL -> (surviving records oldest-first —
         decoded enrollments plus raw ``cutover`` fence records, in file
@@ -656,9 +706,12 @@ class EnrollmentWAL(RotatingJournal):
         for record in records:
             kind = record.get("kind")
             seq = record.get("seq")
-            if kind == "cutover" and isinstance(seq, (int, float)):
-                # Version fence: flows through in order so replay and the
-                # tail consumers see exactly where the space changed.
+            if (kind in ("cutover", "registry_cutover", "registry_abort")
+                    and isinstance(seq, (int, float))):
+                # Version fences (embedder cutovers, model-registry swaps)
+                # and registry abandon tombstones: flow through in order
+                # so replay, the tail consumers and the offline verifier
+                # see exactly where each role's served version changed.
                 out.append(dict(record))
                 continue
             if kind != "enroll":
@@ -810,8 +863,21 @@ class StateLifecycle:
         self._subject_names: Optional[list] = None
         self._service = None
         self._closed = False
+        #: optional runtime.registry.ModelRegistry — the versioned model
+        #: registry (ISSUE 18). When attached, enroll rows and checkpoint
+        #: headers carry the full registry stamp, ``perform_registry_
+        #: cutover`` fences detector/cascade swaps through the WAL, and
+        #: recovery completes (or cleanly abandons) a fenced swap whose
+        #: manifest install never ran.
+        self.registry = None
 
     # ---- wiring ----
+
+    def attach_registry(self, registry) -> None:
+        """Wire the versioned model registry: rows/checkpoints stamp its
+        versions from here on, and registry swaps fence through this
+        lifecycle's WAL."""
+        self.registry = registry
 
     def bind(self, gallery, subject_names: list) -> None:
         """Point the lifecycle at a bare gallery + live subject-name list
@@ -857,6 +923,28 @@ class StateLifecycle:
         it)."""
         gallery, _names = self._targets()
         return self._gallery_version(gallery)
+
+    def _role_stamp(self) -> Optional[Dict[str, int]]:
+        """The non-embedder registry stamp for WAL rows (``{"detector":
+        v, "cascade": v}``), or None when no registry is attached. The
+        embedder rides its own ``embedder_version`` field — one source of
+        truth per role, no duplication."""
+        if self.registry is None:
+            return None
+        stamp = self.registry.stamp()
+        stamp.pop("embedder", None)
+        return stamp
+
+    def registry_stamp(self) -> Optional[Dict[str, int]]:
+        """The FULL registry stamp (every role, embedder from the live
+        gallery) — what checkpoint headers and published results carry.
+        None when no registry is attached."""
+        if self.registry is None:
+            return None
+        gallery, _names = self._targets()
+        stamp = self.registry.stamp()
+        stamp["embedder"] = self._gallery_version(gallery)
+        return stamp
 
     # ---- recovery ----
 
@@ -909,6 +997,14 @@ class StateLifecycle:
                 # k-means. Skipped entirely when a cutover was completed:
                 # the sidecar's centroids live in the OLD embedding space.
                 self._restore_quantizer_locked(gallery, base_seq, report)
+            # Model-registry swaps (ISSUE 18): a ``registry_cutover``
+            # fence whose manifest install never ran is the crash window
+            # between the fence append and the atomic manifest write —
+            # COMPLETE it when the staged candidate params verify, or
+            # CLEANLY ABANDON it (tombstone + retired version number)
+            # when they don't. Either way the fleet restarts serving
+            # exactly one fenced version per role.
+            self._settle_registry_locked(surviving, report)
             # WAL replay: acknowledged enrollments since the effective
             # anchor, fenced by embedder version — a row from another
             # version's space is NEVER applied (it can only arise from a
@@ -968,6 +1064,9 @@ class StateLifecycle:
             self.metrics.set_gauge(mn.WAL_ROWS, self._rows_since_ckpt)
         report["gallery_size"] = gallery.size
         report["embedder_version"] = current_version
+        if self.registry is not None:
+            report["registry"] = {**self.registry.stamp(),
+                                  "embedder": current_version}
         # No (or stale) sidecar: the quantizer retrains in the background
         # (single-flight) while the exact matcher serves — startup never
         # blocks on a k-means.
@@ -1035,6 +1134,86 @@ class StateLifecycle:
         else:
             if self.metrics is not None:
                 self.metrics.incr(mn.IVF_SIDECAR_STALE)
+
+    def _settle_registry_locked(self, surviving: List[Dict[str, Any]],
+                                report: Dict[str, Any]) -> None:
+        """Complete or cleanly abandon every fenced-but-uninstalled model
+        registry swap (see ``recover``). Attaches a registry on the fly
+        when the state dir carries a manifest but none was wired (the
+        crash-restart harnesses construct the lifecycle bare) — a CORRUPT
+        manifest raises ``RegistryStateError`` out of recovery: a writer
+        must never guess which model versions it serves."""
+        registry = self.registry
+        if registry is None:
+            from opencv_facerecognizer_tpu.runtime.registry import (
+                MANIFEST_NAME, ModelRegistry,
+            )
+
+            if not os.path.exists(os.path.join(self.state_dir,
+                                               MANIFEST_NAME)):
+                return
+            registry = ModelRegistry(self.state_dir, metrics=self.metrics)
+            self.registry = registry
+        from opencv_facerecognizer_tpu.runtime.registry import _file_sha256
+
+        voided = {(r.get("role"), int(r.get("to_version", -1)))
+                  for r in surviving if r.get("kind") == "registry_abort"}
+        for record in surviving:
+            if record.get("kind") != "registry_cutover":
+                continue
+            role = str(record.get("role"))
+            to_version = int(record.get("to_version", -1))
+            if (role, to_version) in voided:
+                continue  # a previous recovery already abandoned it
+            if registry.version(role) >= to_version:
+                continue  # manifest install landed before the crash
+            entry = {"role": role, "seq": int(record.get("seq", 0)),
+                     "from_version": int(record.get("from_version", 0)),
+                     "to_version": to_version}
+            sha = record.get("params_sha256")
+            path = record.get("params_path")
+            params_ok = True
+            if sha is not None:
+                try:
+                    params_ok = (path is not None and os.path.exists(path)
+                                 and _file_sha256(path) == sha)
+                except OSError:
+                    params_ok = False
+            if params_ok:
+                registry.install(role, to_version,
+                                 config=record.get("config"),
+                                 params_path=path, params_sha256=sha)
+                report.setdefault("completed_registry_swaps",
+                                  []).append(entry)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.REGISTRY_SWAPS_COMPLETED_RECOVERY)
+                logging.getLogger(__name__).warning(
+                    "completed pending registry swap %s v%d -> v%d from "
+                    "the fence + staged params (the crash landed between "
+                    "the fence record and the manifest install)", role,
+                    entry["from_version"], to_version)
+            else:
+                try:
+                    self.wal.append_registry_abort(entry["seq"], role,
+                                                   to_version)
+                except OSError:
+                    logging.getLogger(__name__).exception(
+                        "registry_abort tombstone append failed; the "
+                        "abandonment stands (manifest never moved) but "
+                        "the offline verifier will flag the dangling "
+                        "fence")
+                registry.retire(role, to_version)
+                report.setdefault("abandoned_registry_swaps",
+                                  []).append(entry)
+                if self.metrics is not None:
+                    self.metrics.incr(mn.REGISTRY_SWAPS_ABANDONED_RECOVERY)
+                logging.getLogger(__name__).warning(
+                    "ABANDONED pending registry swap %s v%d -> v%d: the "
+                    "fenced candidate params are missing or damaged "
+                    "(sha256 mismatch) — the role stays at v%d and "
+                    "version %d is retired, never reused", role,
+                    entry["from_version"], to_version,
+                    entry["from_version"], to_version)
 
     @staticmethod
     def _pending_cutover(records: List[Dict[str, Any]],
@@ -1243,7 +1422,8 @@ class StateLifecycle:
                 try:
                     self.wal.append_enroll(seq, embeddings, labels,
                                            subject=subject, label=label,
-                                           embedder_version=gver)
+                                           embedder_version=gver,
+                                           registry=self._role_stamp())
                 except InjectedCrashError:
                     raise  # simulated kill: no post-mortem writes
                 except BaseException as exc:
@@ -1398,6 +1578,11 @@ class StateLifecycle:
         poke = getattr(gallery, "_poke_quantizer", None)
         if poke is not None:
             poke()
+        if self.registry is not None:
+            # Keep the registry's embedder entry in step with the gallery
+            # (the gallery stays that role's source of truth; the mirror
+            # makes /registry and the checkpoint stamp coherent).
+            self.registry.mirror_embedder(int(to_version))
         if self.metrics is not None:
             self.metrics.incr(mn.ROLLOUT_CUTOVERS)
             self.metrics.set_gauge(mn.ROLLOUT_EMBEDDER_VERSION,
@@ -1409,6 +1594,94 @@ class StateLifecycle:
                              from_version=from_version,
                              to_version=int(to_version), rows=int(size),
                              seq=seq)
+        return seq
+
+    def adopt_wal_seq(self) -> int:
+        """Seed the burned-sequence watermark from the existing WAL
+        without running a full recovery (the offline ``--registry-swap``
+        runbook path has no gallery to recover into): every record —
+        aborts and corrupt-but-parseable ones included — advances the
+        floor, exactly like recover()'s seeding, so a fence appended
+        next never reuses a live or tombstoned seq."""
+        _records, highest = self.wal.scan()
+        with self._enroll_lock:
+            self._wal_seq = max(self._wal_seq, int(highest))
+            return self._wal_seq
+
+    def perform_registry_cutover(self, role: str, to_version: int, *,
+                                 config: Any = None,
+                                 params_path: Optional[str] = None,
+                                 params_sha256: Optional[str] = None,
+                                 install_fn: Optional[Callable[[], None]]
+                                 = None) -> int:
+        """The atomic model-registry swap for a non-embedder role
+        (``runtime.registry.RegistrySwapCoordinator`` drives this): under
+        the enroll lock — so no enrollment can interleave between the
+        fence and the swap, and no checkpoint can snapshot across it —
+
+        1. the ``registry_cutover`` WAL fence record is appended (strict,
+           fsynced) with the full post-swap registry stamp and the
+           candidate params' sha256 — write-ahead: from this instant a
+           crash recovers INTO the new version when the staged params
+           verify, or cleanly abandons the swap when they don't (never a
+           mix, never a guess);
+        2. the manifest installs atomically (``ModelRegistry.install`` —
+           tmp + rename + dirsync, monotonic per role);
+        3. ``install_fn()`` publishes the new params in memory (model
+           params are jit ARGUMENTS in the pipeline, so a
+           same-architecture publish is one attribute store — keep it
+           that cheap; it runs under the lock so every row appended
+           after the fence was served by the new model).
+
+        Returns the fence record's sequence. The caller MUST follow with
+        a forced checkpoint — until it lands, read replicas park on the
+        fence. Reuses the ``cutover`` fault boundary (crash_before_record
+        / crash_after_record) so the chaos harness kills both windows."""
+        if self.registry is None:
+            raise RuntimeError("perform_registry_cutover needs an attached "
+                               "ModelRegistry (attach_registry)")
+        t0 = time.monotonic()
+        with self._enroll_lock:
+            from_version = self.registry.version(role)
+            if int(to_version) <= from_version:
+                raise ValueError(
+                    f"registry versions are monotonic: {role} serves "
+                    f"v{from_version}, refusing cutover to v{to_version}")
+            stamp_after = self.registry.stamp()
+            stamp_after[role] = int(to_version)
+            if self._service is not None or self._gallery is not None:
+                gallery, _names = self._targets()
+                stamp_after["embedder"] = self._gallery_version(gallery)
+            fault = (self._faults.on_cutover()
+                     if self._faults is not None else None)
+            if fault == "crash_before_record":
+                raise InjectedCrashError(
+                    "crash before registry_cutover record: the candidate "
+                    "params are durable, the fleet stays on the old "
+                    "version")
+            seq = self._wal_seq = self._wal_seq + 1
+            self.wal.append_registry_cutover(
+                seq, role, from_version, int(to_version),
+                registry=stamp_after, config=config,
+                params_path=params_path, params_sha256=params_sha256)
+            if fault == "crash_after_record":
+                raise InjectedCrashError(
+                    "crash after registry_cutover record, before the "
+                    "manifest install: recovery must complete the swap "
+                    "from the fence + staged params (or cleanly abandon)")
+            self.registry.install(role, int(to_version), config=config,
+                                  params_path=params_path,
+                                  params_sha256=params_sha256)
+            if install_fn is not None:
+                install_fn()
+        if self.metrics is not None:
+            self.metrics.incr(mn.REGISTRY_SWAPS)
+        if self.tracer is not None:
+            self.tracer.emit(self.tracer.new_trace(), "registry_cutover",
+                             topic=LIFECYCLE_TOPIC, t0=t0,
+                             dur=time.monotonic() - t0, role=str(role),
+                             from_version=from_version,
+                             to_version=int(to_version), seq=seq)
         return seq
 
     # ---- checkpointing ----
@@ -1512,8 +1785,12 @@ class StateLifecycle:
                 emb, lab, val, size = gallery.snapshot()
                 # Embedder version captured in the SAME critical section
                 # as the rows it stamps: a checkpoint header can never
-                # claim one version over another version's snapshot.
+                # claim one version over another version's snapshot. The
+                # registry stamp rides the same section for the same
+                # reason (a header straddling a registry swap must not
+                # claim the new stamp over pre-swap rows).
                 gver = self._gallery_version(gallery)
+                reg_stamp = self._role_stamp()
                 names_copy = [] if names is None else list(names)
                 # IVF sidecar payload captured in the SAME critical
                 # section: its assignments cover exactly the rows this
@@ -1534,6 +1811,8 @@ class StateLifecycle:
                 "wal_seq": wal_seq,
                 "embedder_version": gver,
             }
+            if reg_stamp is not None:
+                meta["registry"] = {**reg_stamp, "embedder": gver}
             fault = (self._faults.on_checkpoint()
                      if self._faults is not None else None)
             try:

@@ -389,6 +389,40 @@ ROLLOUT_OBSERVE_ERRORS = "rollout_observe_errors"
 #: cutover WAL fence records appended.
 WAL_CUTOVER_RECORDS = "wal_cutover_records"
 
+# ---- versioned model registry (runtime.registry, ISSUE 18) -----------------
+#: per-role served-version gauge family ``model_version_<role>`` (the
+#: /prom mirror of the durable manifest: embedder, detector, cascade).
+MODEL_VERSION_PREFIX = "model_version_"
+#: registry swap phase gauge: 0 idle, 1 parity, 2 ready, 3 cutover,
+#: 4 watch, 5 done, 6 rolled_back (``runtime.registry.PHASE_CODES``).
+REGISTRY_PHASE = "registry_phase"
+#: detection-parity window (old vs candidate detector, box-overlap
+#: verdict match on live sampled frames) and the sample count behind it.
+REGISTRY_PARITY_AGREEMENT = "registry_parity_agreement"
+REGISTRY_PARITY_SAMPLES = "registry_parity_samples"
+#: fenced registry swaps performed, and swaps the parity gate refused.
+REGISTRY_SWAPS = "registry_swaps"
+REGISTRY_SWAPS_BLOCKED = "registry_swaps_blocked"
+#: recovery found a fsynced registry fence whose manifest install never
+#: ran and COMPLETED it (staged params verified) / cleanly ABANDONED it
+#: (params missing or damaged — the version number is retired).
+REGISTRY_SWAPS_COMPLETED_RECOVERY = "registry_swaps_completed_recovery"
+REGISTRY_SWAPS_ABANDONED_RECOVERY = "registry_swaps_abandoned_recovery"
+#: post-cutover watch regressions rolled back automatically (each one
+#: forces a ``registry_auto_rollback`` flight dump).
+REGISTRY_AUTO_ROLLBACKS = "registry_auto_rollbacks"
+#: FaceGate retrains riding a detector swap (``evaluate_gate`` scores
+#: stage 1 against detector verdicts, so the pair cuts over together).
+REGISTRY_GATE_RETRAINS = "registry_gate_retrains"
+#: eager tracker/cascade cache flushes on a role's cutover.
+REGISTRY_CACHE_FLUSHES = "registry_cache_flushes"
+#: live-observation hook failures on the publish path (counted, never
+#: propagated into the serving loop — like rollout_observe_errors).
+REGISTRY_OBSERVE_ERRORS = "registry_observe_errors"
+#: registry_cutover WAL fence records appended, and abandon tombstones.
+WAL_REGISTRY_RECORDS = "wal_registry_records"
+WAL_REGISTRY_ABORTS = "wal_registry_aborts"
+
 # ---- topic router (runtime.replication.TopicRouter) ------------------------
 ROUTER_ROUTED = "router_routed"
 #: per-reason rejection family: ``router_rejected_<reason>``

@@ -117,6 +117,21 @@ METRICS: Dict[str, Tuple[Callable[[dict], Any], str, float, float]] = {
         lambda d: (d.get("rollout") or {})
         .get("cutover_window_completed_ratio"),
         "ratio_min", 0.80, 0.0),
+    # Versioned model registry (ISSUE 18): the live detection-agreement
+    # parity on the detector-swap smoke (a candidate quietly degrading
+    # box-verdict agreement is a registry-gate regression) and the
+    # completed-frames ratio through the fence + re-anchor window (the
+    # serving-never-blanks number for non-embedder swaps — no re-embed,
+    # params are jit arguments, so it must track the rollout ratio or
+    # better). Artifacts predating the registry section ride the
+    # baseline-predates-metric skip.
+    "registry_parity_agreement": (
+        lambda d: (d.get("registry") or {}).get("parity_agreement"),
+        "ratio_min", 0.98, 0.0),
+    "registry_swap_completed_ratio": (
+        lambda d: (d.get("registry") or {})
+        .get("swap_window_completed_ratio"),
+        "ratio_min", 0.80, 0.0),
     # Ingest pipeline (ISSUE 12): the staging-ring uint8 H2D tail at the
     # b32 rung (the old --transfer-uint8 path's 118 ms p99 pathology must
     # never creep back — ratio + absolute slack, same reasoning as the

@@ -1956,6 +1956,600 @@ def run_rollout(seconds: float = 6.0, seed: int | None = None,
     return report
 
 
+def run_registry(seconds: float = 6.0, seed: int | None = None,
+                 state_dir: str | None = None) -> dict:
+    """Model-registry scenario (ISSUE 18 acceptance): 1 writer + 2
+    WAL-tailing read replicas behind the topic router serve live traffic
+    while the writer swaps the DETECTOR through the versioned model
+    registry — live detection-parity window, ``registry_cutover`` WAL
+    fence, atomic manifest install, replica re-anchor — with
+    deterministic kills at every swap boundary:
+
+    - **kill before the fence** (``crash_before_record``): nothing was
+      fenced, the fleet stays on the old detector, no seq burned;
+    - **kill mid-swap** (``crash_after_record`` — after the WAL fence,
+      before the manifest install): the restarted writer's recovery must
+      COMPLETE the swap from the fence + staged params (sha256 verifies),
+      and the parked readers re-anchor onto the post-swap checkpoint;
+    - **kill mid-swap with damaged params** (cascade role): recovery must
+      CLEANLY ABANDON — ``registry_abort`` tombstone, the role stays at
+      the old version, the candidate number is retired, never reused;
+    - **parity-regressing candidate**: a detector that passes the
+      pre-cutover gate but regresses on post-cutover traffic is
+      auto-rolled-back inside the watch window at the next monotonic
+      version, with a parseable flight-recorder dump carrying the full
+      swap status.
+
+    Pass criteria (any miss -> ``ok: False``):
+
+    1. **zero acked loss, bit-equal** — writer, both surviving readers
+       and a late-start replacement replica hold byte-identical
+       galleries covering every acked enrollment (registry swaps never
+       re-embed: rows are untouched by construction, so equality is
+       EXACT, not approximate);
+    2. **never mixed-version serving** — every published result carries
+       the full registry stamp of the model set its batch was dispatched
+       under; per replica the detector stamp stream is monotonic
+       non-decreasing, every stamped version was fenced, and the
+       ABANDONED cascade candidate version never appears in any
+       published result (no result from an unfenced model version);
+    3. **exact per-replica ledgers** — each replica's stamped-result
+       ledger and applied-row count are reported exactly, and the
+       offline verifier's multi-role registry walk passes over the final
+       state dir (manifest checksum + per-role fence continuity, rc 0).
+    """
+    import random as random_mod
+    import threading
+
+    import numpy as np
+
+    from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+    from opencv_facerecognizer_tpu.runtime import (
+        FakeConnector, FaultInjector, ModelRegistry, ReadReplica,
+        RecognizerService, RegistrySwapCoordinator, ReplicaHandle,
+        ResiliencePolicy, StateLifecycle, TopicRouter, WriterLease,
+        registry_params_path,
+    )
+    from opencv_facerecognizer_tpu.runtime.connector import encode_frame
+    from opencv_facerecognizer_tpu.runtime.fakes import (
+        InstantPipeline, TrafficRecorder,
+    )
+    from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+    from opencv_facerecognizer_tpu.runtime.recognizer import RESULT_TOPIC
+    from opencv_facerecognizer_tpu.runtime.replication import (
+        service_health_probe,
+    )
+    from opencv_facerecognizer_tpu.utils.metrics import Metrics
+    from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+    if seed is None:
+        seed = random_mod.SystemRandom().randrange(1 << 31)
+    print(f"chaos_soak registry seed={seed} seconds={seconds}",
+          file=sys.stderr)
+    rng = random_mod.Random(seed)
+    frame_rng = np.random.default_rng(seed)
+
+    temp_dir = state_dir is None
+    if temp_dir:
+        state_dir = tempfile.mkdtemp(prefix="ocvf_registry_")
+    trace_dir = tempfile.mkdtemp(prefix="ocvf_flight_")
+    tracer = Tracer(ring_size=1 << 16, sample=1.0, seed=seed,
+                    dump_dir=trace_dir, min_dump_interval_s=0.1)
+    mesh = make_mesh()
+    DIM = 8
+    frame_shape = (32, 32)
+    dispatch_s = 0.01
+    offered_hz = 50.0
+    topics = 12
+
+    # Synthetic detectors: yxyx verdict boxes over the 32x32 frames. The
+    # serving detector is a version-keyed closure over ``serving_box`` —
+    # install_fn IS the one attribute publish the real pipeline does
+    # (params are jit arguments; same-architecture swap, zero recompiles).
+    serving_box = {"detector": 1}
+
+    def detect_v1(frame):
+        del frame
+        return [(8.0, 8.0, 24.0, 24.0)]
+
+    def detect_v2(frame):
+        del frame
+        return [(9.0, 9.0, 25.0, 25.0)]  # IoU ~0.78 vs v1: agrees
+
+    #: the regressing candidate: agrees while the pre-cutover parity
+    #: window looks, then drifts on post-cutover traffic (the exact
+    #: failure the watch window + auto-rollback exist for).
+    behave = {"good": True}
+
+    def detect_v3(frame):
+        if behave["good"]:
+            return [(8.0, 9.0, 24.0, 25.0)]
+        return [(0.0, 0.0, 6.0, 6.0)]  # disjoint: verdict mismatch
+
+    report = {"scenario": "registry", "seed": seed, "seconds": seconds,
+              "state_dir": state_dir, "ok": False}
+    failures: list = []
+
+    #: acked enrollments: (emb, labels, subject, label, detector_version)
+    acked: list = []
+
+    def make_service(gallery, metrics, registry=None, replica=None):
+        pipe = InstantPipeline(frame_shape, dispatch_s=dispatch_s,
+                               faces_per_frame=1)
+        pipe.gallery = gallery
+        svc = RecognizerService(
+            pipe, FakeConnector(), batch_size=8, frame_shape=frame_shape,
+            flush_timeout=0.02, inflight_depth=2, similarity_threshold=0.0,
+            metrics=metrics,
+            resilience=ResiliencePolicy(readback_deadline_s=2.0),
+            replica=replica)
+        svc.registry = registry
+        return svc
+
+    # ---- fleet: writer (registry attached) + 2 readers + router ----
+    injector = FaultInjector(seed=seed)
+    writer_metrics = Metrics()
+    lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+    writer_gallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh)
+    writer_names: list = []
+    state = StateLifecycle(state_dir, metrics=writer_metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9,
+                           fault_injector=injector, tracer=tracer)
+    state.attach_registry(ModelRegistry(state_dir, metrics=writer_metrics))
+    state.bind(writer_gallery, writer_names)
+    writer_box = {"svc": make_service(writer_gallery, writer_metrics,
+                                      registry=state.registry)}
+
+    def enroll_burst(n):
+        """Synchronous acked enrollments, stamped with the CURRENT
+        registry (rows are never re-embedded by a registry swap — the
+        ledger is bit-exact)."""
+        for _ in range(n):
+            rows = rng.randint(1, 2)
+            emb = frame_rng.normal(size=(rows, DIM)).astype(np.float32)
+            label = len(writer_names)
+            subject = f"subject_{len(acked)}"
+            labels = np.full(rows, label, np.int32)
+            writer_names.append(subject)
+            state.append_enrollment(
+                emb, labels, subject=subject, label=label,
+                embedder_version=1,
+                apply_fn=lambda e=emb, l=labels: writer_gallery.add(e, l))
+            acked.append((emb, labels, subject, label,
+                          state.registry.version("detector")))
+
+    enroll_burst(4)
+
+    readers = []
+    for i in range(2):
+        rmetrics = Metrics()
+        rgallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh)
+        rnames: list = []
+        rep = ReadReplica(state_dir, rgallery, rnames, metrics=rmetrics,
+                          tracer=tracer, poll_interval_s=0.02,
+                          name=f"reader-{i}")
+        rep.registry = ModelRegistry(state_dir, metrics=rmetrics,
+                                     readonly=True)
+        rep.poll(force=True)
+        svc = make_service(rgallery, rmetrics, registry=rep.registry,
+                           replica=rep)
+        rep.on_registry_change = svc.flush_model_caches
+        readers.append({"replica": rep, "gallery": rgallery,
+                        "names": rnames, "metrics": rmetrics, "svc": svc})
+
+    router_metrics = Metrics()
+    handles = [ReplicaHandle(
+        "writer", writer_box["svc"].connector,
+        health_fn=lambda: service_health_probe(writer_box["svc"])(),
+        writer=True)]
+    for i, reader in enumerate(readers):
+        handles.append(ReplicaHandle(
+            f"reader-{i}", reader["svc"].connector,
+            health_fn=service_health_probe(reader["svc"])))
+    router = TopicRouter(handles, metrics=router_metrics, tracer=tracer,
+                         health_interval_s=0.05)
+    for i, reader in enumerate(readers):
+        reader["replica"].on_resync = router.cordon_hook(f"reader-{i}")
+    recorder = TrafficRecorder(router)
+    frame_msg = encode_frame(np.zeros(frame_shape, np.float32))
+
+    #: per-replica published (monotonic time, detector_v, cascade_v)
+    #: registry-stamp ledger — the never-mixed-version evidence.
+    stamps: dict = {"writer": [], "reader-0": [], "reader-1": []}
+    stamp_lock = threading.Lock()
+
+    def watch_stamps(name, connector):
+        def on_result(_t, message, _name=name):
+            reg = message.get("registry")
+            if isinstance(reg, dict):
+                with stamp_lock:
+                    stamps[_name].append(
+                        (time.monotonic(), int(reg.get("detector", 0)),
+                         int(reg.get("cascade", 0))))
+
+        connector.subscribe(RESULT_TOPIC, on_result)
+
+    watch_stamps("writer", writer_box["svc"].connector)
+    for i, reader in enumerate(readers):
+        watch_stamps(f"reader-{i}", reader["svc"].connector)
+
+    seq_box = {"seq": 0}
+
+    def pump(duration_s):
+        interval = 1.0 / offered_hz
+        end = time.monotonic() + duration_s
+        while time.monotonic() < end:
+            seq = seq_box["seq"]
+            seq_box["seq"] = seq + 1
+            recorder.send_t[seq] = time.monotonic()
+            router.publish(f"camera/{seq % topics}",
+                           {**frame_msg, "priority": "interactive",
+                            "meta": {"seq": seq}})
+            time.sleep(interval)
+
+    def stage_params(role, version):
+        """Stage a deterministic candidate params blob at the runbook
+        path and return (path, bytes) — durable BEFORE any fence, as the
+        swap protocol requires."""
+        from opencv_facerecognizer_tpu.utils.serialization import (
+            atomic_write_bytes,
+        )
+        path = registry_params_path(state_dir, role, version)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        blob = f"{role}-v{version}-params-{seed}".encode() * 64
+        atomic_write_bytes(path, blob)
+        return path
+
+    def restart_writer(where):
+        """Full writer 'process' restart: stop, drop the lease, recover
+        from disk (recovery completes or abandons any fenced swap),
+        re-acquire, rewire the router."""
+        nonlocal lease, state, writer_gallery, writer_names
+        writer_box["svc"].stop()
+        lease.release()
+        state.close()
+        new_gallery = ShardedGallery(capacity=1024, dim=DIM, mesh=mesh)
+        new_names: list = []
+        lease = WriterLease(state_dir, metrics=writer_metrics).acquire()
+        state = StateLifecycle(state_dir, metrics=writer_metrics,
+                               checkpoint_wal_rows=1 << 30,
+                               checkpoint_every_s=1e9,
+                               fault_injector=injector, tracer=tracer)
+        recovery = state.recover(new_gallery, new_names)
+        if state.registry is None:
+            failures.append(f"writer recovery ({where}) attached no "
+                            f"registry despite the durable manifest")
+            state.attach_registry(
+                ModelRegistry(state_dir, metrics=writer_metrics))
+        writer_gallery = new_gallery
+        writer_names = new_names
+        new_svc = make_service(new_gallery, writer_metrics,
+                               registry=state.registry)
+        new_svc.start(warmup=False)
+        writer_box["svc"] = new_svc
+        router.replace_connector("writer", new_svc.connector)
+        watch_stamps("writer", new_svc.connector)
+        return recovery
+
+    def await_reader_registry(role, version, where, deadline_s=15.0):
+        """Poll the readers through their re-anchor until both manifest
+        views serve ``role`` at ``version``."""
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            views = [r["replica"].stats()["registry"] for r in readers]
+            if all(v is not None and v.get(role) == version for v in views):
+                return
+            pump(0.1)
+            for r in readers:
+                r["replica"].poll(force=True)
+        failures.append(
+            f"{where}: readers never re-anchored onto {role} v{version}: "
+            f"{[r['replica'].stats()['registry'] for r in readers]}")
+
+    parity_frames = [frame_rng.normal(size=frame_shape).astype(np.float32)
+                     for _ in range(24)]
+    phase_t = {}
+    try:
+        writer_box["svc"].start(warmup=False)
+        for reader in readers:
+            reader["svc"].start(warmup=False)
+        router.start()
+
+        # ---- phase A: steady state, everything at v1 ----
+        pump(max(0.4, seconds * 0.1))
+
+        # ---- phase B0: kill BEFORE the fence (nothing durable moves) --
+        v2_path = stage_params("detector", 2)
+        coordinator = RegistrySwapCoordinator(
+            state, state.registry, "detector", 2,
+            old_detect_fn=detect_v1, new_detect_fn=detect_v2,
+            params_path=v2_path, parity_min_samples=8,
+            install_fn=lambda: serving_box.__setitem__("detector", 2),
+            metrics=writer_metrics, tracer=tracer)
+        coordinator.score_parity(parity_frames[:12])
+        if not coordinator.parity_ok():
+            failures.append("detector v2 parity gate never opened: "
+                            f"{coordinator.status()['parity']}")
+        injector.script("cutover", "crash_before_record")
+        try:
+            coordinator.cutover()
+            failures.append("scripted pre-fence kill never fired")
+        except InjectedCrashError:
+            pass
+        if state.registry.version("detector") != 1:
+            failures.append("pre-fence kill moved the manifest")
+        seq_before = state.wal_seq
+
+        # ---- phase B: kill mid-swap (fenced, manifest not installed) --
+        phase_t["swap_start"] = time.monotonic()
+        injector.script("cutover", "crash_after_record")
+        try:
+            coordinator.cutover()
+            failures.append("scripted mid-swap kill never fired")
+        except InjectedCrashError:
+            pass
+        if state.wal_seq <= seq_before:
+            failures.append("mid-swap kill burned no fence seq")
+        pump(max(0.3, seconds * 0.1))  # readers hit the fence; serve on
+        for r in readers:
+            r["replica"].poll(force=True)
+        awaiting = [bool(r["replica"].stats()["awaiting_cutover"])
+                    for r in readers]
+        report["readers_parked_at_fence"] = awaiting
+        if not any(awaiting):
+            failures.append("no reader parked on the registry fence "
+                            "while the writer was down")
+        recovery = restart_writer("after mid-swap kill")
+        completed = recovery.get("completed_registry_swaps") or []
+        if not any(e["role"] == "detector" and e["to_version"] == 2
+                   for e in completed):
+            failures.append(f"recovery did not complete the fenced "
+                            f"detector swap: {recovery}")
+        if state.registry.version("detector") != 2:
+            failures.append(f"writer recovered with detector v"
+                            f"{state.registry.version('detector')}, not v2")
+        if not state.checkpoint_now(wait=True):
+            failures.append("post-swap checkpoint failed")
+        enroll_burst(3)  # rows stamped under detector v2
+        await_reader_registry("detector", 2, "after completed swap")
+
+        # ---- phase C: kill mid-swap, candidate DAMAGED -> abandon ----
+        c2_path = stage_params("cascade", 2)
+        coordinator = RegistrySwapCoordinator(
+            state, state.registry, "cascade", 2, params_path=c2_path,
+            metrics=writer_metrics, tracer=tracer)
+        injector.script("cutover", "crash_after_record")
+        try:
+            coordinator.cutover(force=True)  # cascade: no parity fns wired
+            failures.append("scripted cascade-swap kill never fired")
+        except InjectedCrashError:
+            pass
+        with open(c2_path, "ab") as fh:
+            fh.write(b"bitrot")  # the staged candidate no longer verifies
+        recovery = restart_writer("after damaged-candidate kill")
+        abandoned = recovery.get("abandoned_registry_swaps") or []
+        if not any(e["role"] == "cascade" and e["to_version"] == 2
+                   for e in abandoned):
+            failures.append(f"recovery did not cleanly abandon the "
+                            f"damaged cascade swap: {recovery}")
+        if state.registry.version("cascade") != 1:
+            failures.append(f"abandoned swap moved cascade to v"
+                            f"{state.registry.version('cascade')}")
+        if not state.checkpoint_now(wait=True):
+            failures.append("post-abandon checkpoint failed")
+        enroll_burst(2)  # still stamped cascade v1
+        await_reader_registry("cascade", 1, "after abandoned swap")
+        try:
+            state.registry.install("cascade", 2)
+            failures.append("retired cascade v2 was re-installable "
+                            "(fence ambiguity)")
+        except ValueError:
+            report["retired_version_refused"] = True
+
+        # ---- phase D: parity-regressing candidate -> auto-rollback ----
+        v3_path = stage_params("detector", 3)
+        coordinator = RegistrySwapCoordinator(
+            state, state.registry, "detector", 3,
+            old_detect_fn=detect_v2, new_detect_fn=detect_v3,
+            params_path=v3_path, parity_min_samples=8,
+            watch_min_samples=8,
+            install_fn=lambda: serving_box.__setitem__("detector", 3),
+            rollback_install_fn=lambda: serving_box.__setitem__(
+                "detector", 2),
+            flush_fn=writer_box["svc"].flush_model_caches,
+            metrics=writer_metrics, tracer=tracer)
+        writer_box["svc"].registry_swap = coordinator
+        coordinator.score_parity(parity_frames[:12])
+        if not coordinator.parity_ok():
+            failures.append("regressing candidate failed the PRE-cutover "
+                            "gate (the watch window is what must catch it)")
+        coordinator.cutover()
+        pump(max(0.3, seconds * 0.1))  # fleet serves v3 inside the watch
+        behave["good"] = False  # the candidate drifts on live traffic
+        coordinator.score_parity(parity_frames[12:])
+        if coordinator.phase != "rolled_back":
+            failures.append(f"watch regression did not auto-roll-back "
+                            f"(phase {coordinator.phase})")
+        if state.registry.version("detector") != 4:
+            failures.append(f"rollback landed detector v"
+                            f"{state.registry.version('detector')}, "
+                            f"not the next monotonic v4")
+        if serving_box["detector"] != 2:
+            failures.append("rollback did not restore the previous "
+                            "params in memory")
+        writer_box["svc"].registry_swap = None
+        report["auto_rollback"] = coordinator.status()
+        enroll_burst(2)  # stamped detector v4
+        await_reader_registry("detector", 4, "after auto-rollback")
+        phase_t["swap_end"] = time.monotonic()
+        pump(max(0.3, seconds * 0.1))
+
+        # ---- phase E: drain + replacement replica + verification ----
+        target = state.wal_seq
+        deadline = time.monotonic() + 10.0
+        while (any(r["replica"].applied_seq < target for r in readers)
+               and time.monotonic() < deadline):
+            for r in readers:
+                r["replica"].poll(force=True)
+            time.sleep(0.02)
+        replacement_gallery = ShardedGallery(capacity=1024, dim=DIM,
+                                             mesh=mesh)
+        replacement_names: list = []
+        replacement = ReadReplica(state_dir, replacement_gallery,
+                                  replacement_names, metrics=Metrics(),
+                                  tracer=tracer, poll_interval_s=0.0,
+                                  name="replacement")
+        replacement.registry = ModelRegistry(state_dir, readonly=True)
+        replacement.poll(force=True)
+        for svc in [writer_box["svc"]] + [r["svc"] for r in readers]:
+            svc.drain(timeout=15.0)
+
+        # Zero acked loss, bit-equal: registry swaps never touch rows,
+        # so every gallery must hold byte-identical state.
+        want_rows = sum(len(labels) for _e, labels, _s, _l, _d in acked)
+        w_emb, w_lab, _v, w_size = writer_gallery.snapshot()
+        if w_size != want_rows:
+            failures.append(f"writer holds {w_size} rows, "
+                            f"{want_rows} acked")
+        ledgers = {}
+        for name, gal, names_list in (
+                [("writer", writer_gallery, writer_names)]
+                + [(f"reader-{i}", r["gallery"], r["names"])
+                   for i, r in enumerate(readers)]
+                + [("replacement", replacement_gallery,
+                    replacement_names)]):
+            emb, lab, _v, size = gal.snapshot()
+            ledgers[name] = {"rows": int(size),
+                             "subjects": len(names_list)}
+            if size != w_size:
+                failures.append(f"{name}: {size} rows, writer has "
+                                f"{w_size} (acked loss)")
+                continue
+            if not np.array_equal(emb[:size], w_emb[:w_size]) \
+                    or not np.array_equal(lab[:size], w_lab[:w_size]):
+                failures.append(f"{name}: gallery differs from the "
+                                f"writer's bit-for-bit")
+            if list(names_list) != list(writer_names):
+                failures.append(f"{name}: subject ledger differs")
+        report["replica_ledgers"] = ledgers
+        final_stamp = replacement.stats()["registry"]
+        if final_stamp is None or final_stamp.get("detector") != 4 \
+                or final_stamp.get("cascade") != 1:
+            failures.append(f"late-start replacement anchored on "
+                            f"{final_stamp}, expected detector v4 / "
+                            f"cascade v1")
+    finally:
+        router.stop()
+        for svc in [writer_box["svc"]] + [r["svc"] for r in readers]:
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                import traceback
+
+                traceback.print_exc()
+        lease.release()
+        state.close()
+
+    # ---- verdicts ----
+    with stamp_lock:
+        stamp_view = {k: list(v) for k, v in stamps.items()}
+    #: detector versions that were ever FENCED into the manifest; the
+    #: abandoned cascade v2 is deliberately absent from the cascade set.
+    fenced_detector = {1, 2, 3, 4}
+    report["result_stamps"] = {
+        k: {"total": len(v),
+            "detector_versions": sorted({d for _t, d, _c in v}),
+            "cascade_versions": sorted({c for _t, _d, c in v})}
+        for k, v in stamp_view.items()}
+    for name, series in stamp_view.items():
+        if not series:
+            failures.append(f"{name}: published no registry-stamped "
+                            f"results")
+            continue
+        detectors = [d for _t, d, _c in series]
+        if any(d not in fenced_detector for d in detectors):
+            failures.append(f"{name}: detector stamp outside the fenced "
+                            f"set: {sorted(set(detectors))}")
+        if detectors != sorted(detectors):
+            failures.append(f"{name}: detector stamps interleave "
+                            f"(mixed-version serving): {detectors}")
+        if any(c != 1 for _t, _d, c in series):
+            failures.append(f"{name}: a result was published under the "
+                            f"ABANDONED cascade candidate (unfenced "
+                            f"model version)")
+    # Serving continuity across all three swap windows.
+    window = (phase_t.get("swap_start"), phase_t.get("swap_end"))
+    if None not in window:
+        done_ts = sorted(t for t in recorder.done_t.values()
+                         if window[0] - 0.5 <= t <= window[1] + 0.5)
+        report["swap_window_completions"] = len(done_ts)
+        if len(done_ts) < 2:
+            failures.append("serving blanked through the swap window "
+                            f"({len(done_ts)} completions)")
+        else:
+            max_gap = max(b - a for a, b in zip(done_ts, done_ts[1:]))
+            report["swap_window_max_gap_s"] = round(max_gap, 3)
+            if max_gap > 2.0:
+                failures.append(f"completed-frames gap {max_gap:.2f}s "
+                                f"through the swaps (serving blanked)")
+
+    # Offline verifier: manifest checksum + the multi-role registry walk
+    # over the final WAL must pass (fence continuity per role).
+    import importlib.util as _ilu
+
+    spec = _ilu.spec_from_file_location(
+        "verify_checkpoint",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "verify_checkpoint.py"))
+    verify_mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(verify_mod)
+    vreport = verify_mod.verify_state_dir(state_dir)
+    report["verify"] = {"ok": vreport["ok"],
+                        "registry": vreport.get("registry"),
+                        "violations": (vreport.get("wal") or {}).get(
+                            "version_violations")}
+    if not vreport["ok"]:
+        failures.append(f"offline verifier failed on the final state "
+                        f"dir: {report['verify']}")
+    roles = (vreport.get("registry") or {}).get("roles") or {}
+    if roles.get("detector") != 4 or roles.get("cascade") != 1:
+        failures.append(f"verifier read manifest {roles}, expected "
+                        f"detector v4 / cascade v1")
+
+    # The auto-rollback's forensic artifact: a parseable flight dump
+    # whose extra carries the full swap status.
+    dumps = _check_flight_dumps(trace_dir, failures, require=1)
+    rollback_dumps = [p for p in dumps if "registry_auto_rollback" in p]
+    if not rollback_dumps:
+        failures.append("auto-rollback left no flight dump")
+    else:
+        with open(rollback_dumps[-1]) as fh:
+            dump = json.load(fh)
+        swap_status = (dump.get("extra") or {}).get("registry_swap")
+        if not isinstance(swap_status, dict) \
+                or swap_status.get("role") != "detector" \
+                or swap_status.get("to_version") != 3:
+            failures.append(f"auto-rollback flight dump carries no "
+                            f"parseable swap status: {swap_status}")
+        else:
+            report["rollback_dump"] = {
+                "path": os.path.basename(rollback_dumps[-1]),
+                "role": swap_status["role"],
+                "to_version": swap_status["to_version"],
+                "parity": swap_status.get("parity")}
+    tracer.dump("registry_end", extra={"acked": len(acked)}, force=True)
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    if temp_dir:
+        shutil.rmtree(state_dir, ignore_errors=True)
+
+    report["acked_enrollments"] = len(acked)
+    report["offered"] = seq_box["seq"]
+    report["failures"] = failures
+    report["ok"] = not failures
+    return report
+
+
 def run_disk(seconds: float = 6.0, seed: int | None = None,
              state_dir: str | None = None) -> dict:
     """Storage-fault scenario (ISSUE 15 acceptance): the disk STAYS broken
@@ -3019,6 +3613,7 @@ def main(argv=None) -> int:
                         help="replay a previous run exactly (logged on stderr)")
     parser.add_argument("--scenario", choices=["soak", "overload", "recovery",
                                                "replication", "rollout",
+                                               "registry",
                                                "disk", "partition",
                                                "video"],
                         default="soak",
@@ -3037,6 +3632,14 @@ def main(argv=None) -> int:
                              "mid-cutover, and a reader mid-re-anchor; "
                              "assert zero acked loss, no mixed-version "
                              "scores, serving continuity (run_rollout); "
+                             "registry: versioned model-registry swaps — "
+                             "kill before/after the detector-swap fence "
+                             "(recovery completes), damaged candidate "
+                             "(recovery cleanly abandons), parity-"
+                             "regressing candidate (auto-rollback + "
+                             "flight dump); assert bit-equal zero acked "
+                             "loss, never mixed-version serving, exact "
+                             "per-replica ledgers (run_registry); "
                              "disk: the disk STAYS broken — ENOSPC "
                              "mid-enrollment, EIO mid-checkpoint, slow "
                              "fsync under load, watermark pressure; "
@@ -3077,6 +3680,9 @@ def main(argv=None) -> int:
     elif args.scenario == "rollout":
         report = run_rollout(seconds=args.seconds, seed=args.seed,
                              state_dir=args.state_dir)
+    elif args.scenario == "registry":
+        report = run_registry(seconds=args.seconds, seed=args.seed,
+                              state_dir=args.state_dir)
     elif args.scenario == "disk":
         report = run_disk(seconds=args.seconds, seed=args.seed,
                           state_dir=args.state_dir)

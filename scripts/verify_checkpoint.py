@@ -111,11 +111,57 @@ def verify_state_dir(path: str) -> dict:
                 ckpt_embedder_version = version  # newest verified wins
         report["embedder_version"] = ckpt_embedder_version
 
+    manifest_path = os.path.join(path, "registry.json")
+    if os.path.exists(manifest_path):
+        # Model-registry manifest (ISSUE 18): checksum over the canonical
+        # roles bytes + per-role shape/monotonicity. Torn/unreadable
+        # (the bytes could not be parsed) is "cannot verify" (rc 3);
+        # a checksum/shape mismatch is corruption (rc 2) — same contract
+        # as the checkpoint sweep.
+        from opencv_facerecognizer_tpu.runtime.registry import (
+            ModelRegistry, RegistryStateError,
+        )
+
+        try:
+            roles = ModelRegistry.read_manifest(manifest_path)["roles"]
+            entry = {"path": manifest_path,
+                     "roles": {r: int(v["version"])
+                               for r, v in roles.items()}}
+            bad = [r for r, v in roles.items()
+                   if int(v.get("version", 0)) < 1
+                   or int(v.get("retired", 0) or 0) < 0]
+            if bad:
+                entry["error"] = (f"non-monotonic version fields for "
+                                  f"role(s) {bad}")
+                entry["reason"] = "corrupt"
+                report["ok"] = False
+                report["registry_corrupt"] = True
+            report["registry"] = entry
+        except RegistryStateError as exc:
+            report["ok"] = False
+            report["registry"] = {"path": manifest_path,
+                                  "error": str(exc),
+                                  "reason": exc.reason}
+            if exc.reason == "unreadable":
+                report["cannot_verify"] = True
+            else:
+                report["registry_corrupt"] = True
+
     wal_path = os.path.join(path, "enroll.wal")
     if os.path.exists(wal_path):
         torn_lines = enroll_records = valid_records = 0
         cutover_records = 0
         version_violations = []
+        # Multi-role version walk (ISSUE 18): enroll rows stamp the
+        # non-embedder roles they were served under (``registry``), and
+        # a ``registry_cutover`` record is the only sanctioned way a
+        # role's version moves — a ``registry_abort`` tombstone voids
+        # its fence (the role reverts to the fence's from_version).
+        # Rows spanning a role's versions without an intervening fence
+        # mean replay could mix model sets: rc 2.
+        registry_cutover_records = 0
+        cur_roles = {}
+        fence_from = {}  # (role, to_version) -> from_version, for aborts
         # Version walk (rollout fencing): rows carry the embedder version
         # they were enrolled under; a ``cutover`` record is the only
         # sanctioned way the stream switches versions. Rows spanning
@@ -169,6 +215,46 @@ def verify_state_dir(path: str) -> dict:
                                    f"but the stream is at {cur_version}"})
                 cur_version = to_v
                 continue
+            if record.get("kind") == "registry_cutover":
+                registry_cutover_records += 1
+                try:
+                    role = str(record["role"])
+                    from_v = int(record["from_version"])
+                    to_v = int(record["to_version"])
+                except (KeyError, TypeError, ValueError):
+                    version_violations.append(
+                        {"seq": record.get("seq"),
+                         "reason": "registry_cutover record with "
+                                   "unreadable role/versions"})
+                    continue
+                if to_v <= from_v:
+                    version_violations.append(
+                        {"seq": record.get("seq"),
+                         "reason": f"registry_cutover {role} "
+                                   f"v{from_v} -> v{to_v} is not "
+                                   f"monotonic"})
+                if role in cur_roles and from_v != cur_roles[role]:
+                    version_violations.append(
+                        {"seq": record.get("seq"),
+                         "reason": f"registry_cutover claims {role} "
+                                   f"from_version {from_v} but the "
+                                   f"stream is at v{cur_roles[role]}"})
+                fence_from[(role, to_v)] = from_v
+                cur_roles[role] = to_v
+                continue
+            if record.get("kind") == "registry_abort":
+                # Recovery abandoned the fence this tombstone names: the
+                # role reverts to the fence's from_version (the version
+                # number stays burned — the manifest's retired floor).
+                role = str(record.get("role"))
+                try:
+                    to_v = int(record.get("to_version", -1))
+                except (TypeError, ValueError):
+                    to_v = -1
+                key = (role, to_v)
+                if key in fence_from and cur_roles.get(role) == to_v:
+                    cur_roles[role] = fence_from[key]
+                continue
             if record.get("kind") != "enroll":
                 continue
             enroll_records += 1
@@ -190,6 +276,29 @@ def verify_state_dir(path: str) -> dict:
                      "reason": f"row at embedder v{row_version} follows "
                                f"v{cur_version} rows with no intervening "
                                f"cutover record (version fence breached)"})
+            row_stamp = record.get("registry")
+            if isinstance(row_stamp, dict):
+                for role, ver in row_stamp.items():
+                    role = str(role)
+                    try:
+                        ver = int(ver)
+                    except (TypeError, ValueError):
+                        version_violations.append(
+                            {"seq": record.get("seq"),
+                             "reason": f"unreadable registry stamp for "
+                                       f"role {role!r}: "
+                                       f"{row_stamp.get(role)!r}"})
+                        continue
+                    if role not in cur_roles:
+                        cur_roles[role] = ver  # seed, like the embedder
+                    elif ver != cur_roles[role]:
+                        version_violations.append(
+                            {"seq": record.get("seq"),
+                             "reason": f"row at {role} v{ver} follows "
+                                       f"v{cur_roles[role]} rows with no "
+                                       f"intervening registry_cutover "
+                                       f"record (registry fence "
+                                       f"breached)"})
         # A PARSEABLE enroll record failing crc/base64 was acknowledged
         # and is now unreadable — that is real loss of acked data.
         corrupt_records = enroll_records - valid_records
@@ -199,6 +308,8 @@ def verify_state_dir(path: str) -> dict:
                          "torn_lines": torn_lines,
                          "corrupt_records": corrupt_records,
                          "cutover_records": cutover_records,
+                         "registry_cutover_records":
+                             registry_cutover_records,
                          "version_violations": version_violations}
         if corrupt_records:
             report["ok"] = False
@@ -360,6 +471,7 @@ def main(argv=None) -> int:
     # rc 2 — restore-from-backup beats fix-the-mount when both apply.
     wal = report.get("wal") or {}
     corruption = bool(report.get("corrupt") or report.get("version_errors")
+                      or report.get("registry_corrupt")
                       or wal.get("corrupt_records")
                       or wal.get("version_violations"))
     if report.get("cannot_verify") and not corruption:

@@ -1,0 +1,643 @@
+"""Versioned model registry tests (``runtime.registry`` + the
+multi-role state machinery, ISSUE 18): the durable checksummed manifest
+with monotonic per-role versions, the detection-parity swap gate and its
+refusal of a degraded candidate, the FaceGate retrain that cuts over
+atomically with a detector swap, the per-role tracker/cascade cache
+flush, WAL-fenced cutover recovery (complete-or-abandon), replica
+park/re-anchor on the registry fence, the offline verifier's manifest +
+multi-role walk rc contract, the CLI startup fences and offline swap
+runbook, ``GET /registry``, and the fast deterministic tier-1 variant of
+``scripts/chaos_soak.py --scenario registry``."""
+
+import glob
+import importlib.util
+import json
+import os
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opencv_facerecognizer_tpu.parallel import ShardedGallery, make_mesh
+from opencv_facerecognizer_tpu.runtime import (
+    FakeConnector,
+    FaultInjector,
+    ModelRegistry,
+    ReadReplica,
+    RecognizerService,
+    RegistryStateError,
+    RegistrySwapCoordinator,
+    RolloutGateError,
+    StateLifecycle,
+    registry_params_path,
+)
+from opencv_facerecognizer_tpu.runtime.expo import ExpoServer
+from opencv_facerecognizer_tpu.runtime.fakes import InstantPipeline
+from opencv_facerecognizer_tpu.runtime.faults import InjectedCrashError
+from opencv_facerecognizer_tpu.runtime.registry import (
+    DetectionParity,
+    _file_sha256,
+    box_iou,
+)
+from opencv_facerecognizer_tpu.runtime.tracker import (
+    IdentityTracker,
+    TrackerConfig,
+)
+from opencv_facerecognizer_tpu.utils import metric_names as mn
+from opencv_facerecognizer_tpu.utils.metrics import Metrics
+from opencv_facerecognizer_tpu.utils.tracing import Tracer
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DIM = 8
+
+# yxyx corner boxes: OLD serving verdict, an AGREEING candidate (IoU
+# ~0.78) and a DISAGREEING one (IoU 0.0) for the parity window.
+OLD_BOX = (8.0, 8.0, 24.0, 24.0)
+GOOD_BOX = (9.0, 9.0, 25.0, 25.0)
+BAD_BOX = (0.0, 0.0, 6.0, 6.0)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _writer(tmp_path, mesh, **kw):
+    metrics = kw.pop("metrics", Metrics())
+    gallery = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names = []
+    state = StateLifecycle(str(tmp_path), metrics=metrics,
+                           checkpoint_wal_rows=1 << 30,
+                           checkpoint_every_s=1e9, **kw)
+    state.bind(gallery, names)
+    state.attach_registry(ModelRegistry(str(tmp_path), metrics=metrics))
+    return state, gallery, names, metrics
+
+
+def _enroll(state, gallery, names, rng, i, n=1):
+    emb = rng.normal(size=(n, DIM)).astype(np.float32)
+    labels = np.full(n, i, np.int32)
+    names.append(f"s{i}")
+    state.append_enrollment(emb, labels, subject=f"s{i}", label=i,
+                            apply_fn=lambda e=emb, l=labels:
+                                gallery.add(e, l))
+    return emb
+
+
+def _stage_params(state_dir, role, version, payload=b"params-blob"):
+    path = registry_params_path(str(state_dir), role, version)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as fh:
+        fh.write(payload + f"-{role}-v{version}".encode())
+    return path, _file_sha256(path)
+
+
+def _det(box):
+    def fn(frame):
+        del frame  # synthetic verdict, content-independent
+        return [np.asarray(box, np.float32)]
+    return fn
+
+
+def _frames(n, hw=(16, 16)):
+    return [np.zeros(hw, np.float32) for _ in range(n)]
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+# ---------- manifest: durability + monotonicity ----------
+
+
+def test_manifest_eager_write_and_monotonic_install(tmp_path):
+    metrics = Metrics()
+    reg = ModelRegistry(str(tmp_path), metrics=metrics)
+    # Eager write: the manifest exists from construction, so recovery
+    # and readers never have to guess versions.
+    assert os.path.exists(os.path.join(str(tmp_path), "registry.json"))
+    assert reg.stamp() == {"embedder": 1, "detector": 1, "cascade": 1}
+    assert metrics.gauge(mn.MODEL_VERSION_PREFIX + "detector") == 1
+    reg.install("detector", 2, params_path="p", params_sha256="x")
+    assert reg.version("detector") == 2
+    assert metrics.gauge(mn.MODEL_VERSION_PREFIX + "detector") == 2
+    # A second mount reads the installed version back.
+    other = ModelRegistry(str(tmp_path), readonly=True)
+    assert other.version("detector") == 2
+    # Monotonic: versions never move backward or repeat...
+    with pytest.raises(ValueError):
+        reg.install("detector", 2)
+    with pytest.raises(ValueError):
+        reg.install("detector", 1)
+    # ...and a retired (abandoned-swap) number is burned forever.
+    reg.retire("cascade", 2)
+    assert reg.version("cascade") == 1  # retirement never serves
+    with pytest.raises(ValueError):
+        reg.install("cascade", 2)
+    reg.install("cascade", 3)
+    assert reg.version("cascade") == 3
+
+
+def test_manifest_detects_torn_and_corrupt_bytes(tmp_path):
+    ModelRegistry(str(tmp_path))
+    path = os.path.join(str(tmp_path), "registry.json")
+    # Bit-flip inside the roles object: checksum mismatch = corrupt.
+    doc = json.load(open(path))
+    doc["roles"]["detector"]["version"] = 9
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    with pytest.raises(RegistryStateError) as err:
+        ModelRegistry.read_manifest(path)
+    assert err.value.reason == "corrupt"
+    # Torn write (not even JSON): unreadable — proves nothing.
+    with open(path, "wb") as fh:
+        fh.write(b"\x80\x81 torn manifest bytes")
+    with pytest.raises(RegistryStateError) as err:
+        ModelRegistry.read_manifest(path)
+    assert err.value.reason == "unreadable"
+
+
+# ---------- the detection-parity window ----------
+
+
+def test_box_iou_and_parity_verdict_match():
+    assert box_iou(OLD_BOX, OLD_BOX) == pytest.approx(1.0)
+    assert box_iou(OLD_BOX, BAD_BOX) == 0.0
+    assert box_iou(OLD_BOX, GOOD_BOX) == pytest.approx(
+        (15.0 * 15.0) / (2 * 16.0 * 16.0 - 15.0 * 15.0))
+    metrics = Metrics()
+    parity = DetectionParity(_det(OLD_BOX), _det(GOOD_BOX),
+                             min_samples=4, metrics=metrics)
+    assert not parity.ok()  # below the sample floor nothing passes
+    parity.score(_frames(4))
+    assert parity.ok() and parity.agreement == 1.0
+    assert metrics.gauge(mn.REGISTRY_PARITY_AGREEMENT) == 1.0
+    # Verdict mismatch: the old side saw a face, the candidate none.
+    miss = DetectionParity(_det(OLD_BOX), lambda f: [], min_samples=4)
+    miss.score(_frames(4))
+    assert not miss.ok() and miss.agreement == 0.0
+
+
+# ---------- the gated swap: refusal, retrain, flush, rollback ----------
+
+
+def test_detector_swap_parity_gate_refuses_degraded(tmp_path, mesh):
+    rng = np.random.default_rng(0)
+    state, gallery, names, metrics = _writer(tmp_path, mesh)
+    _enroll(state, gallery, names, rng, 0)
+    seq_before = state.wal_seq
+    co = RegistrySwapCoordinator(
+        state, state.registry, "detector", 2,
+        old_detect_fn=_det(OLD_BOX), new_detect_fn=_det(BAD_BOX),
+        parity_min_samples=8, metrics=metrics)
+    co.score_parity(_frames(12))
+    assert co.phase == "parity" and not co.parity_ok()
+    with pytest.raises(RolloutGateError):
+        co.cutover()
+    # The refusal is total: no fence burned, no manifest movement.
+    assert metrics.counter(mn.REGISTRY_SWAPS_BLOCKED) == 1
+    assert state.registry.version("detector") == 1
+    assert state.wal_seq == seq_before
+    # A coordinator with NO parity window wired refuses too (force-only).
+    blind = RegistrySwapCoordinator(state, state.registry, "cascade", 2,
+                                    metrics=metrics)
+    with pytest.raises(RolloutGateError):
+        blind.cutover()
+    # The embedder is not this coordinator's role: it needs the staged
+    # re-embed machinery, not a params swap.
+    with pytest.raises(ValueError):
+        RegistrySwapCoordinator(state, state.registry, "embedder", 2)
+    state.close()
+
+
+def test_detector_swap_retrains_facegate_against_candidate(tmp_path, mesh):
+    from opencv_facerecognizer_tpu.models.cascade import (
+        FaceGate, evaluate_gate,
+    )
+    from opencv_facerecognizer_tpu.utils.dataset import make_synthetic_scenes
+
+    rng = np.random.default_rng(1)
+    state, gallery, names, metrics = _writer(tmp_path, mesh)
+    _enroll(state, gallery, names, rng, 0)
+    scenes, boxes, counts = make_synthetic_scenes(96, (96, 96), max_faces=2,
+                                                  seed=3)
+
+    def retrain():
+        return FaceGate().train(scenes, boxes, counts, steps=300,
+                                batch_size=32)
+
+    path, sha = _stage_params(tmp_path, "detector", 2)
+    co = RegistrySwapCoordinator(
+        state, state.registry, "detector", 2,
+        old_detect_fn=_det(OLD_BOX), new_detect_fn=_det(GOOD_BOX),
+        params_path=path, gate_retrain_fn=retrain,
+        parity_min_samples=8, metrics=metrics)
+    assert co.params_sha256 == sha
+    co.score_parity(_frames(8))
+    assert co.phase == "ready"
+    co.cutover()
+    # The pair cut over atomically: the retrain ran BEFORE the fence.
+    assert co.gate_retrained is not None
+    assert metrics.counter(mn.REGISTRY_GATE_RETRAINS) == 1
+    assert metrics.counter(mn.REGISTRY_SWAPS) == 1
+    assert state.registry.version("detector") == 2
+    assert state.registry.describe("detector")["params_sha256"] == sha
+
+    # The retrained stage-1 gate holds the cascade's operating point
+    # against the NEW detector's verdicts: recall >= 0.99 on a held-out
+    # scene set, with a ground-truth-exact stage-2 oracle.
+    class OracleDetector:
+        def __init__(self, gt_boxes, gt_counts):
+            self.gt_boxes, self.gt_counts, self.pos = gt_boxes, gt_counts, 0
+
+        def detect_batch(self, chunk):
+            sl = slice(self.pos, self.pos + len(chunk))
+            self.pos += len(chunk)
+            b = self.gt_boxes[sl]
+            valid = (np.arange(b.shape[1])[None, :]
+                     < self.gt_counts[sl][:, None])
+            return b, valid.astype(np.float32), valid
+
+    held, held_boxes, held_counts = make_synthetic_scenes(
+        48, (96, 96), max_faces=2, seed=99)
+    verdict = evaluate_gate(co.gate_retrained,
+                            OracleDetector(held_boxes, held_counts),
+                            held, gt_counts=held_counts)
+    assert verdict["stage1_recall"] >= 0.99, verdict
+    state.close()
+
+
+def test_cutover_flushes_tracker_cache_per_role(tmp_path, mesh):
+    rng = np.random.default_rng(2)
+    state, gallery, names, metrics = _writer(tmp_path, mesh)
+    _enroll(state, gallery, names, rng, 0)
+    tracker = IdentityTracker(TrackerConfig(reverify_frames=4),
+                              metrics=metrics)
+    hw = (64, 64)
+    pipeline = InstantPipeline(hw)
+    svc = RecognizerService(
+        pipeline, FakeConnector(), batch_size=4, frame_shape=hw,
+        flush_timeout=0.02, inflight_depth=2, similarity_threshold=0.0,
+        metrics=metrics, tracker=tracker)
+    svc.registry = state.registry
+
+    def confirm_track():
+        frame = np.random.default_rng(0).integers(
+            20, 90, size=hw).astype(np.float32)
+        frame[10:26, 8:24] = 160.0
+        face = {"box": [8, 10, 24, 26], "label": 0, "name": "s0",
+                "similarity": 0.9, "detection_score": 0.9}
+        for _ in range(2):
+            tracker.update("cam0", [face], frame,
+                           embedder_version=state.registry.stamp_key())
+
+    confirm_track()
+    assert tracker.stats()["tracks_live"] == 1
+    co = RegistrySwapCoordinator(
+        state, state.registry, "detector", 2,
+        old_detect_fn=_det(OLD_BOX), new_detect_fn=_det(GOOD_BOX),
+        parity_min_samples=4, flush_fn=svc.flush_model_caches,
+        metrics=metrics)
+    co.score_parity(_frames(4))
+    co.cutover()
+    # The detector cutover emptied the PR 17 identity cache eagerly (the
+    # same flush covers the PR 13 cascade verdicts living in those
+    # cached results).
+    assert tracker.stats()["tracks_live"] == 0
+    assert metrics.counter(mn.REGISTRY_CACHE_FLUSHES) == 1
+    # A CASCADE cutover flushes again: per role, not once globally.
+    confirm_track()
+    assert tracker.stats()["tracks_live"] == 1
+    RegistrySwapCoordinator(
+        state, state.registry, "cascade", 2,
+        flush_fn=svc.flush_model_caches, metrics=metrics).cutover(force=True)
+    assert tracker.stats()["tracks_live"] == 0
+    assert metrics.counter(mn.REGISTRY_CACHE_FLUSHES) == 2
+    state.close()
+
+
+def test_watch_regression_auto_rolls_back_with_flight_dump(tmp_path, mesh):
+    rng = np.random.default_rng(3)
+    state_dir = tmp_path / "state"
+    trace_dir = tmp_path / "traces"
+    state, gallery, names, metrics = _writer(state_dir, mesh)
+    _enroll(state, gallery, names, rng, 0)
+    tracer = Tracer(dump_dir=str(trace_dir), metrics=metrics,
+                    min_dump_interval_s=0.0)
+    behave = {"good": True}
+
+    def candidate(frame):
+        del frame
+        return [np.asarray(GOOD_BOX if behave["good"] else BAD_BOX,
+                           np.float32)]
+
+    restored = []
+    co = RegistrySwapCoordinator(
+        state, state.registry, "detector", 2,
+        old_detect_fn=_det(OLD_BOX), new_detect_fn=candidate,
+        rollback_install_fn=lambda: restored.append(True),
+        parity_min_samples=6, watch_min_samples=6, metrics=metrics,
+        tracer=tracer)
+    co.score_parity(_frames(6))
+    co.cutover()
+    assert co.phase == "watch"
+    assert state.registry.version("detector") == 2
+    # The candidate regresses INSIDE the watch window: the live samples
+    # now disagree, and a completed window below the gate rolls back at
+    # the NEXT monotonic version — number 2 is never reused.
+    behave["good"] = False
+    co.score_parity(_frames(6))
+    assert co.phase == "rolled_back"
+    assert restored == [True]
+    assert state.registry.version("detector") == 3
+    assert metrics.counter(mn.REGISTRY_AUTO_ROLLBACKS) == 1
+    dumps = glob.glob(os.path.join(str(trace_dir),
+                                   "flight-*registry_auto_rollback*.json"))
+    assert dumps, "auto-rollback left no flight dump"
+    with open(dumps[-1]) as fh:
+        dump = json.load(fh)
+    status = dump["extra"]["registry_swap"]
+    assert status["role"] == "detector" and status["to_version"] == 2
+    assert status["parity"]["agreement"] < status["parity"]["threshold"]
+    state.close()
+
+
+# ---------- recovery: complete-or-abandon the fenced swap ----------
+
+
+def test_recovery_completes_fenced_detector_swap(tmp_path, mesh):
+    rng = np.random.default_rng(4)
+    injector = FaultInjector(seed=4)
+    state, gallery, names, _m = _writer(tmp_path, mesh,
+                                        fault_injector=injector)
+    for i in range(3):
+        _enroll(state, gallery, names, rng, i)
+    assert state.checkpoint_now(wait=True)  # a pre-swap anchor
+    _enroll(state, gallery, names, rng, 3)  # WAL-only row
+    path, sha = _stage_params(tmp_path, "detector", 2)
+    injector.script("cutover", "crash_after_record")
+    with pytest.raises(InjectedCrashError):
+        state.perform_registry_cutover("detector", 2, params_path=path,
+                                       params_sha256=sha)
+    # The dying process fsynced the fence but never installed: on-disk
+    # manifest still serves v1.
+    assert ModelRegistry(str(tmp_path), readonly=True) \
+        .version("detector") == 1
+    # "Restart": recovery verifies the staged params against the fence's
+    # checksum and COMPLETES the swap.
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    names2, m2 = [], Metrics()
+    state2 = StateLifecycle(str(tmp_path), metrics=m2)
+    report = state2.recover(g2, names2)
+    done = report["completed_registry_swaps"]
+    assert [(d["role"], d["to_version"]) for d in done] == [("detector", 2)]
+    assert m2.counter(mn.REGISTRY_SWAPS_COMPLETED_RECOVERY) == 1
+    assert state2.registry.version("detector") == 2  # auto-attached
+    assert names2 == names and g2.size == 4
+    state.close()
+    state2.close()
+
+
+def test_recovery_abandons_damaged_candidate_and_retires(tmp_path, mesh):
+    rng = np.random.default_rng(5)
+    injector = FaultInjector(seed=5)
+    state, gallery, names, _m = _writer(tmp_path, mesh,
+                                        fault_injector=injector)
+    _enroll(state, gallery, names, rng, 0)
+    path, sha = _stage_params(tmp_path, "detector", 2)
+    injector.script("cutover", "crash_after_record")
+    with pytest.raises(InjectedCrashError):
+        state.perform_registry_cutover("detector", 2, params_path=path,
+                                       params_sha256=sha)
+    # Media damage after the fence fsynced: the staged bytes rot.
+    with open(path, "ab") as fh:
+        fh.write(b"bitrot")
+    g2 = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    m2 = Metrics()
+    state2 = StateLifecycle(str(tmp_path), metrics=m2)
+    report = state2.recover(g2, [])
+    gone = report["abandoned_registry_swaps"]
+    assert [(d["role"], d["to_version"]) for d in gone] == [("detector", 2)]
+    assert m2.counter(mn.REGISTRY_SWAPS_ABANDONED_RECOVERY) == 1
+    # The role never served v2 — and the number is burned, not reusable.
+    assert state2.registry.version("detector") == 1
+    with pytest.raises(ValueError):
+        state2.registry.install("detector", 2)
+    state2.registry.install("detector", 3)
+    # The abort tombstone keeps the offline multi-role walk clean.
+    verify = _load_script("verify_checkpoint")
+    vreport = verify.verify_state_dir(str(tmp_path))
+    assert vreport["ok"], vreport
+    state.close()
+    state2.close()
+
+
+# ---------- fleet: the replica parks on the fence ----------
+
+
+def test_replica_parks_on_registry_fence_then_reanchors(tmp_path, mesh):
+    rng = np.random.default_rng(6)
+    state, wg, wnames, _m = _writer(tmp_path, mesh)
+    for i in range(3):
+        _enroll(state, wg, wnames, rng, i)
+    assert state.checkpoint_now(wait=True)
+    rg = ShardedGallery(capacity=64, dim=DIM, mesh=mesh)
+    rmetrics = Metrics()
+    rep = ReadReplica(str(tmp_path), rg, [], metrics=rmetrics,
+                      poll_interval_s=0.0, name="r")
+    rep.registry = ModelRegistry(str(tmp_path), metrics=rmetrics,
+                                 readonly=True)
+    flushes = []
+    rep.on_registry_change = flushes.append
+    rep.poll(force=True)
+    assert rep.stats()["registry"]["detector"] == 1
+    # The locked swap WITHOUT the trailing checkpoint, so the fence
+    # window is observable.
+    path, sha = _stage_params(tmp_path, "detector", 2)
+    state.perform_registry_cutover("detector", 2, params_path=path,
+                                   params_sha256=sha)
+    rep.poll(force=True)
+    parked = rep.stats()["awaiting_cutover"]
+    assert parked and parked["role"] == "detector" \
+        and parked["to_version"] == 2
+    # Rows stamped with the post-swap registry must NOT apply while
+    # parked — and the replica's served registry view has not moved.
+    _enroll(state, wg, wnames, rng, 3)
+    rep.poll(force=True)
+    assert rep.gallery.size == 3
+    assert rep.stats()["registry"]["detector"] == 1
+    assert not flushes
+    # The post-swap checkpoint lands: re-anchor, new manifest, cache
+    # flush hook, tail caught up.
+    assert state.checkpoint_now(wait=True)
+    rep.poll(force=True)
+    assert rep.stats()["awaiting_cutover"] is None
+    assert rep.stats()["registry"]["detector"] == 2
+    assert flushes and flushes[-1]["detector"] == 2
+    rep.poll(force=True)
+    assert rep.gallery.size == 4
+    state.close()
+
+
+# ---------- offline verifier: manifest + multi-role walk ----------
+
+
+def test_verify_checkpoint_registry_fence_walk(tmp_path, mesh):
+    rng = np.random.default_rng(7)
+    state, gallery, names, _m = _writer(tmp_path, mesh)
+    for i in range(2):
+        _enroll(state, gallery, names, rng, i)
+    verify = _load_script("verify_checkpoint")
+    report = verify.verify_state_dir(str(tmp_path))
+    assert report["ok"], report
+    assert report["registry"]["roles"] == {"embedder": 1, "detector": 1,
+                                           "cascade": 1}
+    # A legitimate fenced swap keeps the walk clean.
+    path, sha = _stage_params(tmp_path, "detector", 2)
+    state.perform_registry_cutover("detector", 2, params_path=path,
+                                   params_sha256=sha)
+    _enroll(state, gallery, names, rng, 2)  # a post-fence row
+    report = verify.verify_state_dir(str(tmp_path))
+    assert report["ok"], report
+    assert report["wal"]["registry_cutover_records"] == 1
+    assert report["registry"]["roles"]["detector"] == 2
+    # A row claiming a detector version NO fence introduced is the rc-2
+    # unfenced-span breach.
+    state.wal.append_enroll(99, np.ones((1, DIM), np.float32),
+                            np.zeros(1, np.int32), embedder_version=1,
+                            registry={"detector": 9, "cascade": 1})
+    report = verify.verify_state_dir(str(tmp_path))
+    assert not report["ok"]
+    assert report["wal"]["version_violations"]
+    assert verify.main([str(tmp_path)]) == 2
+    state.close()
+
+
+def test_verify_checkpoint_manifest_rc_contract(tmp_path, mesh):
+    state, gallery, names, _m = _writer(tmp_path, mesh)
+    _enroll(state, gallery, names, np.random.default_rng(8), 0)
+    state.close()
+    verify = _load_script("verify_checkpoint")
+    assert verify.verify_state_dir(str(tmp_path))["ok"]
+    path = os.path.join(str(tmp_path), "registry.json")
+    # Checksum mismatch = corruption evidence: rc 2.
+    doc = json.load(open(path))
+    doc["roles"]["detector"]["version"] = 9
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    report = verify.verify_state_dir(str(tmp_path))
+    assert not report["ok"] and report.get("registry_corrupt")
+    assert verify.main([str(tmp_path)]) == 2
+    # Torn/unparseable bytes = cannot verify: rc 3.
+    with open(path, "wb") as fh:
+        fh.write(b"\x80\x81 torn")
+    report = verify.verify_state_dir(str(tmp_path))
+    assert not report["ok"] and report.get("cannot_verify")
+    assert verify.main([str(tmp_path)]) == 3
+
+
+# ---------- CLI: startup fences + the offline swap runbook ----------
+
+
+def test_cli_registry_fence_and_offline_swap(tmp_path):
+    from opencv_facerecognizer_tpu.apps import recognize
+
+    registry = ModelRegistry(str(tmp_path))
+    ok = types.SimpleNamespace(detector_version=1, cascade_version=0)
+    recognize._registry_fence(registry, ok, "writer")  # matching: starts
+    for who in ("writer", "reader"):
+        bad = types.SimpleNamespace(detector_version=3, cascade_version=0)
+        with pytest.raises(SystemExit):
+            recognize._registry_fence(registry, bad, who)
+    with pytest.raises(SystemExit):
+        recognize._registry_fence(
+            registry,
+            types.SimpleNamespace(detector_version=0, cascade_version=5),
+            "writer")
+    # --registry-swap argument contract: ROLE=VERSION, detector/cascade
+    # only, positive integer, staged params required.
+    for spec in ("detector", "detector=abc", "embedder=2", "detector=0"):
+        with pytest.raises(SystemExit):
+            recognize.run_registry_swap(types.SimpleNamespace(
+                state_dir=str(tmp_path), registry_swap=spec))
+    with pytest.raises(SystemExit):  # nothing staged yet
+        recognize.run_registry_swap(types.SimpleNamespace(
+            state_dir=str(tmp_path), registry_swap="detector=2"))
+    # The happy-path runbook swap: stage, fence, install — rc 0, and the
+    # manifest serves v2 for the next startup fence.
+    _stage_params(tmp_path, "detector", 2)
+    assert recognize.run_registry_swap(types.SimpleNamespace(
+        state_dir=str(tmp_path), registry_swap="detector=2")) == 0
+    assert ModelRegistry(str(tmp_path), readonly=True) \
+        .version("detector") == 2
+    with pytest.raises(SystemExit):  # non-monotonic re-swap refused
+        recognize.run_registry_swap(types.SimpleNamespace(
+            state_dir=str(tmp_path), registry_swap="detector=2"))
+    # The full argparse path: --registry-swap runs WITHOUT the serving
+    # stack's --model/--detector/--gallery...
+    _stage_params(tmp_path, "cascade", 2)
+    assert recognize.main(["--state-dir", str(tmp_path),
+                           "--registry-swap", "cascade=2"]) == 0
+    assert ModelRegistry(str(tmp_path), readonly=True) \
+        .version("cascade") == 2
+    # ...but every serving mode still requires them at parse time.
+    with pytest.raises(SystemExit):
+        recognize.main(["--state-dir", str(tmp_path)])
+
+
+# ---------- GET /registry ----------
+
+
+def test_expo_registry_endpoint(tmp_path):
+    metrics = Metrics()
+    expo = ExpoServer(metrics=metrics,
+                      registry=ModelRegistry(str(tmp_path),
+                                             metrics=metrics), port=0)
+    expo.start()
+    base = f"http://{expo.host}:{expo.port}"
+    try:
+        status, payload = _get_json(base + "/registry")
+        assert status == 200
+        assert payload["registry"]["roles"]["detector"]["version"] == 1
+        assert payload["swap"] is None
+        # The same versions ride the /prom gauges.
+        with urllib.request.urlopen(base + "/prom", timeout=5) as resp:
+            text = resp.read().decode()
+        assert "ocvf_model_version_detector 1" in text
+    finally:
+        expo.stop()
+    bare = ExpoServer(metrics=Metrics(), port=0)
+    bare.start()
+    try:
+        status, payload = _get_json(
+            f"http://{bare.host}:{bare.port}/registry")
+        assert status == 200 and payload["registry"] is None
+    finally:
+        bare.stop()
+
+
+# ---------- chaos: the fast deterministic tier-1 variant ----------
+
+
+def test_registry_chaos_fast_deterministic():
+    chaos_soak = _load_script("chaos_soak")
+    report = chaos_soak.run_registry(seconds=3.0, seed=7)
+    assert report["ok"], report["failures"]
+    # Kill mid-detector-swap completed on restart; the damaged cascade
+    # candidate was cleanly abandoned; the regressing detector
+    # auto-rolled-back at the next monotonic version.
+    roles = report["verify"]["registry"]["roles"]
+    assert roles["detector"] == 4 and roles["cascade"] == 1
+    assert report["auto_rollback"]["phase"] == "rolled_back"
+    assert report["rollback_dump"]["role"] == "detector"
+    assert report["verify"]["ok"]

@@ -887,7 +887,8 @@ def test_expo_concurrent_get_hammer_no_500s_counters_consistent():
 def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
                offered=120, ratio=1.0, scaleout_x2=2.0, parity=1.0,
                cutover_ratio=0.95, ingest_p99=0.6, ingest_uplift=2.5,
-               cascade_uplift=4.0, video_uplift=2.8, failover_s=0.25):
+               cascade_uplift=4.0, video_uplift=2.8, failover_s=0.25,
+               registry_parity=1.0, registry_ratio=0.93):
     return {
         "modes": {"overlapped": {
             "e2e_p50_ms": e2e, "dropped_frames": dropped,
@@ -900,6 +901,8 @@ def _smoke_doc(e2e=10.0, ready=3.0, dropped=0, p99=80.0, done=120,
         "replica_scaleout": {"scaling": {"x2": scaleout_x2}},
         "rollout": {"parity_agreement": parity,
                     "cutover_window_completed_ratio": cutover_ratio},
+        "registry": {"parity_agreement": registry_parity,
+                     "swap_window_completed_ratio": registry_ratio},
         "ingest": {"h2d": {"32": {"uint8_ring": {"p99_ms": ingest_p99}}},
                    "uplift": {"b32": {"uplift": ingest_uplift}}},
         "cascade": {"uplift": {"d0": {"uplift": cascade_uplift}}},

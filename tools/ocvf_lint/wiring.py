@@ -69,6 +69,12 @@ ATTR_HINTS: Dict[str, str] = {
     # is the per-replica track -> identity cache consulted on the
     # dispatch thread and updated on the readback worker.
     "tracker": "IdentityTracker",
+    # Versioned model registry (ISSUE 18): ``self.registry`` is the durable
+    # per-role version manifest every holder (lifecycle, service, replica)
+    # consults; ``registry_swap`` is the live detector/cascade swap
+    # coordinator whose parity window the readback worker feeds.
+    "registry": "ModelRegistry",
+    "registry_swap": "RegistrySwapCoordinator",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -87,6 +93,11 @@ HOT_PATH_SUFFIXES: Tuple[str, ...] = (
     # the dispatch AND readback threads: pure host NumPy by contract —
     # any device sync sneaking in here would stall the serving loop.
     "runtime/tracker.py",
+    # The model registry's live-parity window (ISSUE 18) is fed from the
+    # readback worker (``offer_live`` per published batch): its scoring is
+    # host-side box math by contract, so the module is scanned like the
+    # rest of the hot loop.
+    "runtime/registry.py",
 )
 
 #: Modules that OWN the epoch-pairing protocol (PR 6): only they may touch
