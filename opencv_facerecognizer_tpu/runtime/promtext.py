@@ -15,6 +15,8 @@ orchestrator/scrape stack speaks the Prometheus text format
 - **prefix families** are folded into labels: the registry's dynamic
   families (``frames_rejected_<reason>``, ``batcher_dropped_<reason>``,
   ``slo_burn_<objective>``, ``slo_events_<reason>``,
+  ``track_flushes_<reason>``, ``transport_fault_<kind>``,
+  ``router_rejected_<reason>``,
   ``stage_share_b<bucket>_<stage>``) become one metric each with a
   ``reason=`` / ``objective=`` / ``bucket=``+``stage=`` label instead of
   N single-sample families — the Prometheus-idiomatic shape, and the
@@ -48,6 +50,9 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str], ...] = (
     (mn.BATCHER_DROPPED_PREFIX, "batcher_dropped", "reason"),
     (mn.SLO_EVENTS_PREFIX, "slo_events", "reason"),
     (mn.SLO_BURN_PREFIX, "slo_burn", "objective"),
+    (mn.TRACK_FLUSHES_PREFIX, "track_flushes", "reason"),
+    (mn.TRANSPORT_FAULTS_PREFIX, "transport_fault", "kind"),
+    (mn.ROUTER_REJECTED_PREFIX, "router_rejected", "reason"),
 )
 
 _NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")

@@ -581,19 +581,7 @@ class RecognizerService:
     #: is quiescent (``in_system`` = the live remainder otherwise).
     #: Pre-admission rejections (``frames_rejected_*``) are outside by
     #: design — a rejected frame never entered.
-    LEDGER_DROP_COUNTERS = (
-        mn.FRAMES_MALFORMED,            # admitted, then failed to decode
-        mn.FRAMES_DROPPED_DECODE,       # compressed payload lost in the
-                                        # decode pool (corrupt / backlog)
-        mn.BATCHER_DROPPED_MALFORMED,   # poisoned at the put boundary
-        mn.BATCHER_DROPPED_OVERFLOW,    # priority-aware overflow eviction
-        mn.BATCHER_DROPPED_STALE,       # outlived shed_stale_after_s queued
-        mn.BATCHER_DROPPED_CLOSED,      # arrived during shutdown
-        mn.FRAMES_DROPPED_BROWNOUT,     # shed by the brownout controller
-        mn.FRAMES_DEAD_LETTERED,        # readback outlived its deadline
-        mn.FRAMES_FAILED,               # dispatch abandoned (retry budget)
-        mn.FRAMES_DROPPED_CRASHED,      # lost to a serving-thread crash
-    )
+    LEDGER_DROP_COUNTERS = mn.LEDGER_DROP_COUNTERS
 
     def ledger(self) -> Dict[str, Any]:
         """One atomic admission-ledger snapshot: ``admitted``,

@@ -297,7 +297,7 @@ class CheckpointStore:
                                 f"ckpt-{seq:08d}{CHECKPOINT_SUFFIX}")
             if fault == "torn":
                 # Die mid-write: a durable partial tmp, never renamed.
-                with open(path + ".tmp", "wb") as fh:
+                with open(path + ".tmp", "wb") as fh:  # ocvf-lint: boundary=fence-ordering -- fault injection simulating the torn write atomic_write_* exists to prevent: partial tmp, never renamed, recovery must ignore it
                     fh.write(blob[:max(1, len(blob) // 2)])
                     fh.flush()
                     os.fsync(fh.fileno())
@@ -305,7 +305,7 @@ class CheckpointStore:
             if fault == "crash":
                 # Die after the tmp completes but before the rename: the
                 # checkpoint never installs.
-                with open(path + ".tmp", "wb") as fh:
+                with open(path + ".tmp", "wb") as fh:  # ocvf-lint: boundary=fence-ordering -- fault injection: a COMPLETE tmp that dies before the rename; the durable install below still goes through atomic_write_bytes
                     fh.write(blob)
                     fh.flush()
                     os.fsync(fh.fileno())
@@ -1425,7 +1425,7 @@ class StateLifecycle:
                                            embedder_version=gver,
                                            registry=self._role_stamp())
                 except InjectedCrashError:
-                    raise  # simulated kill: no post-mortem writes
+                    raise  # ocvf-lint: boundary=resource-pairing -- simulated kill: the burned seq leaks ON PURPOSE so recovery's abort/replay handling of a half-landed record is exercised; a real crash writes nothing post-mortem either
                 except BaseException as exc:
                     # Best-effort tombstone for the possibly-landed record;
                     # if this fails too the residual risk is the documented

@@ -457,6 +457,46 @@ SUPERVISOR_GAVE_UP = "supervisor_gave_up"
 SUPERVISOR_DURABLE_RESTORES = "supervisor_durable_restores"
 
 
+# ---- ledger source-of-truth tables (ocvf-lint ledger-registry-coherence) ---
+# The admission-ledger invariant is
+#   admitted == Σ(LEDGER_COMPLETION_COUNTERS) + Σ(LEDGER_DROP_COUNTERS)
+# at quiescence.  These two tables are THE definition of "terminal status":
+# the runtime (RecognizerService.ledger/frames_in_system), the span reducer
+# (tracing.account_spans), the chaos soak's span mirror, and the settle-once
+# lint rule all derive from them.  A new terminal bucket starts here; the
+# ledger-registry-coherence rule flags every mirror site that missed it.
+LEDGER_COMPLETION_COUNTERS = (
+    FRAMES_COMPLETED,
+    FRAMES_COMPLETED_EMPTY,
+    FRAMES_COMPLETED_CACHED,
+)
+LEDGER_DROP_COUNTERS = (
+    FRAMES_MALFORMED,
+    FRAMES_DROPPED_DECODE,
+    BATCHER_DROPPED_MALFORMED,
+    BATCHER_DROPPED_OVERFLOW,
+    BATCHER_DROPPED_STALE,
+    BATCHER_DROPPED_CLOSED,
+    FRAMES_DROPPED_BROWNOUT,
+    FRAMES_DEAD_LETTERED,
+    FRAMES_FAILED,
+    FRAMES_DROPPED_CRASHED,
+)
+
+#: The dynamic prefix families promtext folds into labeled Prometheus
+#: families (plus STAGE_SHARE_PREFIX, which gets its own two-label
+#: parser).  promtext._LABEL_FAMILIES must mirror this set exactly.
+PROM_FOLDED_PREFIXES = (
+    FRAMES_REJECTED_PREFIX,
+    BATCHER_DROPPED_PREFIX,
+    SLO_EVENTS_PREFIX,
+    SLO_BURN_PREFIX,
+    TRACK_FLUSHES_PREFIX,
+    TRANSPORT_FAULTS_PREFIX,
+    ROUTER_REJECTED_PREFIX,
+)
+
+
 def all_names():
     """Every registered full metric name (prefix families excluded) —
     used by tests to assert the registry has no duplicate values."""

@@ -494,10 +494,12 @@ def test_cli_exit_2_on_internal_error(tmp_path):
 ALL_RULES = ("lock-order", "blocking-under-lock", "non-atomic-write",
              "metrics-registry", "swallowed-exception",
              "jit-recompile-hazard", "host-sync", "prng-discipline",
-             "epoch-pairing", "wal-before-mutate")
+             "epoch-pairing", "wal-before-mutate",
+             "settle-once", "resource-pairing", "fence-ordering",
+             "ledger-registry-coherence")
 
 
-def test_cli_list_rules_names_all_ten(tmp_path):
+def test_cli_list_rules_names_all_fourteen(tmp_path):
     proc = _cli("--list-rules")
     assert proc.returncode == 0
     for rule in ALL_RULES:
@@ -509,10 +511,12 @@ def test_cli_list_rules_names_all_ten(tmp_path):
 
 def test_real_tree_has_zero_findings():
     """The acceptance bar: ``python -m tools.ocvf_lint
-    opencv_facerecognizer_tpu scripts`` exits 0 at head, with all TEN
-    rules active (v2 added jit-recompile-hazard / host-sync /
-    prng-discipline / epoch-pairing / wal-before-mutate) and every
-    suppression/boundary justified."""
+    opencv_facerecognizer_tpu scripts`` exits 0 at head, with all
+    FOURTEEN rules active (v2 added jit-recompile-hazard / host-sync /
+    prng-discipline / epoch-pairing / wal-before-mutate; v3 added
+    settle-once / resource-pairing / fence-ordering /
+    ledger-registry-coherence) and every suppression/boundary
+    justified."""
     proc = _cli("opencv_facerecognizer_tpu", "scripts", "--json",
                 "--no-cache")
     assert proc.returncode == 0, f"lint found issues:\n{proc.stdout}\n{proc.stderr}"
@@ -968,6 +972,544 @@ def test_boundary_counts_reported_separately(tmp_path):
     assert result.findings == []
     assert result.boundaries_used == 1
     assert result.suppressions_used == 0
+
+
+# ---------------- settle-once (v3) ----------------
+
+#: minimal ledger registry shared by the settle-once fixtures: the rule
+#: resolves terminal statuses through these tables, not hard-coded names.
+_MN_FIXTURE = """\
+    FRAMES_COMPLETED = "frames_completed"
+    FRAMES_FAILED = "frames_failed"
+    BATCHER_DROPPED_PREFIX = "batcher_dropped_"
+    FRAMES_ADMITTED = "frames_admitted"
+    LEDGER_COMPLETION_COUNTERS = (FRAMES_COMPLETED,)
+    LEDGER_DROP_COUNTERS = (FRAMES_FAILED,)
+    PROM_FOLDED_PREFIXES = ()
+    """
+
+
+def test_settle_once_unsettled_incr_on_exit_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": _MN_FIXTURE,
+        "runtime/service.py": """\
+            from utils import metric_names as mn
+
+            class RecognizerService:
+                def fail_path(self, tids, count):
+                    self.metrics.incr(mn.FRAMES_FAILED, count)
+                    return count
+            """,
+    }, rules=["settle-once"])
+    assert rules_and_lines(findings) == [("settle-once", 5)]
+    assert "without a matching settle sink" in findings[0].message
+
+
+def test_settle_once_double_settlement_on_crash_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": _MN_FIXTURE,
+        "runtime/service.py": """\
+            from utils import metric_names as mn
+
+            class RecognizerService:
+                def crash(self, tid):
+                    self.metrics.incr(mn.FRAMES_FAILED)
+                    self._trace_settle([tid], mn.FRAMES_FAILED, "a")
+                    self._trace_settle([tid], mn.FRAMES_FAILED, "b")
+                    raise RuntimeError("boom")
+            """,
+    }, rules=["settle-once"])
+    # the raising path skips balance (crash handlers settle elsewhere)
+    # but a double settlement of the same basis+status still fires.
+    assert rules_and_lines(findings) == [("settle-once", 7)]
+    assert "settles the same frame run twice" in findings[0].message
+
+
+def test_settle_once_balanced_paths_and_prefix_family_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": _MN_FIXTURE,
+        "runtime/service.py": """\
+            from utils import metric_names as mn
+
+            class FrameBatcher:
+                def drop(self, entry, reason):
+                    self.metrics.incr(mn.BATCHER_DROPPED_PREFIX + reason)
+                    self._emit_settle(entry[3],
+                                      mn.BATCHER_DROPPED_PREFIX + reason,
+                                      "batcher")
+                    return False
+
+            class RecognizerService:
+                def publish(self, tids, published, rejected):
+                    self.metrics.incr(mn.FRAMES_ADMITTED)
+                    try:
+                        self.emit(tids)
+                    finally:
+                        self.metrics.incr(mn.FRAMES_COMPLETED, published)
+                        self._trace_settle(tids, mn.FRAMES_COMPLETED, "ok")
+                    if published < len(rejected):
+                        self.metrics.incr(mn.FRAMES_FAILED)
+                        self._trace_settle(tids, mn.FRAMES_FAILED, "fail")
+            """,
+    }, rules=["settle-once"])
+    # FRAMES_ADMITTED is not terminal; both terminal incrs pair exactly.
+    assert findings == []
+
+
+def test_settle_once_literal_status_is_flagged(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": _MN_FIXTURE,
+        "runtime/service.py": """\
+            from utils import metric_names as mn
+
+            class RecognizerService:
+                def fail_path(self, tid):
+                    self.metrics.incr(mn.FRAMES_FAILED)
+                    self._trace_settle([tid], "frames_failed", "x")
+            """,
+    }, rules=["settle-once"])
+    # balance holds (the literal still pairs) — only hygiene fires.
+    assert rules_and_lines(findings) == [("settle-once", 6)]
+    assert "string literal" in findings[0].message
+
+
+def test_settle_once_suppression(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "utils/metric_names.py": _MN_FIXTURE,
+        "runtime/service.py": """\
+            from utils import metric_names as mn
+
+            class RecognizerService:
+                def fail_path(self, tids, count):
+                    self.metrics.incr(mn.FRAMES_FAILED, count)  # ocvf-lint: disable=settle-once -- settled by the caller's crash handler in this fixture
+                    return count
+            """,
+    }, rules=["settle-once"])
+    assert findings == []
+
+
+# ---------------- resource-pairing (v3) ----------------
+
+
+def test_resource_pairing_custody_leak_and_boundary(tmp_path):
+    source = """\
+        class FrameBatcher:
+            def pop(self, count):
+                buf = self._ring.acquire(count)
+                data = self.fill(count)
+                if data is None:
+                    return None
+                self.out.append((data, buf))
+                return data
+        """
+    findings = lint_tree(tmp_path, {"runtime/batcher.py": source},
+                         rules=["resource-pairing"])
+    # anchored at the acquire, with the leaking exit as an also-site.
+    assert rules_and_lines(findings) == [("resource-pairing", 3)]
+    assert findings[0].also == ((str(tmp_path / "runtime" / "batcher.py"), 6),)
+    # a boundary annotation on the leaking EXIT line sanctions the path.
+    suppressed = source.replace(
+        "return None",
+        "return None  # ocvf-lint: boundary=resource-pairing -- fixture: caller inherits the buffer through self.pending on this path")
+    findings = lint_tree(tmp_path / "b", {"runtime/batcher.py": suppressed},
+                         rules=["resource-pairing"])
+    assert findings == []
+
+
+def test_resource_pairing_release_forfeit_and_handoff_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/batcher.py": """\
+            class FrameBatcher:
+                def pop(self, count):
+                    buf = self._ring.acquire(count)
+                    try:
+                        data = self.fill(count)
+                    except Exception:
+                        self._ring.forfeit(buf)
+                        raise
+                    self._ring.recycle(buf)
+                    return data
+
+                def pop_handoff(self, count):
+                    buf = self._ring.acquire(count)
+                    return self.pack(buf)
+            """,
+    }, rules=["resource-pairing"])
+    assert findings == []
+
+
+def test_resource_pairing_forfeit_missing_on_crash_path(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/batcher.py": """\
+            class FrameBatcher:
+                def pop(self, count):
+                    buf = self._ring.acquire(count)
+                    try:
+                        data = self.fill(count)
+                    except Exception:
+                        self.log("fill failed")
+                        raise
+                    self._ring.recycle(buf)
+                    return data
+            """,
+    }, rules=["resource-pairing"])
+    # the normal path releases; the crash path leaks the buffer.
+    assert rules_and_lines(findings) == [("resource-pairing", 3)]
+    assert "crash paths" in findings[0].message
+
+
+def test_resource_pairing_discarded_acquire(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/batcher.py": """\
+            class FrameBatcher:
+                def warm(self, count):
+                    self._ring.acquire(count)
+            """,
+    }, rules=["resource-pairing"])
+    assert rules_and_lines(findings) == [("resource-pairing", 3)]
+    assert "discarded" in findings[0].message
+
+
+def test_resource_pairing_seq_burn_and_watermark(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/state_store.py": """\
+            class StateLifecycle:
+                def enroll(self, rows):
+                    seq = self._wal_seq = self._wal_seq + 1
+                    if not rows:
+                        raise ValueError("empty enrollment")
+                    self.wal.append_enroll(seq, rows)
+                    return seq
+
+                def adopt(self, highest):
+                    self._wal_seq = max(self._wal_seq, int(highest))
+                    return self._wal_seq
+            """,
+    }, rules=["resource-pairing"])
+    # the early raise leaks the burned seq; watermark seeding is NOT a
+    # burn (max(), not the increment idiom) and stays silent.
+    assert rules_and_lines(findings) == [("resource-pairing", 3)]
+    assert "append_*" in findings[0].message
+
+
+def test_resource_pairing_seq_burn_abort_path_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/state_store.py": """\
+            class StateLifecycle:
+                def enroll(self, rows):
+                    seq = self._wal_seq = self._wal_seq + 1
+                    try:
+                        self.wal.append_enroll(seq, rows)
+                    except BaseException:
+                        self.wal.append_abort(seq)
+                        raise
+                    return seq
+            """,
+    }, rules=["resource-pairing"])
+    assert findings == []
+
+
+def test_resource_pairing_lifecycle_needs_with(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "mod.py": """\
+            class Worker:
+                def bad(self):
+                    span = self._tracer.lifecycle("swap")
+                    return span
+
+                def good(self):
+                    with self._tracer.lifecycle("swap"):
+                        return 1
+            """,
+    }, rules=["resource-pairing"])
+    assert rules_and_lines(findings) == [("resource-pairing", 3)]
+    assert "contextmanager" in findings[0].message
+
+
+# ---------------- fence-ordering (v3) ----------------
+
+
+def test_fence_ordering_install_before_fence(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/state_store.py": """\
+            class StateLifecycle:
+                def perform_cutover(self, to_version, emb):
+                    seq = self.alloc()
+                    self.gallery.load_snapshot(emb, to_version)
+                    self.wal.append_cutover(seq, to_version)
+                    return seq
+            """,
+    }, rules=["fence-ordering"])
+    assert rules_and_lines(findings) == [("fence-ordering", 4)]
+    assert "before the WAL fence append" in findings[0].message
+
+
+def test_fence_ordering_fence_first_with_faults_clean(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/state_store.py": """\
+            class StateLifecycle:
+                def perform_cutover(self, to_version, emb, fault):
+                    seq = self.alloc()
+                    if fault == "before":
+                        raise RuntimeError("crash before record")
+                    self.wal.append_cutover(seq, to_version)
+                    if fault == "after":
+                        raise RuntimeError("crash after record")
+                    self.gallery.load_snapshot(emb, to_version)
+                    return seq
+
+                def perform_registry_cutover(self, role, install_fn):
+                    seq = self.alloc()
+                    self.wal.append_registry_cutover(seq, role)
+                    self.registry.install(role)
+                    install_fn()
+                    return seq
+            """,
+    }, rules=["fence-ordering"])
+    assert findings == []
+
+
+def test_fence_ordering_installer_callback_before_fence(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/state_store.py": """\
+            class StateLifecycle:
+                def perform_registry_cutover(self, role, install_fn):
+                    seq = self.alloc()
+                    install_fn()
+                    self.wal.append_registry_cutover(seq, role)
+                    return seq
+            """,
+    }, rules=["fence-ordering"])
+    assert rules_and_lines(findings) == [("fence-ordering", 4)]
+
+
+def test_fence_ordering_durable_writer_needs_atomic_helper(tmp_path):
+    findings = lint_tree(tmp_path, {
+        "runtime/registry.py": """\
+            class ModelRegistry:
+                def _save_locked(self):
+                    with open(self.path, "w") as fh:
+                        fh.write(self.blob)
+            """,
+    }, rules=["fence-ordering"])
+    got = rules_and_lines(findings)
+    # the bare write-mode open AND the missing atomic_write_* both fire.
+    assert ("fence-ordering", 3) in got
+    assert ("fence-ordering", 2) in got
+    clean = lint_tree(tmp_path / "b", {
+        "runtime/registry.py": """\
+            class ModelRegistry:
+                def _save_locked(self):
+                    atomic_write_json(self.path, self.blob)
+            """,
+    }, rules=["fence-ordering"])
+    assert clean == []
+
+
+# ---------------- ledger-registry-coherence (v3) ----------------
+
+_COHERENT_TREE = {
+    "utils/metric_names.py": """\
+        FRAMES_COMPLETED = "frames_completed"
+        FRAMES_COMPLETED_EMPTY = "frames_completed_empty"
+        FRAMES_FAILED = "frames_failed"
+        REJ_PREFIX = "frames_rejected_"
+        LEDGER_COMPLETION_COUNTERS = (FRAMES_COMPLETED,
+                                      FRAMES_COMPLETED_EMPTY)
+        LEDGER_DROP_COUNTERS = (FRAMES_FAILED,)
+        PROM_FOLDED_PREFIXES = (REJ_PREFIX,)
+        """,
+    "utils/tracing.py": """\
+        OUTCOME_COMPLETED = "completed"
+        OUTCOME_COMPLETED_EMPTY = "completed_empty"
+
+        def account_spans(spans):
+            return {OUTCOME_COMPLETED: 0, OUTCOME_COMPLETED_EMPTY: 0}
+        """,
+    "runtime/recognizer.py": """\
+        from utils import metric_names as mn
+
+        class RecognizerService:
+            LEDGER_DROP_COUNTERS = mn.LEDGER_DROP_COUNTERS
+
+            def ledger(self):
+                return (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+                        self.LEDGER_DROP_COUNTERS)
+
+            def frames_in_system(self):
+                return (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+                        self.LEDGER_DROP_COUNTERS)
+        """,
+    "runtime/promtext.py": """\
+        from utils import metric_names as mn
+
+        _LABEL_FAMILIES = ((mn.REJ_PREFIX, "frames_rejected", "reason"),)
+        """,
+    "scripts/chaos_soak.py": """\
+        def _check_span_accounting(acct):
+            assert acct["completed"] >= 0
+            assert acct["completed_empty"] >= 0
+        """,
+}
+
+
+def test_coherence_full_tree_is_clean(tmp_path):
+    findings = lint_tree(tmp_path, dict(_COHERENT_TREE),
+                         rules=["ledger-registry-coherence"])
+    assert findings == []
+
+
+def test_coherence_missing_tracing_mirror_and_reducer_ref(tmp_path):
+    tree = dict(_COHERENT_TREE)
+    tree["utils/tracing.py"] = """\
+        OUTCOME_COMPLETED = "completed"
+
+        def account_spans(spans):
+            return {OUTCOME_COMPLETED: 0}
+        """
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert [f.rule for f in findings] == ["ledger-registry-coherence"]
+    assert "no OUTCOME_* mirror" in findings[0].message
+    assert "completed_empty" in findings[0].message
+
+
+def test_coherence_recognizer_drop_tuple_drift(tmp_path):
+    tree = dict(_COHERENT_TREE)
+    tree["runtime/recognizer.py"] = """\
+        from utils import metric_names as mn
+
+        class RecognizerService:
+            LEDGER_DROP_COUNTERS = (mn.FRAMES_FAILED, mn.FRAMES_BOGUS)
+
+            def ledger(self):
+                return (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+                        self.LEDGER_DROP_COUNTERS)
+
+            def frames_in_system(self):
+                return (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+                        self.LEDGER_DROP_COUNTERS)
+        """
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert rules_and_lines(findings) == [("ledger-registry-coherence", 4)]
+    assert "drifted from the registry table" in findings[0].message
+
+
+def test_coherence_missing_completion_in_ledger_surface(tmp_path):
+    tree = dict(_COHERENT_TREE)
+    tree["runtime/recognizer.py"] = """\
+        from utils import metric_names as mn
+
+        class RecognizerService:
+            LEDGER_DROP_COUNTERS = mn.LEDGER_DROP_COUNTERS
+
+            def ledger(self):
+                return (mn.FRAMES_COMPLETED, self.LEDGER_DROP_COUNTERS)
+
+            def frames_in_system(self):
+                return (mn.FRAMES_COMPLETED, mn.FRAMES_COMPLETED_EMPTY,
+                        self.LEDGER_DROP_COUNTERS)
+        """
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert rules_and_lines(findings) == [("ledger-registry-coherence", 6)]
+    assert "FRAMES_COMPLETED_EMPTY" in findings[0].message
+
+
+def test_coherence_promtext_family_drift(tmp_path):
+    tree = dict(_COHERENT_TREE)
+    tree["runtime/promtext.py"] = """\
+        from utils import metric_names as mn
+
+        _LABEL_FAMILIES = ()
+        """
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert rules_and_lines(findings) == [("ledger-registry-coherence", 3)]
+    assert "REJ_PREFIX" in findings[0].message
+
+
+def test_coherence_chaos_soak_missing_outcome(tmp_path):
+    tree = dict(_COHERENT_TREE)
+    tree["scripts/chaos_soak.py"] = """\
+        def _check_span_accounting(acct):
+            assert acct["completed"] >= 0
+        """
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert rules_and_lines(findings) == [("ledger-registry-coherence", 1)]
+    assert "completed_empty" in findings[0].message
+
+
+def test_coherence_sites_absent_from_subset_lint_are_skipped(tmp_path):
+    tree = {k: v for k, v in _COHERENT_TREE.items()
+            if k in ("utils/metric_names.py", "scripts/chaos_soak.py")}
+    findings = lint_tree(tmp_path, tree,
+                         rules=["ledger-registry-coherence"])
+    assert findings == []
+
+
+# ---------------- v3 scratch-copy deletion gates ----------------
+# The acceptance demonstration: delete ONE settlement call / custody
+# overwrite / fence append from a copy of the REAL tree and the matching
+# rule must fire at the mutated site.
+
+
+def _real_source(rel):
+    with open(os.path.join(REPO_ROOT, rel), "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_scratch_delete_settlement_call_fires_settle_once(tmp_path):
+    src = _real_source("opencv_facerecognizer_tpu/runtime/recognizer.py")
+    needle = ('self._trace_settle(trace_ids[:count], mn.FRAMES_FAILED,\n'
+              '                                   "dispatch.abandoned", '
+              'batch=batch_tid)\n                ')
+    assert needle in src, "recognizer settle site moved; update the fixture"
+    mutated = src.replace(needle, "", 1)
+    path = tmp_path / "runtime" / "recognizer.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(mutated)
+    findings = core.run([str(tmp_path)], rules=["settle-once"]).findings
+    incr_line = mutated.splitlines().index(
+        "                self.metrics.incr(mn.FRAMES_FAILED, count)") + 1
+    assert ("settle-once", incr_line) in rules_and_lines(findings)
+
+
+def test_scratch_break_custody_overwrite_fires_resource_pairing(tmp_path):
+    src = _real_source("opencv_facerecognizer_tpu/runtime/batcher.py")
+    assert "buf = _EXHAUSTED" in src, "batcher custody site moved"
+    # the exhausted-branch overwrite is what ENDS custody of the acquired
+    # buffer on the retry path; renaming it leaks custody to `return None`
+    mutated = src.replace("buf = _EXHAUSTED", "buf_retry = _EXHAUSTED", 1)
+    path = tmp_path / "runtime" / "batcher.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(mutated)
+    findings = core.run([str(tmp_path)],
+                        rules=["resource-pairing"]).findings
+    acquire_line = next(i for i, line in enumerate(mutated.splitlines(), 1)
+                        if "self._ring.acquire(" in line)
+    assert ("resource-pairing", acquire_line) in rules_and_lines(findings)
+
+
+def test_scratch_delete_fence_append_fires_fence_ordering(tmp_path):
+    src = _real_source("opencv_facerecognizer_tpu/runtime/state_store.py")
+    needle = ("""self.wal.append_cutover(seq, from_version, int(to_version),
+                                    rows=int(size), dim=int(emb.shape[1]))""")
+    assert needle in src, "cutover fence site moved; update the fixture"
+    mutated = src.replace(needle, "_ = seq", 1)
+    path = tmp_path / "runtime" / "state_store.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(mutated)
+    findings = core.run([str(tmp_path)],
+                        rules=["fence-ordering"]).findings
+    lines = mutated.splitlines()
+    mark = next(i for i, line in enumerate(lines, 1)
+                if line.strip() == "_ = seq")
+    install_line = next(i for i, line in enumerate(lines, 1)
+                        if i > mark and "load_snapshot(" in line)
+    assert ("fence-ordering", install_line) in rules_and_lines(findings)
 
 
 # ---------------- incremental cache ----------------

@@ -3,12 +3,16 @@
 from tools.ocvf_lint.checkers import (  # noqa: F401
     blocking_under_lock,
     epoch_pairing,
+    fence_ordering,
     host_sync,
     jit_recompile_hazard,
+    ledger_coherence,
     lock_order,
     metrics_registry,
     non_atomic_write,
     prng_discipline,
+    resource_pairing,
+    settle_once,
     swallowed_exception,
     wal_before_mutate,
 )

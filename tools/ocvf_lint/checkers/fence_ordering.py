@@ -1,0 +1,170 @@
+"""fence-ordering: model/registry cutovers must append the WAL fence record
+BEFORE installing anything into live state, on every exit path.
+
+The cutover protocol (state_store / registry / rollout) is: append the
+cutover fence to the WAL (the durable declaration "a swap is happening"),
+then install the new snapshot/detector into the live gallery or registry.
+If the process crashes between the two, recovery replays the fence and
+re-drives the install — the swap is exactly-once.  Inverting the order
+breaks that: an install that lands before the fence is invisible to
+recovery, so a crash in the window leaves live state ahead of the WAL and
+the next replay serves stale identities against a new detector.
+
+Two checks:
+
+- path ordering: inside the designated cutover functions
+  (``wiring.FENCE_CUTOVER_FUNCS``) in fence-bearing modules, no exit path
+  may execute an install call (``install``/``load_snapshot``/a designated
+  installer callback) before the fence append
+  (``wiring.FENCE_APPEND_ATTRS``).  Raising paths count — installing and
+  THEN crashing before the fence is precisely the broken window.
+- durable writers: the methods that persist registry/checkpoint bytes
+  (``wiring.FENCE_DURABLE_WRITERS``) must go through an ``atomic_write_*``
+  helper and never a bare ``open(..., "w")`` — a torn registry file turns
+  every later cutover into a parse error at recovery time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from tools.ocvf_lint import wiring
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+from tools.ocvf_lint.exitpaths import enumerate_exit_paths, walk_events
+
+_WRITE_MODES = ("w", "a", "x")
+
+
+@register
+class FenceOrderingChecker(Checker):
+    rule = "fence-ordering"
+    description = ("cutover functions must append the WAL fence before any "
+                   "install; durable registry writers must use "
+                   "atomic_write_* helpers")
+    boundary_capable = True
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        if not wiring.path_matches(ctx.path, wiring.FENCE_MODULE_SUFFIXES):
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wiring.FENCE_CUTOVER_FUNCS:
+                findings.extend(self._check_cutover(ctx, node))
+        findings.extend(self._check_durable_writers(ctx))
+        return findings
+
+    # ---- path ordering ----
+
+    @staticmethod
+    def _classify(call: ast.Call) -> str:
+        if isinstance(call.func, ast.Attribute):
+            if call.func.attr in wiring.FENCE_APPEND_ATTRS:
+                return "fence"
+            if call.func.attr in wiring.FENCE_INSTALL_ATTRS:
+                return "install"
+        elif isinstance(call.func, ast.Name) \
+                and call.func.id in wiring.FENCE_INSTALL_FN_NAMES:
+            return "install"
+        return ""
+
+    def _check_cutover(self, ctx: FileContext, fn: ast.AST) -> List[Finding]:
+        memo: Dict[int, List[Tuple]] = {}
+
+        def extract(node: ast.AST) -> List[Tuple]:
+            key = id(node)
+            if key not in memo:
+                evs = []
+                for sub in walk_events(node):
+                    if isinstance(sub, ast.Call):
+                        kind = self._classify(sub)
+                        if kind:
+                            evs.append((kind, sub))
+                memo[key] = evs
+            return memo[key]
+
+        paths, truncated = enumerate_exit_paths(
+            fn.body, extract, optional_attrs=wiring.OPTIONAL_SURFACE_ATTRS)
+        if truncated:
+            return []
+        findings: List[Finding] = []
+        reported: Set[int] = set()
+        for path in paths:
+            fence_seen = False
+            for kind, node in path.events:
+                if kind == "fence":
+                    fence_seen = True
+                elif not fence_seen:
+                    if id(node) not in reported:
+                        reported.add(id(node))
+                        end_line = getattr(path.end, "lineno", None)
+                        also = (((ctx.path, end_line),)
+                                if end_line is not None else ())
+                        findings.append(ctx.finding(
+                            self.rule, node,
+                            f"{fn.name}: install executes before the WAL "
+                            f"fence append on this path — a crash in the "
+                            f"window leaves live state ahead of the WAL and "
+                            f"recovery cannot re-drive the swap (append the "
+                            f"{'/'.join(sorted(wiring.FENCE_APPEND_ATTRS))} "
+                            f"record first)", also=also))
+        return findings
+
+    # ---- durable writers ----
+
+    def _check_durable_writers(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        classes = {node.name: node for node in ctx.tree.body
+                   if isinstance(node, ast.ClassDef)}
+        for cls_name, method_name in wiring.FENCE_DURABLE_WRITERS:
+            cls = classes.get(cls_name)
+            if cls is None:
+                continue
+            for sub in cls.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and sub.name == method_name:
+                    findings.extend(
+                        self._check_writer(ctx, cls_name, sub))
+        return findings
+
+    def _check_writer(self, ctx: FileContext, cls_name: str,
+                      fn: ast.AST) -> List[Finding]:
+        findings: List[Finding] = []
+        has_atomic = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if name and name.startswith(wiring.ATOMIC_WRITE_PREFIX):
+                has_atomic = True
+            if name == "open" and self._opens_for_write(node):
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"{cls_name}.{fn.name} opens its durable file for "
+                    f"writing directly — a crash mid-write tears the "
+                    f"registry; route through an "
+                    f"{wiring.ATOMIC_WRITE_PREFIX}* helper "
+                    f"(tmp-file + fsync + rename)"))
+        if not has_atomic:
+            findings.append(ctx.finding(
+                self.rule, fn,
+                f"{cls_name}.{fn.name} persists cutover-critical state but "
+                f"never calls an {wiring.ATOMIC_WRITE_PREFIX}* helper — "
+                f"durable installs must be atomic so recovery never parses "
+                f"a torn file"))
+        return findings
+
+    @staticmethod
+    def _opens_for_write(call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and any(m in mode for m in _WRITE_MODES)

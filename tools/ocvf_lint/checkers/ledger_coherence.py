@@ -1,0 +1,334 @@
+"""ledger-registry-coherence: one source-of-truth table for terminal
+statuses, and every consumer provably derived from it.
+
+``utils/metric_names.py`` declares the terminal shape of the admission
+ledger as data: ``LEDGER_COMPLETION_COUNTERS`` (the three completion
+buckets), ``LEDGER_DROP_COUNTERS`` (the ten drop buckets) and
+``PROM_FOLDED_PREFIXES`` (the labelled counter families promtext folds).
+Four consumers mirror that shape and historically drifted one constant at
+a time — each drift is invisible until an operator stares at a dashboard
+where ``frames_in_system`` never drains:
+
+- ``tracing.account_spans`` must handle every completion outcome (the
+  ``OUTCOME_*`` mirror constants must exist, carry the registry's values,
+  and be referenced by the reducer);
+- ``RecognizerService.ledger`` / ``frames_in_system`` must cover all three
+  completion counters, and the class's ``LEDGER_DROP_COUNTERS`` must BE
+  the registry table (``mn.LEDGER_DROP_COUNTERS``) or literally equal it;
+- ``promtext._LABEL_FAMILIES`` must fold exactly the registry's prefix
+  families — one missing and its counters vanish from /metrics, one extra
+  and promtext emits a family the registry never populates;
+- ``scripts/chaos_soak`` span accounting must assert on every completion
+  outcome, else the soak silently stops checking a bucket.
+
+Project-scope: sites absent from a subset lint are skipped (you can lint a
+single file); the registry itself falls back to a disk read, folded into
+the cache fingerprint."""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.ocvf_lint import wiring
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+
+_TABLES = ("LEDGER_COMPLETION_COUNTERS", "LEDGER_DROP_COUNTERS",
+           "PROM_FOLDED_PREFIXES")
+
+
+def _canon(value: str) -> str:
+    return value[7:] if value.startswith("frames_") else value
+
+
+def _str_assigns(tree: ast.Module) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _tuple_tables(tree: ast.Module) -> Dict[str, List[str]]:
+    """Module-level ``NAME = (A, B, ...)`` tables as element NAMES."""
+    out: Dict[str, List[str]] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, (ast.Tuple, ast.List))):
+            out[stmt.targets[0].id] = [e.id for e in stmt.value.elts
+                                       if isinstance(e, ast.Name)]
+    return out
+
+
+def _attr_names(node: ast.AST) -> Set[str]:
+    return {n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)}
+
+
+def _find_function(tree: ast.Module, name: str) -> Optional[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node
+    return None
+
+
+def _find_class(tree: ast.Module, name: str) -> Optional[ast.ClassDef]:
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+@register
+class LedgerCoherenceChecker(Checker):
+    rule = "ledger-registry-coherence"
+    description = ("the terminal-status tables in metric_names must agree "
+                   "with tracing.account_spans, the recognizer ledger, "
+                   "promtext folded families and chaos_soak span checks")
+    scope = "project"
+
+    def __init__(self) -> None:
+        self._registry: Optional[Tuple[FileContext, ast.Module]] = None
+        self._sites: Dict[str, FileContext] = {}
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        norm = ctx.path.replace("\\", "/")
+        if norm.endswith("utils/metric_names.py"):
+            self._registry = (ctx, ctx.tree)
+        for key, suffix in (
+                ("tracing", wiring.COHERENCE_TRACING_SUFFIX),
+                ("recognizer", wiring.COHERENCE_RECOGNIZER_SUFFIX),
+                ("promtext", wiring.COHERENCE_PROMTEXT_SUFFIX),
+                ("chaos", wiring.COHERENCE_CHAOS_SUFFIX)):
+            if norm.endswith(suffix):
+                self._sites[key] = ctx
+        return []
+
+    # ---- registry fallback (metrics-registry pattern) ----
+
+    @staticmethod
+    def _fallback_registry_path() -> str:
+        here = os.path.dirname(os.path.abspath(__file__))
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+        return os.path.join(repo_root, "opencv_facerecognizer_tpu", "utils",
+                            "metric_names.py")
+
+    def extra_cache_fingerprint(self, files) -> str:
+        if any(f.replace("\\", "/").endswith("utils/metric_names.py")
+               for f in files):
+            return ""
+        try:
+            with open(self._fallback_registry_path(), "rb") as fh:
+                return ("ledger-coherence:"
+                        + hashlib.sha256(fh.read()).hexdigest())
+        except OSError:
+            return "ledger-coherence:absent"
+
+    def finalize(self) -> List[Finding]:
+        if not self._sites:
+            return []
+        if self._registry is None:
+            candidate = self._fallback_registry_path()
+            if os.path.exists(candidate):
+                with open(candidate, "r", encoding="utf-8") as fh:
+                    self._registry = (None, ast.parse(fh.read()))
+        first_site = next(iter(self._sites.values()))
+        if self._registry is None:
+            return [Finding(self.rule, first_site.path, 1, 0,
+                            "no utils/metric_names.py registry found — the "
+                            "ledger source-of-truth tables are unreachable")]
+        reg_ctx, reg_tree = self._registry
+        consts = _str_assigns(reg_tree)
+        tables = _tuple_tables(reg_tree)
+        findings: List[Finding] = []
+        anchor = reg_ctx if reg_ctx is not None else first_site
+        for table in _TABLES:
+            if table not in tables:
+                findings.append(Finding(
+                    self.rule, anchor.path, 1, 0,
+                    f"metric_names does not declare the source-of-truth "
+                    f"table {table} — consumers have nothing to derive "
+                    f"from"))
+        if findings:
+            return findings
+        completion_names = tables["LEDGER_COMPLETION_COUNTERS"]
+        drop_names = tables["LEDGER_DROP_COUNTERS"]
+        prefix_names = tables["PROM_FOLDED_PREFIXES"]
+        completion_outcomes = {_canon(consts[n]) for n in completion_names
+                               if n in consts}
+        if "tracing" in self._sites:
+            findings.extend(self._check_tracing(
+                self._sites["tracing"], completion_outcomes))
+        if "recognizer" in self._sites:
+            findings.extend(self._check_recognizer(
+                self._sites["recognizer"], completion_names, drop_names))
+        if "promtext" in self._sites:
+            findings.extend(self._check_promtext(
+                self._sites["promtext"], prefix_names))
+        if "chaos" in self._sites:
+            findings.extend(self._check_chaos(
+                self._sites["chaos"], completion_outcomes))
+        return findings
+
+    # ---- per-site checks ----
+
+    def _check_tracing(self, ctx: FileContext,
+                       completion_outcomes: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        outcome_consts = {name: value
+                          for name, value in _str_assigns(ctx.tree).items()
+                          if name.startswith("OUTCOME_")}
+        mirrored = set(outcome_consts.values())
+        for outcome in sorted(completion_outcomes - mirrored):
+            findings.append(Finding(
+                self.rule, ctx.path, 1, 0,
+                f"tracing declares no OUTCOME_* mirror constant for the "
+                f"registry completion outcome {outcome!r} — span "
+                f"accounting cannot classify those settles"))
+        fn = _find_function(ctx.tree, "account_spans")
+        if fn is None:
+            findings.append(Finding(
+                self.rule, ctx.path, 1, 0,
+                "tracing has no account_spans reducer — the span-side "
+                "ledger mirror is gone"))
+            return findings
+        used = {n.id for n in ast.walk(fn) if isinstance(n, ast.Name)}
+        used |= _attr_names(fn)
+        for name, value in sorted(outcome_consts.items()):
+            if value in completion_outcomes and name not in used:
+                findings.append(ctx.finding(
+                    self.rule, fn,
+                    f"account_spans never references {name} — spans "
+                    f"settled as {value!r} fall into the generic drop "
+                    f"bucket and the ledger mirror drifts"))
+        return findings
+
+    def _check_recognizer(self, ctx: FileContext, completion_names: List[str],
+                          drop_names: List[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        cls = _find_class(ctx.tree, "RecognizerService")
+        if cls is None:
+            return findings
+        attr_stmt = None
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id == "LEDGER_DROP_COUNTERS":
+                attr_stmt = stmt
+        if attr_stmt is None:
+            findings.append(ctx.finding(
+                self.rule, cls,
+                "RecognizerService declares no LEDGER_DROP_COUNTERS class "
+                "attribute — the ledger cannot enumerate drop buckets"))
+        elif isinstance(attr_stmt.value, ast.Attribute):
+            if attr_stmt.value.attr != "LEDGER_DROP_COUNTERS":
+                findings.append(ctx.finding(
+                    self.rule, attr_stmt,
+                    f"RecognizerService.LEDGER_DROP_COUNTERS aliases "
+                    f"{attr_stmt.value.attr!r} instead of the registry's "
+                    f"LEDGER_DROP_COUNTERS table"))
+        elif isinstance(attr_stmt.value, (ast.Tuple, ast.List)):
+            local = [e.attr for e in attr_stmt.value.elts
+                     if isinstance(e, ast.Attribute)]
+            if sorted(local) != sorted(drop_names):
+                missing = sorted(set(drop_names) - set(local))
+                extra = sorted(set(local) - set(drop_names))
+                detail = "; ".join(filter(None, (
+                    f"missing {', '.join(missing)}" if missing else "",
+                    f"extra {', '.join(extra)}" if extra else "")))
+                findings.append(ctx.finding(
+                    self.rule, attr_stmt,
+                    f"RecognizerService.LEDGER_DROP_COUNTERS drifted from "
+                    f"the registry table ({detail}) — alias "
+                    f"mn.LEDGER_DROP_COUNTERS instead of hand-maintaining "
+                    f"the tuple"))
+        for method, need_drops in (("ledger", True),
+                                   ("frames_in_system", True)):
+            fn = next((s for s in cls.body
+                       if isinstance(s, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                       and s.name == method), None)
+            if fn is None:
+                findings.append(ctx.finding(
+                    self.rule, cls,
+                    f"RecognizerService has no {method}() — the admission "
+                    f"ledger surface is gone"))
+                continue
+            used = _attr_names(fn)
+            for name in completion_names:
+                if name not in used:
+                    findings.append(ctx.finding(
+                        self.rule, fn,
+                        f"RecognizerService.{method} never reads "
+                        f"mn.{name} — that completion bucket is invisible "
+                        f"to the ledger and the invariant check"))
+            if need_drops and "LEDGER_DROP_COUNTERS" not in used:
+                findings.append(ctx.finding(
+                    self.rule, fn,
+                    f"RecognizerService.{method} does not fold the "
+                    f"LEDGER_DROP_COUNTERS table in — drop buckets escape "
+                    f"the ledger"))
+        return findings
+
+    def _check_promtext(self, ctx: FileContext,
+                        prefix_names: List[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        families = None
+        for stmt in ctx.tree.body:
+            target = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                target, value = stmt.target, stmt.value
+            else:
+                continue
+            if isinstance(target, ast.Name) and target.id == "_LABEL_FAMILIES":
+                families = (stmt, value)
+        if families is None:
+            findings.append(Finding(
+                self.rule, ctx.path, 1, 0,
+                "promtext declares no _LABEL_FAMILIES — labelled counter "
+                "families are not folded into /metrics"))
+            return findings
+        stmt, value = families
+        local = sorted(a for a in _attr_names(value) if a.endswith("_PREFIX"))
+        expected = sorted(prefix_names)
+        if local != expected:
+            missing = sorted(set(expected) - set(local))
+            extra = sorted(set(local) - set(expected))
+            detail = "; ".join(filter(None, (
+                f"missing {', '.join(missing)}" if missing else "",
+                f"extra {', '.join(extra)}" if extra else "")))
+            findings.append(ctx.finding(
+                self.rule, stmt,
+                f"promtext._LABEL_FAMILIES drifted from the registry's "
+                f"PROM_FOLDED_PREFIXES ({detail}) — folded families must "
+                f"match the registry exactly"))
+        return findings
+
+    def _check_chaos(self, ctx: FileContext,
+                     completion_outcomes: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        fn = _find_function(ctx.tree, "_check_span_accounting")
+        if fn is None:
+            findings.append(Finding(
+                self.rule, ctx.path, 1, 0,
+                "chaos_soak has no _check_span_accounting — the soak no "
+                "longer cross-checks the span ledger mirror"))
+            return findings
+        literals = {n.value for n in ast.walk(fn)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)}
+        for outcome in sorted(completion_outcomes - literals):
+            findings.append(ctx.finding(
+                self.rule, fn,
+                f"chaos_soak._check_span_accounting never asserts on the "
+                f"completion outcome {outcome!r} — that bucket is "
+                f"unchecked under fault injection"))
+        return findings

@@ -1,0 +1,422 @@
+"""settle-once: inside the frame-settlement scopes (``RecognizerService``
+and ``FrameBatcher``), every exit path that increments a TERMINAL admission-
+ledger counter must reach exactly one settlement sink of the same status —
+and no path may settle the same frame run twice.
+
+The ledger invariant (``admitted == completed + completed_empty +
+completed_cached + Σ drops``) has a span-level mirror: each admitted frame
+emits exactly one terminal ``settle`` span whose outcome names the ledger
+bucket it landed in (``tracing.account_spans`` reduces spans back to ledger
+shape and chaos_soak asserts equality).  A terminal ``metrics.incr`` without
+its settle span desynchronizes the two ledgers silently — the soak only
+catches it hours later, under load, with the culprit long off-screen.  This
+rule catches it at lint time, per exit path:
+
+- events are paired on each path the exit-path engine enumerates
+  (``tools.ocvf_lint.exitpaths``): balance is checked on paths that reach
+  the function's normal exit (``return``/fall-through); raising paths are
+  exempt from balance (a crash between two adjacent bookkeeping statements
+  is the crash handler's job to settle) but double-settlement is flagged on
+  EVERY path;
+- statuses are matched through the source-of-truth tables in
+  ``utils/metric_names.py`` (``LEDGER_COMPLETION_COUNTERS`` +
+  ``LEDGER_DROP_COUNTERS``): a counter ``frames_<x>`` pairs with a settle
+  outcome of either ``frames_<x>`` or ``<x>`` (the tracing-side
+  ``OUTCOME_*`` mirror constants); the ``batcher_dropped_`` prefix family
+  is paired symbolically (``PREFIX + reason`` on both sides);
+- terminal-status hygiene: the settle outcome argument must be a
+  ``metric_names`` constant, a ``tracing.OUTCOME_*`` mirror constant, or a
+  registered ``*_PREFIX + suffix`` — a string literal or bare variable is
+  drift waiting to happen and is flagged regardless of balance.
+
+Functions whose path enumeration overflows the engine budget are skipped
+(soundness of findings over completeness of coverage).  Designed
+exceptions carry ``# ocvf-lint: boundary=settle-once -- why`` on the
+path's exit statement."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tools.ocvf_lint import wiring
+from tools.ocvf_lint.core import Checker, FileContext, Finding, register
+from tools.ocvf_lint.exitpaths import (
+    NORMAL_TERMINALS,
+    enumerate_exit_paths,
+    walk_events,
+)
+
+REGISTRY_SUFFIX = "utils/metric_names.py"
+TRACING_SUFFIX = "utils/tracing.py"
+
+#: source-of-truth tuple tables in utils/metric_names.py whose members are
+#: the terminal ledger counters this rule pairs.
+_TERMINAL_TABLES = ("LEDGER_COMPLETION_COUNTERS", "LEDGER_DROP_COUNTERS")
+
+
+def _canon(value: str) -> str:
+    """Counter value and settle outcome share a canonical key: the tracing
+    mirror constants drop the ``frames_`` namespace (``frames_completed``
+    settles as ``completed``)."""
+    return value[7:] if value.startswith("frames_") else value
+
+
+def _str_assigns(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` constants."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, str)):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _tuple_tables(tree: ast.Module, consts: Dict[str, str]
+                  ) -> Dict[str, List[str]]:
+    """Module-level ``NAME = (A, B, ...)`` tables resolved to the string
+    values of their Name elements."""
+    out: Dict[str, List[str]] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, (ast.Tuple, ast.List))):
+            values = [consts[e.id] for e in stmt.value.elts
+                      if isinstance(e, ast.Name) and e.id in consts]
+            out[stmt.targets[0].id] = values
+    return out
+
+
+class _Imports:
+    """Local names referring to the metric_names / tracing modules (or to
+    constants imported from them)."""
+
+    def __init__(self, tree: ast.Module):
+        self.mn_modules: Set[str] = set()
+        self.mn_constants: Dict[str, str] = {}
+        self.tr_modules: Set[str] = set()
+        self.tr_constants: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.endswith("metric_names"):
+                    for alias in node.names:
+                        self.mn_constants[alias.asname or alias.name] = alias.name
+                elif node.module.endswith("tracing"):
+                    for alias in node.names:
+                        self.tr_constants[alias.asname or alias.name] = alias.name
+                elif node.module.endswith("utils"):
+                    for alias in node.names:
+                        if alias.name == "metric_names":
+                            self.mn_modules.add(alias.asname or alias.name)
+                        elif alias.name == "tracing":
+                            self.tr_modules.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.endswith("metric_names"):
+                        self.mn_modules.add(alias.asname
+                                            or alias.name.split(".")[0])
+                    elif alias.name.endswith("tracing"):
+                        self.tr_modules.add(alias.asname
+                                            or alias.name.split(".")[0])
+
+
+@register
+class SettleOnceChecker(Checker):
+    rule = "settle-once"
+    description = ("every exit path incrementing a terminal ledger counter "
+                   "in RecognizerService/FrameBatcher must reach exactly one "
+                   "matching settle sink, and never two")
+    scope = "project"  # verdicts depend on the metric_names/tracing tables
+    boundary_capable = True
+
+    def __init__(self) -> None:
+        self._registry_tree: Optional[ast.Module] = None
+        self._tracing_tree: Optional[ast.Module] = None
+        #: (ctx, imports, class name, method FunctionDef)
+        self._pending: List[Tuple[FileContext, _Imports, str, ast.AST]] = []
+
+    # ---- collection ----
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        norm = ctx.path.replace("\\", "/")
+        if norm.endswith(REGISTRY_SUFFIX):
+            self._registry_tree = ctx.tree
+        if norm.endswith(TRACING_SUFFIX):
+            self._tracing_tree = ctx.tree
+        imports: Optional[_Imports] = None
+        for stmt in ctx.tree.body:
+            if not (isinstance(stmt, ast.ClassDef)
+                    and stmt.name in wiring.SETTLE_SCOPE_CLASSES):
+                continue
+            if imports is None:
+                imports = _Imports(ctx.tree)
+            for sub in stmt.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._pending.append((ctx, imports, stmt.name, sub))
+        return []
+
+    # ---- out-of-tree inputs ----
+
+    @staticmethod
+    def _repo_file(*parts: str) -> str:
+        here = os.path.dirname(os.path.abspath(__file__))
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+        return os.path.join(repo_root, "opencv_facerecognizer_tpu", *parts)
+
+    def extra_cache_fingerprint(self, files) -> str:
+        """The status tables are read from disk when the registry/tracing
+        modules are not among the linted files — fold those fallback reads
+        into the run-cache key (metrics-registry's invalidation pattern)."""
+        import hashlib
+
+        out = []
+        for suffix, parts in ((REGISTRY_SUFFIX, ("utils", "metric_names.py")),
+                              (TRACING_SUFFIX, ("utils", "tracing.py"))):
+            if any(f.replace("\\", "/").endswith(suffix) for f in files):
+                continue  # in-tree: content hash already in the key
+            try:
+                with open(self._repo_file(*parts), "rb") as fh:
+                    out.append("settle-once:"
+                               + hashlib.sha256(fh.read()).hexdigest())
+            except OSError:
+                out.append("settle-once:absent")
+        return "".join(out)
+
+    def _load_fallbacks(self) -> None:
+        for attr, parts in (("_registry_tree", ("utils", "metric_names.py")),
+                            ("_tracing_tree", ("utils", "tracing.py"))):
+            if getattr(self, attr) is not None:
+                continue
+            candidate = self._repo_file(*parts)
+            if os.path.exists(candidate):
+                with open(candidate, "r", encoding="utf-8") as fh:
+                    setattr(self, attr, ast.parse(fh.read()))
+
+    # ---- status resolution ----
+
+    def _build_tables(self) -> bool:
+        self._load_fallbacks()
+        if self._registry_tree is None:
+            return False
+        self._mn_consts = _str_assigns(self._registry_tree)
+        tables = _tuple_tables(self._registry_tree, self._mn_consts)
+        terminal: Set[str] = set()
+        for name in _TERMINAL_TABLES:
+            terminal.update(tables.get(name, ()))
+        self._terminal_values = terminal
+        self._terminal_prefixes = {
+            self._mn_consts[name]
+            for name in wiring.LEDGER_PREFIX_CONSTANTS
+            if name in self._mn_consts}
+        self._tr_consts = (_str_assigns(self._tracing_tree)
+                           if self._tracing_tree is not None else {})
+        return True
+
+    def _const_value(self, expr: ast.expr, imports: _Imports
+                     ) -> Optional[str]:
+        """The string value of a metric_names / tracing constant reference,
+        or None when ``expr`` is not one."""
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            if expr.value.id in imports.mn_modules:
+                return self._mn_consts.get(expr.attr)
+            if expr.value.id in imports.tr_modules:
+                return self._tr_consts.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            original = imports.mn_constants.get(expr.id)
+            if original is not None:
+                return self._mn_consts.get(original)
+            original = imports.tr_constants.get(expr.id)
+            if original is not None:
+                return self._tr_consts.get(original)
+        return None
+
+    def _incr_key(self, expr: ast.expr, imports: _Imports
+                  ) -> Optional[Tuple[Any, ...]]:
+        """Pairing key for a terminal-counter ``incr`` argument, or None
+        when the counter is not terminal (non-terminal counters are outside
+        this rule — metrics-registry already polices their names)."""
+        value = self._const_value(expr, imports)
+        if value is None and isinstance(expr, ast.Constant) \
+                and isinstance(expr.value, str):
+            value = expr.value
+        if value is not None:
+            return (("name", _canon(value))
+                    if value in self._terminal_values else None)
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            prefix = self._const_value(expr.left, imports)
+            if prefix is None and isinstance(expr.left, ast.Constant) \
+                    and isinstance(expr.left.value, str):
+                prefix = expr.left.value
+            if prefix in self._terminal_prefixes:
+                return ("prefix", prefix, ast.dump(expr.right))
+        return None
+
+    def _settle_key(self, expr: ast.expr, imports: _Imports
+                    ) -> Tuple[Tuple[Any, ...], Optional[str]]:
+        """(pairing key, hygiene problem) for a settle outcome argument."""
+        value = self._const_value(expr, imports)
+        if value is not None:
+            return ("name", _canon(value)), None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            prefix = self._const_value(expr.left, imports)
+            if prefix in self._terminal_prefixes:
+                return ("prefix", prefix, ast.dump(expr.right)), None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return (("name", _canon(expr.value)),
+                    f"terminal status is the string literal {expr.value!r} — "
+                    f"thread a metric_names ledger constant (or its "
+                    f"tracing OUTCOME_* mirror) through instead")
+        return (("dyn", ast.dump(expr)),
+                "terminal status is not statically resolvable to a "
+                "metric_names / tracing constant — settle outcomes must "
+                "come from the ledger's source-of-truth tables")
+
+    # ---- per-method analysis ----
+
+    def _events_for(self, node: ast.AST, imports: _Imports,
+                    hygiene: List[Tuple[ast.AST, str]]) -> List[Tuple]:
+        evs: List[Tuple] = []
+        for sub in walk_events(node):
+            if not (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)):
+                continue
+            attr = sub.func.attr
+            if attr == "incr" and sub.args:
+                key = self._incr_key(sub.args[0], imports)
+                if key is not None:
+                    evs.append(("incr", key, sub))
+            elif attr in wiring.SETTLE_SINKS:
+                basis_idx, status_idx = wiring.SETTLE_SINKS[attr]
+                if len(sub.args) <= max(basis_idx, status_idx):
+                    hygiene.append((sub, f"settlement sink {attr} called "
+                                    f"without its trace-basis/status "
+                                    f"arguments"))
+                    continue
+                key, problem = self._settle_key(sub.args[status_idx], imports)
+                if problem is not None:
+                    hygiene.append((sub, problem))
+                evs.append(("settle", key,
+                            ast.dump(sub.args[basis_idx]), sub))
+        return evs
+
+    def finalize(self) -> List[Finding]:
+        if not self._pending:
+            return []
+        if not self._build_tables():
+            ctx = self._pending[0][0]
+            return [Finding(self.rule, ctx.path, 1, 0,
+                            "no utils/metric_names.py registry found in the "
+                            "scanned tree or the repository — terminal "
+                            "statuses cannot be paired")]
+        findings: List[Finding] = []
+        for ctx, imports, cls, fn in self._pending:
+            findings.extend(self._check_method(ctx, imports, cls, fn))
+        return findings
+
+    def _check_method(self, ctx: FileContext, imports: _Imports, cls: str,
+                      fn: ast.AST) -> List[Finding]:
+        hygiene: List[Tuple[ast.AST, str]] = []
+        memo: Dict[int, List[Tuple]] = {}
+
+        def extract(node: ast.AST) -> List[Tuple]:
+            key = id(node)
+            if key not in memo:
+                memo[key] = self._events_for(node, imports, hygiene)
+            return memo[key]
+
+        paths, truncated = enumerate_exit_paths(
+            fn.body, extract, optional_attrs=wiring.OPTIONAL_SURFACE_ATTRS)
+        # Force one full extraction even when enumeration overflowed, so
+        # hygiene findings (site properties, not path properties) survive.
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.stmt):
+                extract(stmt)
+        findings: List[Finding] = []
+        seen_hyg = set()
+        for node, problem in hygiene:
+            if id(node) not in seen_hyg:
+                seen_hyg.add(id(node))
+                findings.append(ctx.finding(self.rule, node, problem))
+        if truncated:
+            return findings  # partial path set: stay silent on balance
+        reported: Set[Tuple] = set()
+        for path in paths:
+            self._check_path(ctx, cls, fn, path, reported, findings)
+        return findings
+
+    def _check_path(self, ctx: FileContext, cls: str, fn: ast.AST, path,
+                    reported: Set[Tuple], findings: List[Finding]) -> None:
+        end_line = getattr(path.end, "lineno", None)
+        also = ((ctx.path, end_line),) if end_line is not None else ()
+
+        # double-settlement: the same trace basis settled twice with the
+        # same status on one path — checked on EVERY path (a crash path
+        # that settles twice is just as wrong as a normal one).
+        seen_sig: Dict[Tuple, ast.AST] = {}
+        for ev in path.events:
+            if ev[0] != "settle":
+                continue
+            sig = (ev[1], ev[2])
+            if sig in seen_sig:
+                key = ("double", id(ev[3]))
+                if key not in reported:
+                    reported.add(key)
+                    findings.append(ctx.finding(
+                        self.rule, ev[3],
+                        f"{cls}.{fn.name}: this path settles the same frame "
+                        f"run twice with status {ev[1]!r} (first settlement "
+                        f"at line {seen_sig[sig].lineno}) — every admitted "
+                        f"frame settles exactly once", also=also))
+            else:
+                seen_sig[sig] = ev[3]
+
+        if path.terminal not in NORMAL_TERMINALS:
+            return  # raising/loop path: balance is the crash handler's job
+        incrs: Dict[Tuple, List[ast.AST]] = {}
+        settles: Dict[Tuple, List[ast.AST]] = {}
+        for ev in path.events:
+            if ev[0] == "incr":
+                incrs.setdefault(ev[1], []).append(ev[2])
+            else:
+                settles.setdefault(ev[1], []).append(ev[3])
+        for key, nodes in incrs.items():
+            missing = len(nodes) - len(settles.get(key, ()))
+            for node in nodes[:max(0, missing)]:
+                fkey = ("unsettled", id(node))
+                if fkey in reported:
+                    continue
+                reported.add(fkey)
+                where = (f"line {end_line}" if end_line is not None
+                         else "fall-through")
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"{cls}.{fn.name}: terminal ledger incr "
+                    f"{self._key_str(key)} reaches the exit at {where} "
+                    f"without a matching settle sink "
+                    f"({'/'.join(sorted(wiring.SETTLE_SINKS))}) — the span "
+                    f"ledger desynchronizes from the admission ledger",
+                    also=also))
+        for key, nodes in settles.items():
+            extra = len(nodes) - len(incrs.get(key, ()))
+            for node in nodes[:max(0, extra)]:
+                fkey = ("orphan", id(node))
+                if fkey in reported:
+                    continue
+                reported.add(fkey)
+                findings.append(ctx.finding(
+                    self.rule, node,
+                    f"{cls}.{fn.name}: settle sink with status "
+                    f"{self._key_str(key)} has no matching terminal ledger "
+                    f"incr on this exit path — the span ledger counts a "
+                    f"frame the admission ledger never will", also=also))
+
+    @staticmethod
+    def _key_str(key: Tuple) -> str:
+        if key[0] == "name":
+            return repr(key[1])
+        if key[0] == "prefix":
+            return f"{key[1]!r}+<reason>"
+        return "<dynamic status>"

@@ -1,0 +1,503 @@
+"""Acyclic exit-path enumeration over one function body (ocvf-lint v3).
+
+The v1/v2 rules are *site* rules: a bad call is bad wherever it stands.
+The protocol rules (settle-once, resource-pairing, fence-ordering) are
+*path* properties — "every path from an acquire reaches a release",
+"no path installs before the fence" — so they need to know which event
+sequences a function can actually execute, not just which events exist.
+
+This module is deliberately NOT a CFG solver.  It normalizes a function
+body into a bounded set of acyclic exit paths under the same stdlib-ast
+budget as the v2 dataflow layer:
+
+- ``if``/``match`` fork; ``for``/``while`` bodies run zero-or-once (no
+  back edges — a second iteration adds no *new* event orderings for the
+  pairing rules, whose events are idempotent per path);
+- ``while True`` runs once and exits only through ``break``/``return``;
+  a body that would iterate again ends the path with the ``loop``
+  terminal, which protocol checks skip (the path never reaches the
+  function's exit);
+- ``try`` bodies additionally fork *raising* edges: after the block
+  entry and after every event-bearing top-level statement, control may
+  jump into each handler (and, when no handler is catch-all, propagate
+  out).  ``finally`` suffixes every outcome.  Raising edges are taken
+  only at event boundaries — exceptions between two event-free
+  statements cannot change a protocol verdict;
+- simple constant propagation over local booleans/None prunes branches
+  the runtime's flag idioms make infeasible (``accounted = True`` before
+  the crash handler's ``if not accounted:``), and *optional-surface
+  guards* (``if self.metrics:`` — observability objects that may be
+  None by wiring) are taken as present, so a guarded ``incr`` still
+  pairs with its unguarded settle span;
+- enumeration is capped (``max_paths``); on overflow the caller gets
+  ``truncated=True`` and should stay silent for that function
+  (soundness of findings over completeness of coverage).
+
+Checkers supply an ``extract(node)`` callback mapping statement-level
+nodes to hashable *events* (tuples); the engine only orders them.  The
+callback sees simple statements whole, ``if``/``while`` tests, ``for``
+iterables, and ``with`` items — never nested function/lambda bodies
+(use :func:`walk_events` to honor that rule inside the callback).
+"""
+
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+NEXT = "next"
+RETURN = "return"
+RAISE = "raise"
+BREAK = "break"
+CONTINUE = "continue"
+LOOP = "loop"
+FALL = "fall"
+
+#: terminals on which a path truly reaches the function's normal exit —
+#: balance/pairing checks that require "the function finished" test these.
+NORMAL_TERMINALS = frozenset({RETURN, FALL})
+
+#: hard ceiling on live states while enumerating one function.
+_MAX_STATES = 32768
+
+
+class ExitPath:
+    """One acyclic way through a function: the ordered events it executes,
+    how it leaves (``return``/``raise``/``fall``/``loop``), and the AST
+    node it leaves at (None for implicit exits)."""
+
+    __slots__ = ("events", "terminal", "end")
+
+    def __init__(self, events: Tuple[Any, ...], terminal: str,
+                 end: Optional[ast.AST]):
+        self.events = events
+        self.terminal = terminal
+        self.end = end
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"ExitPath({self.terminal}, {len(self.events)} events)"
+
+
+def walk_events(node: ast.AST) -> Iterator[ast.AST]:
+    """Source-ordered walk of ``node`` that does NOT descend into nested
+    function/lambda bodies — code defined inside a statement does not run
+    when the statement does.  Every extractor goes through this."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            continue  # the def/lambda node itself was yielded; not its body
+        stack.extend(reversed(list(ast.iter_child_nodes(cur))))
+
+
+class _State:
+    __slots__ = ("events", "env")
+
+    def __init__(self, events: Tuple[Any, ...], env: Dict[str, Any]):
+        self.events = events
+        self.env = env
+
+    def add(self, events: Sequence[Any]) -> "_State":
+        if not events:
+            return self
+        return _State(self.events + tuple(events), self.env)
+
+    def key(self) -> Tuple[Any, ...]:
+        return (self.events,
+                tuple(sorted(self.env.items(), key=lambda kv: kv[0])))
+
+
+class _Truncated(Exception):
+    pass
+
+
+def _terminal_name(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_none(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.Constant) and expr.value is None
+
+
+class _Enumerator:
+    def __init__(self, extract: Callable[[ast.AST], Sequence[Any]],
+                 optional_attrs: frozenset, max_paths: int):
+        self.extract = extract
+        self.optional = optional_attrs
+        self.max_paths = max_paths
+        self.states_made = 0
+
+    # ---- branch-condition evaluation ----
+
+    def _guard_value(self, test: ast.expr) -> Optional[bool]:
+        """True/False when ``test`` is purely an optionality check on an
+        optional-surface attribute (``if self.metrics:``, ``if tracer is
+        not None:``) — those objects are modeled as wired, so the guarded
+        code runs."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = self._guard_value(test.operand)
+            return None if inner is None else not inner
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and len(test.comparators) == 1 \
+                and _is_none(test.comparators[0]):
+            name = _terminal_name(test.left)
+            if name in self.optional:
+                if isinstance(test.ops[0], ast.IsNot):
+                    return True
+                if isinstance(test.ops[0], ast.Is):
+                    return False
+            return None
+        name = _terminal_name(test)
+        if name in self.optional and not isinstance(test, ast.Call):
+            return True
+        return None
+
+    def _test_value(self, test: ast.expr, env: Dict[str, Any]
+                    ) -> Optional[bool]:
+        if isinstance(test, ast.Constant):
+            return bool(test.value)
+        guard = self._guard_value(test)
+        if guard is not None:
+            return guard
+        if isinstance(test, ast.Name):
+            val = env.get(test.id, "?")
+            if val is True or val == "T":
+                return True
+            if val is False or val is None or val == "F":
+                return False
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = self._test_value(test.operand, env)
+            return None if inner is None else not inner
+        if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+                and len(test.comparators) == 1 \
+                and _is_none(test.comparators[0]) \
+                and isinstance(test.left, ast.Name):
+            val = env.get(test.left.id, "?")
+            if val is None:
+                return isinstance(test.ops[0], ast.Is)
+            if val is True or val is False or val == "T":
+                # a known-bool / known-truthy value is never None
+                return isinstance(test.ops[0], ast.IsNot)
+            return None
+        if isinstance(test, ast.BoolOp):
+            vals = [self._test_value(v, env) for v in test.values]
+            if isinstance(test.op, ast.And):
+                if any(v is False for v in vals):
+                    return False
+                if all(v is True for v in vals):
+                    return True
+            else:
+                if any(v is True for v in vals):
+                    return True
+                if all(v is False for v in vals):
+                    return False
+        return None
+
+    # ---- statement walking ----
+
+    def _bump(self, n: int = 1) -> None:
+        self.states_made += n
+        if self.states_made > _MAX_STATES:
+            raise _Truncated()
+
+    def block(self, stmts: Sequence[ast.stmt], states: List[_State]
+              ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        out, _mids = self.block_collect(stmts, states)
+        return out
+
+    def block_collect(self, stmts: Sequence[ast.stmt], states: List[_State]
+                      ) -> Tuple[List[Tuple[_State, str, Optional[ast.AST]]],
+                                 List[_State]]:
+        done: List[Tuple[_State, str, Optional[ast.AST]]] = []
+        seen_done = set()
+        live = list(states)
+        mids: List[_State] = []
+        seen_mid = set()
+        for st in live:
+            if st.key() not in seen_mid:
+                seen_mid.add(st.key())
+                mids.append(st)
+        for stmt in stmts:
+            if not live:
+                break
+            next_live: List[_State] = []
+            seen_live = set()
+            for st in live:
+                for st2, term, node in self.stmt(stmt, st):
+                    if term == NEXT:
+                        # frontier dedup: states agreeing on (events, env)
+                        # at the same program point have identical futures
+                        # — keeping both only duplicates every downstream
+                        # path (and blows the state budget exponentially).
+                        k = st2.key()
+                        if k not in seen_live:
+                            seen_live.add(k)
+                            next_live.append(st2)
+                    else:
+                        dk = (st2.key(), term, id(node))
+                        if dk not in seen_done:
+                            seen_done.add(dk)
+                            done.append((st2, term, node))
+            self._bump(len(next_live))
+            live = next_live
+            for st in live:
+                k = st.key()
+                if k not in seen_mid:
+                    seen_mid.add(k)
+                    mids.append(st)
+        done.extend((st, NEXT, None) for st in live)
+        return done, mids
+
+    def stmt(self, node: ast.stmt, state: _State
+             ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        if isinstance(node, ast.Return):
+            return [(state.add(self.extract(node)), RETURN, node)]
+        if isinstance(node, ast.Raise):
+            return [(state.add(self.extract(node)), RAISE, node)]
+        if isinstance(node, ast.Break):
+            return [(state, BREAK, node)]
+        if isinstance(node, ast.Continue):
+            return [(state, CONTINUE, node)]
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom,
+                             ast.Global, ast.Nonlocal, ast.Pass)):
+            return [(state, NEXT, None)]
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.Expr, ast.Assert, ast.Delete)):
+            ns = state.add(self.extract(node))
+            env = self._env_after(node, ns.env)
+            if env is not ns.env:
+                ns = _State(ns.events, env)
+            return [(ns, NEXT, None)]
+        if isinstance(node, ast.If):
+            return self._if(node, state)
+        if isinstance(node, ast.While):
+            return self._while(node, state)
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            return self._for(node, state)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            evs: List[Any] = []
+            for item in node.items:
+                evs.extend(self.extract(item))
+            return self.block(node.body, [state.add(evs)])
+        if isinstance(node, ast.Try) or node.__class__.__name__ == "TryStar":
+            return self._try(node, state)
+        if isinstance(node, ast.Match):
+            out: List[Tuple[_State, str, Optional[ast.AST]]] = []
+            for case in node.cases:
+                out.extend(self.block(case.body, [state]))
+            out.append((state, NEXT, None))  # no case matched
+            return out
+        return [(state, NEXT, None)]
+
+    def _env_after(self, node: ast.stmt, env: Dict[str, Any]
+                   ) -> Dict[str, Any]:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], None
+        names = []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            elif isinstance(tgt, (ast.Tuple, ast.List)):
+                names.extend(e.id for e in tgt.elts
+                             if isinstance(e, ast.Name))
+        if not names:
+            return env
+        env = dict(env)
+        const = (value.value if isinstance(value, ast.Constant)
+                 and value.value in (True, False, None) else "?")
+        for name in names:
+            if const != "?" and len(names) == 1 \
+                    and isinstance(node, ast.Assign) \
+                    and all(isinstance(t, ast.Name) for t in node.targets):
+                env[name] = const
+            elif const != "?" and isinstance(node, ast.AnnAssign):
+                env[name] = const
+            else:
+                env.pop(name, None)
+        # chained `a = b = True` still sets every Name target
+        if isinstance(node, ast.Assign) and const != "?":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    env[tgt.id] = const
+        return env
+
+    def _if(self, node: ast.If, state: _State
+            ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        ns = state.add(self.extract(node.test))
+        val = self._test_value(node.test, ns.env)
+        if val is True:
+            return self.block(node.body, [ns])
+        if val is False:
+            return self.block(node.orelse, [ns])
+        body_state = ns
+        else_state = ns
+        if isinstance(node.test, ast.Name):
+            benv = dict(ns.env)
+            benv[node.test.id] = "T"
+            eenv = dict(ns.env)
+            eenv[node.test.id] = "F"
+            body_state = _State(ns.events, benv)
+            else_state = _State(ns.events, eenv)
+        self._bump()
+        return (self.block(node.body, [body_state])
+                + self.block(node.orelse, [else_state]))
+
+    def _loop_exit(self, outcomes, after_orelse, node
+                   ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        """Map one-iteration body outcomes to after-loop continuations."""
+        out: List[Tuple[_State, str, Optional[ast.AST]]] = []
+        for st, term, n in outcomes:
+            if term == BREAK:
+                out.append((st, NEXT, None))
+            elif term in (NEXT, CONTINUE):
+                out.extend(self.block(after_orelse, [st]))
+        # RETURN / RAISE / LOOP propagate untouched
+        out.extend((st, term, n) for st, term, n in outcomes
+                   if term in (RETURN, RAISE, LOOP))
+        return out
+
+    def _while(self, node: ast.While, state: _State
+               ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        ns = state.add(self.extract(node.test))
+        infinite = isinstance(node.test, ast.Constant) and bool(node.test.value)
+        body_out = self.block(node.body, [ns])
+        out: List[Tuple[_State, str, Optional[ast.AST]]] = []
+        for st, term, n in body_out:
+            if term == BREAK:
+                out.append((st, NEXT, None))
+            elif term in (NEXT, CONTINUE):
+                if infinite:
+                    # would iterate again forever as far as this acyclic
+                    # model can see: the path never reaches code below.
+                    out.append((st, LOOP, None))
+                else:
+                    out.extend(self.block(node.orelse, [st]))
+            else:
+                out.append((st, term, n))
+        if not infinite:
+            out.extend(self.block(node.orelse, [ns]))  # zero iterations
+        return out
+
+    def _for(self, node, state: _State
+             ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        ns = state.add(self.extract(node.iter))
+        env = ns.env
+        if isinstance(node.target, ast.Name) and node.target.id in env:
+            env = dict(env)
+            env.pop(node.target.id)
+            ns = _State(ns.events, env)
+        out = self._loop_exit(self.block(node.body, [ns]), node.orelse, node)
+        nonempty = (isinstance(node.iter, ast.Name)
+                    and ns.env.get(node.iter.id) in (True, "T"))
+        if not nonempty:
+            out.extend(self.block(node.orelse, [ns]))  # zero iterations
+        return out
+
+    def _try(self, node, state: _State
+             ) -> List[Tuple[_State, str, Optional[ast.AST]]]:
+        body_out, mids = self.block_collect(node.body, [state])
+
+        continuing: List[Tuple[_State, str, Optional[ast.AST]]] = []
+        raisers: List[Tuple[_State, Optional[ast.AST]]] = []
+        seen_raise = set()
+
+        def add_raiser(st: _State, n: Optional[ast.AST]) -> None:
+            k = st.key()
+            if k not in seen_raise:
+                seen_raise.add(k)
+                raisers.append((st, n))
+
+        for st in mids:
+            add_raiser(st, None)
+        for st, term, n in body_out:
+            if term == NEXT:
+                if node.orelse:
+                    continuing.extend(self.block(node.orelse, [st]))
+                else:
+                    continuing.append((st, NEXT, None))
+            elif term == RAISE:
+                add_raiser(st, n)
+            else:
+                continuing.append((st, term, n))
+
+        handlers = list(getattr(node, "handlers", ()) or ())
+        if handlers:
+            catch_all = any(
+                h.type is None
+                or (_terminal_name(h.type) in ("Exception", "BaseException"))
+                for h in handlers)
+            for st, n in raisers:
+                for h in handlers:
+                    henv = dict(st.env)
+                    if h.name:
+                        henv.pop(h.name, None)
+                    for st2, term2, n2 in self.block(
+                            h.body, [_State(st.events, henv)]):
+                        continuing.append((st2, term2, n2 if n2 is not None
+                                           else (n2 or n or h)))
+                if not catch_all:
+                    continuing.append((st, RAISE, n))
+        else:
+            continuing.extend((st, RAISE, n) for st, n in raisers)
+
+        if not getattr(node, "finalbody", None):
+            return continuing
+        out: List[Tuple[_State, str, Optional[ast.AST]]] = []
+        seen_fin = set()
+        for st, term, n in continuing:
+            k = (st.key(), term)
+            if k in seen_fin:
+                continue
+            seen_fin.add(k)
+            for st2, term2, n2 in self.block(node.finalbody, [st]):
+                if term2 == NEXT:
+                    out.append((st2, term, n))
+                else:  # a finally that returns/raises/breaks overrides
+                    out.append((st2, term2, n2))
+        return out
+
+
+def enumerate_exit_paths(
+        body: Sequence[ast.stmt],
+        extract: Callable[[ast.AST], Sequence[Any]],
+        optional_attrs: frozenset = frozenset(),
+        max_paths: int = 512,
+) -> Tuple[List[ExitPath], bool]:
+    """All acyclic exit paths of ``body`` (a function's statement list).
+
+    Returns ``(paths, truncated)``; when ``truncated`` is True the path
+    set is partial (enumeration hit its budget) and callers should not
+    report findings for this function."""
+    enum = _Enumerator(extract, optional_attrs, max_paths)
+    truncated = False
+    try:
+        outcomes = enum.block(body, [_State((), {})])
+    except _Truncated:
+        return [], True
+    paths: List[ExitPath] = []
+    seen = set()
+    for st, term, n in outcomes:
+        terminal = FALL if term in (NEXT, BREAK, CONTINUE) else term
+        key = (st.events, terminal, id(n) if n is not None else 0)
+        if key in seen:
+            continue
+        seen.add(key)
+        paths.append(ExitPath(st.events, terminal, n))
+        if len(paths) > max_paths:
+            return paths, True
+    return paths, truncated

@@ -10,7 +10,7 @@ of truth; checkers import, never redefine.
 
 from __future__ import annotations
 
-from typing import Dict, FrozenSet, Tuple
+from typing import Any, Dict, FrozenSet, Tuple
 
 #: Known wiring of ``self.<attr>`` (or any ``x.<attr>``) to the class whose
 #: methods it dispatches to — the cross-module edges of the serving stack.
@@ -75,6 +75,10 @@ ATTR_HINTS: Dict[str, str] = {
     # coordinator whose parity window the readback worker feeds.
     "registry": "ModelRegistry",
     "registry_swap": "RegistrySwapCoordinator",
+    # Protocol rules (v3): the batcher holds the staging ring as
+    # ``self._ring``; it stores its tracer privately as ``self._tracer``.
+    "_ring": "StagingRing",
+    "_tracer": "Tracer",
 }
 
 #: The serving hot path: the overlapped loop (PR 2) lives in these modules.
@@ -189,6 +193,129 @@ HOST_RESULT_ATTRS: FrozenSet[str] = frozenset({"is_ready"})
 HOST_BUILTIN_FUNCS: FrozenSet[str] = frozenset({
     "len", "range", "enumerate", "hasattr", "isinstance", "getattr", "id",
 })
+
+
+# --------------------------------------------------------------------------
+# v3 protocol rules (exit-path settlement / resource pairing / fence order)
+# --------------------------------------------------------------------------
+
+#: Observability surfaces that may legitimately be None (``metrics=None``
+#: stats-only mode, untraced runs).  The exit-path engine models them as
+#: WIRED: ``if self.metrics:`` guards are taken, so a guarded terminal
+#: ``incr`` still pairs with its unconditional settle span.  Path analysis
+#: must see the fully-instrumented execution — the None configuration
+#: executes a strict subset of it.
+OPTIONAL_SURFACE_ATTRS: FrozenSet[str] = frozenset({
+    "metrics", "tracer", "_tracer", "journal", "drop_log", "_drop_log",
+    "slo", "span_sink", "durability",
+})
+
+#: Classes whose methods own the frame-settlement protocol: every terminal
+#: ledger ``incr`` must ride with exactly one settle span of the same
+#: status on every path (settle-once).
+SETTLE_SCOPE_CLASSES: FrozenSet[str] = frozenset({
+    "RecognizerService", "FrameBatcher",
+})
+
+#: Settlement sinks: method name -> (trace-basis arg index, status arg
+#: index), counted from the call's own args (``self`` excluded).  The
+#: recognizer settles runs of frames (``_trace_settle``); the batcher
+#: settles one frame per drop (``_emit_settle``).
+SETTLE_SINKS: Dict[str, Tuple[int, int]] = {
+    "_trace_settle": (0, 1),
+    "_emit_settle": (0, 1),
+}
+
+#: The one prefix family whose members are terminal ledger statuses
+#: (``batcher_dropped_<reason>`` — both the counter and the settle outcome
+#: are minted from it, so the pairing is checked symbolically).
+LEDGER_PREFIX_CONSTANTS: FrozenSet[str] = frozenset({
+    "BATCHER_DROPPED_PREFIX",
+})
+
+#: Acquire/release pairings the resource-pairing engine enforces.  Each
+#: entry is pure data — a new paired resource is one more dict here:
+#:
+#: - kind "acquire-release": ``acquire_methods`` are (class, method) pairs
+#:   resolved through ATTR_HINTS; the bound result must reach a call whose
+#:   attr is in ``release_attrs`` (passed the buffer bare), be handed off
+#:   bare into another call/container, or be returned, on EVERY path —
+#:   including raising ones (the crash handler's forfeit is the point).
+#: - kind "seq-burn": an assignment burning ``burn_attr`` must be followed
+#:   on every path by a ``<release_receiver>.<release_attr_prefix>*`` call
+#:   (the WAL record or its abort tombstone).
+#: - kind "context": calls to the (class, method) pairs are contextmanagers
+#:   and must be entered with ``with`` — a bare call leaks the span.
+RESOURCE_PAIRINGS: Tuple[Dict[str, Any], ...] = (
+    {
+        "kind": "acquire-release",
+        "name": "staging-buffer",
+        "acquire_methods": (("StagingRing", "acquire"),),
+        "release_attrs": ("recycle", "forfeit", "release"),
+        "module_suffixes": ("runtime/batcher.py", "runtime/ingest.py",
+                           "runtime/recognizer.py"),
+        "what": "staging-ring buffer",
+    },
+    {
+        "kind": "seq-burn",
+        "name": "wal-seq",
+        "burn_attr": "_wal_seq",
+        "release_receiver": "wal",
+        "release_attr_prefix": "append_",
+        "module_suffixes": ("runtime/state_store.py",),
+        "what": "burned WAL sequence number",
+    },
+    {
+        "kind": "context",
+        "name": "tracer-span",
+        "context_methods": (("Tracer", "lifecycle"),),
+        "module_suffixes": (),  # everywhere
+        "what": "lifecycle span contextmanager",
+    },
+)
+
+#: Modules that own the durable-swap fence protocol.
+FENCE_MODULE_SUFFIXES: Tuple[str, ...] = (
+    "runtime/state_store.py",
+    "runtime/registry.py",
+    "runtime/rollout.py",
+)
+
+#: Cutover scopes: functions implementing WAL-fence -> install.  Inside
+#: them no install call may precede the fence append on any path.
+FENCE_CUTOVER_FUNCS: FrozenSet[str] = frozenset({
+    "perform_cutover", "perform_registry_cutover", "cutover",
+})
+
+#: The WAL fence records.
+FENCE_APPEND_ATTRS: FrozenSet[str] = frozenset({
+    "append_cutover", "append_registry_cutover",
+})
+
+#: Install calls fenced by them: the manifest write, the in-memory gallery
+#: snapshot install, and the caller-supplied install hook.
+FENCE_INSTALL_ATTRS: FrozenSet[str] = frozenset({
+    "install", "load_snapshot",
+})
+FENCE_INSTALL_FN_NAMES: FrozenSet[str] = frozenset({"install_fn"})
+
+#: Durable-install writers: these functions MUST write through the
+#: ``atomic_write_*`` helpers (tmp+fsync+rename) and never a bare
+#: ``open(..., "w")`` — a torn manifest/checkpoint is an unrecoverable
+#: fence.
+FENCE_DURABLE_WRITERS: Tuple[Tuple[str, str], ...] = (
+    ("ModelRegistry", "_save_locked"),
+    ("CheckpointStore", "save"),
+)
+ATOMIC_WRITE_PREFIX = "atomic_write_"
+
+#: ledger-registry-coherence sites: where the terminal-status table from
+#: utils/metric_names.py must be mirrored exactly.  Files absent from a
+#: subset lint are skipped (run_lint.sh --changed).
+COHERENCE_TRACING_SUFFIX = "utils/tracing.py"
+COHERENCE_RECOGNIZER_SUFFIX = "runtime/recognizer.py"
+COHERENCE_PROMTEXT_SUFFIX = "runtime/promtext.py"
+COHERENCE_CHAOS_SUFFIX = "chaos_soak.py"
 
 
 def path_matches(path: str, suffixes: Tuple[str, ...]) -> bool:
